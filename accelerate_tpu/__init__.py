@@ -1,0 +1,49 @@
+"""accelerate_tpu — a TPU-native training/inference acceleration framework.
+
+Capability surface of HuggingFace Accelerate (ref /root/reference, see
+SURVEY.md), re-designed for JAX/XLA/pallas/pjit: one GSPMD mesh replaces the
+DDP/FSDP/DeepSpeed/Megatron plugin zoo; the train step compiles to a single
+donated XLA program; collectives ride ICI/DCN via the JAX runtime.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .logging import get_logger
+from .utils import (
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MegatronLMPlugin,
+    MeshConfig,
+    ProjectConfiguration,
+    find_executable_batch_size,
+    set_seed,
+)
+
+# Populated as subsystems land; late imports keep startup light.
+_LAZY = {
+    "Accelerator": ".accelerator",
+    "prepare_data_loader": ".data",
+    "skip_first_batches": ".data",
+    "DataLoaderShard": ".data",
+    "DataLoaderDispatcher": ".data",
+    "init_empty_weights": ".big_modeling",
+    "infer_auto_device_map": ".big_modeling",
+    "load_checkpoint_and_dispatch": ".big_modeling",
+    "dispatch_model": ".big_modeling",
+    "LocalSGD": ".local_sgd",
+    "notebook_launcher": ".launchers",
+    "debug_launcher": ".launchers",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(_LAZY[name], __name__)
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1 @@
+"""CLI subcommands (ref src/accelerate/commands/)."""

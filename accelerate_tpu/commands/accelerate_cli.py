@@ -1,0 +1,47 @@
+"""`accelerate-tpu` CLI root (ref src/accelerate/commands/accelerate_cli.py:26-46).
+
+Subcommands self-register via a `register_subcommand(subparsers)` entry in
+their module; unavailable subcommands (not yet built) are skipped silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+SUBCOMMAND_MODULES = [
+    "accelerate_tpu.commands.env",
+    "accelerate_tpu.commands.config",
+    "accelerate_tpu.commands.launch",
+    "accelerate_tpu.commands.test",
+    "accelerate_tpu.commands.estimate",
+    "accelerate_tpu.commands.tpu",
+]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]"
+    )
+    subparsers = parser.add_subparsers(dest="command")
+    for module_name in SUBCOMMAND_MODULES:
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError:
+            continue
+        module.register_subcommand(subparsers)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not hasattr(args, "func"):
+        parser.print_help()
+        return 1
+    return args.func(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

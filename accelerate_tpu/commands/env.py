@@ -1,0 +1,36 @@
+"""`accelerate-tpu env` — environment report (ref src/accelerate/commands/env.py, 109 LoC)."""
+
+from __future__ import annotations
+
+import argparse
+import platform
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser("env", help="Print environment information")
+    parser.set_defaults(func=env_command)
+
+
+def env_command(args: argparse.Namespace) -> int:
+    import jax
+
+    import accelerate_tpu
+    from accelerate_tpu.utils.imports import package_version
+
+    info = {
+        "`accelerate_tpu` version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "jaxlib version": package_version("jaxlib"),
+        "flax version": package_version("flax"),
+        "optax version": package_version("optax"),
+        "orbax-checkpoint version": package_version("orbax-checkpoint"),
+        "Devices": ", ".join(str(d) for d in jax.devices()),
+        "Default backend": jax.default_backend(),
+        "Process count": jax.process_count(),
+    }
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        print(f"- {key}: {value}")
+    return 0

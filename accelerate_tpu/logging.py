@@ -1,0 +1,61 @@
+"""Multi-process-aware logging (ref src/accelerate/logging.py:22-125)."""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """Logger adapter that only emits on the main process unless asked
+    otherwise (ref logging.py:33-92).
+
+    `log(..., main_process_only=False)` logs on every host;
+    `log(..., in_order=True)` logs host-by-host in rank order.
+    """
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if not self.isEnabledFor(level):
+            return
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if not in_order:
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            return
+
+        from .state import PartialState
+
+        state = PartialState()
+        for i in range(state.num_processes):
+            if i == state.process_index:
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, f"[rank {i}] {msg}", *args, **kwargs)
+            state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """ref logging.py:96-125. Level also settable via
+    ACCELERATE_TPU_LOG_LEVEL."""
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_TPU_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

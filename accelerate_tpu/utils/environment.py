@@ -1,0 +1,95 @@
+"""Environment parsing helpers.
+
+TPU-native analogue of ref src/accelerate/utils/environment.py (274 LoC):
+bool/int env parsing, env patching, and launch-context discovery. GPU probing
+and NUMA affinity are replaced by TPU topology introspection via JAX.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Iterator
+
+_TRUE = {"1", "true", "yes", "on", "y", "t"}
+_FALSE = {"0", "false", "no", "off", "n", "f", ""}
+
+
+def str_to_bool(value: str) -> bool:
+    """Parse a boolean env value (ref utils/environment.py:31-44)."""
+    v = value.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key)
+    if value is None:
+        return default
+    return str_to_bool(value)
+
+
+def parse_int_from_env(key: str, default: int | None = None) -> int | None:
+    value = os.environ.get(key)
+    if value is None:
+        return default
+    return int(value)
+
+
+def get_int_from_env(keys, default: int | None = None) -> int | None:
+    """First int found among ``keys`` (ref utils/environment.py:200-219 MPI
+    variable discovery: PMI_RANK / OMPI_COMM_WORLD_RANK / ...)."""
+    for key in keys:
+        value = os.environ.get(key)
+        if value is not None:
+            return int(value)
+    return default
+
+
+@contextlib.contextmanager
+def patch_environment(**kwargs: Any) -> Iterator[None]:
+    """Temporarily set env vars; restores previous values on exit
+    (ref utils/other.py:246)."""
+    saved: dict[str, str | None] = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        saved[key] = os.environ.get(key)
+        if value is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, old in saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+
+
+def parse_mesh_shape(spec: str) -> dict[str, int]:
+    """Parse ``"data=8,model=4"`` / ``"8x4"``-style mesh specs into an ordered
+    ``{axis: size}`` dict. ``-1`` means "infer from device count"."""
+    spec = spec.strip()
+    if not spec:
+        return {}
+    axes: dict[str, int] = {}
+    if "=" in spec:
+        for part in spec.split(","):
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size)
+    else:
+        from .constants import MESH_AXES
+
+        sizes = [int(s) for s in spec.replace("x", ",").split(",")]
+        for name, size in zip(MESH_AXES, sizes):
+            axes[name] = size
+    return axes
+
+
+def format_mesh_shape(axes: dict[str, int]) -> str:
+    return ",".join(f"{k}={v}" for k, v in axes.items())

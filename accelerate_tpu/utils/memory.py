@@ -1,0 +1,100 @@
+"""OOM-retry + device-memory helpers.
+
+TPU-native analogue of ref src/accelerate/utils/memory.py (158 LoC). OOM on
+XLA surfaces as RESOURCE_EXHAUSTED `XlaRuntimeError` rather than torch's
+`CUDA out of memory` strings (ref `should_reduce_batch_size` memory.py:69-84).
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable
+
+import jax
+
+
+def release_memory(*objects):
+    """Drop references and clear JAX's live-buffer caches
+    (ref memory.py:29-66)."""
+    objects = [None for _ in objects]
+    gc.collect()
+    jax.clear_caches()
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """Classify an exception as out-of-memory (ref memory.py:69-84)."""
+    msg = str(exception)
+    markers = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Attempting to reserve",
+        "exceeds the memory available",
+        "OOM",
+    )
+    if isinstance(exception, MemoryError):
+        return True
+    return any(m in msg for m in markers)
+
+
+def find_executable_batch_size(
+    function: Callable | None = None, starting_batch_size: int = 128
+):
+    """Decorator: call `function(batch_size, ...)`, halving the batch size on
+    OOM until it fits (ref memory.py:69-158). Clears compiled-program and
+    buffer caches between attempts."""
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size, starting_batch_size=starting_batch_size
+        )
+
+    batch_size = starting_batch_size
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        nonlocal batch_size
+        gc.collect()
+        jax.clear_caches()
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument "
+                f"when called.\nRemove this as the decorator already does so: "
+                f"`{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size, *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    gc.collect()
+                    jax.clear_caches()
+                    batch_size //= 2
+                else:
+                    raise
+
+    return wrapper
+
+
+def get_device_memory_stats(device=None) -> dict:
+    """Live/peak HBM bytes for a device (jax.profiler-free fast path).
+
+    The reference had no first-class memory introspection (SURVEY.md §5 —
+    `TorchTracemalloc` lived in a test script); here it is a library API used
+    by the perf harness and `estimate` CLI.
+    """
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }

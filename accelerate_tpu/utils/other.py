@@ -1,0 +1,134 @@
+"""Miscellaneous helpers (ref src/accelerate/utils/other.py, 366 LoC)."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from .environment import patch_environment  # re-export (ref other.py:246)
+
+__all__ = [
+    "patch_environment",
+    "save",
+    "wait_for_everyone",
+    "clean_state_dict_for_safetensors",
+    "save_flat_state_dict",
+    "load_flat_state_dict",
+    "merge_dicts",
+    "is_port_in_use",
+    "convert_bytes",
+    "flatten_dict",
+    "unflatten_dict",
+]
+
+
+def wait_for_everyone() -> None:
+    """Module-level barrier (ref other.py:128-139)."""
+    from ..state import PartialState
+
+    PartialState().wait_for_everyone()
+
+
+def save(obj: Any, f, save_on_each_node: bool = False, safe_serialization: bool = False) -> None:
+    """Save an object only on the main process (ref other.py:143-180)."""
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.is_main_process or save_on_each_node:
+        f = str(f)
+        os.makedirs(os.path.dirname(f) or ".", exist_ok=True)
+        if safe_serialization:
+            save_flat_state_dict(obj, f)
+        else:
+            with open(f, "wb") as fh:
+                pickle.dump(obj, fh)
+
+
+def flatten_dict(tree: Any, prefix: str = "", sep: str = ".") -> dict[str, Any]:
+    """Flatten a nested dict/pytree of arrays into {'a.b.c': leaf}."""
+    out: dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            key = f"{prefix}{sep}{k}" if prefix else str(k)
+            out.update(flatten_dict(v, key, sep))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            key = f"{prefix}{sep}{i}" if prefix else str(i)
+            out.update(flatten_dict(v, key, sep))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def unflatten_dict(flat: dict[str, Any], sep: str = ".") -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for key, value in flat.items():
+        parts = key.split(sep)
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def clean_state_dict_for_safetensors(state_dict: dict) -> dict[str, np.ndarray]:
+    """Flatten + materialize to contiguous numpy (safetensors requires it);
+    analogue of ref other.py:155-170 shared-tensor cleaning (JAX arrays are
+    never aliased, so only flattening remains)."""
+    flat = flatten_dict(state_dict)
+    return {k: np.ascontiguousarray(np.asarray(v)) for k, v in flat.items() if v is not None}
+
+
+def save_flat_state_dict(state_dict: dict, path: str, metadata: dict | None = None) -> None:
+    """Write a pytree as one safetensors file (ref `save_model` path)."""
+    from safetensors.numpy import save_file
+
+    flat = clean_state_dict_for_safetensors(state_dict)
+    save_file(flat, path, metadata={"format": "np", **(metadata or {})})
+
+
+def load_flat_state_dict(path: str) -> dict:
+    from safetensors.numpy import load_file
+
+    return unflatten_dict(load_file(path))
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursive dict merge (ref other.py:318)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination
+
+
+def is_port_in_use(port: int | None = None) -> bool:
+    """ref other.py:330."""
+    import socket
+
+    if port is None:
+        port = 29500
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", port)) == 0
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable bytes (ref other.py:342)."""
+    for unit in ["B", "KB", "MB", "GB", "TB"]:
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"
+
+
+def write_json(obj: Any, path: str | Path) -> None:
+    with open(path, "w") as fh:
+        json.dump(obj, fh, indent=2, sort_keys=True)

@@ -1,0 +1,108 @@
+"""RNG management.
+
+TPU-native analogue of ref src/accelerate/utils/random.py (124 LoC). The
+reference had to *synchronize* implicit global RNG streams across ranks by
+broadcasting from rank 0 each epoch (ref random.py:122). JAX keys are explicit
+and deterministic, so cross-host agreement is by construction: every host
+derives the same key from the same seed. What remains is (a) seeding the
+host-side libraries (python/numpy/torch) that drive data pipelines, and (b) a
+convenient per-step/per-host key-derivation scheme.
+"""
+
+from __future__ import annotations
+
+import random as _py_random
+from typing import Iterable
+
+import jax
+import numpy as np
+
+from .dataclasses import RNGType
+
+
+def set_seed(seed: int, device_specific: bool = False) -> int:
+    """Seed python/numpy/torch globals and return the (possibly rank-offset)
+    seed (ref utils/random.py:31-59).
+
+    `device_specific=True` offsets by process index so each host draws
+    different data-augmentation randomness while model randomness should use
+    explicit keys from `rng_key`.
+    """
+    from ..state import PartialState
+
+    if device_specific:
+        seed += PartialState().process_index
+    _py_random.seed(seed)
+    np.random.seed(seed % (2**32))
+    try:
+        import torch
+
+        torch.manual_seed(seed)
+    except ImportError:
+        pass
+    return seed
+
+
+def rng_key(seed: int) -> jax.Array:
+    """Root PRNG key; identical on every host for replicated model randomness."""
+    return jax.random.key(seed)
+
+
+def fold_in_step(key: jax.Array, step: int) -> jax.Array:
+    """Per-step key: deterministic resume (checkpoint stores only the seed +
+    step; ref checkpointing.py:134-148 had to pickle whole RNG states)."""
+    return jax.random.fold_in(key, step)
+
+
+def fold_in_process(key: jax.Array, process_index: int | None = None) -> jax.Array:
+    """Per-host key, e.g. for host-local augmentation."""
+    if process_index is None:
+        from ..state import PartialState
+
+        process_index = PartialState().process_index
+    return jax.random.fold_in(key, process_index)
+
+
+def synchronize_rng_state(rng_type: RNGType, generator=None) -> None:
+    """Align one host-side RNG stream across hosts by broadcasting rank-0's
+    state (ref utils/random.py:62-112). JAX keys never need this."""
+    from ..state import PartialState
+
+    state = PartialState()
+    if state.num_processes <= 1 or rng_type == RNGType.JAX:
+        return
+    from jax.experimental import multihost_utils
+
+    if rng_type == RNGType.NUMPY:
+        # legacy MT19937 state: (name, keys[624], pos, has_gauss, cached)
+        st = np.random.get_state()
+        keys = multihost_utils.broadcast_one_to_all(np.asarray(st[1], dtype=np.uint32))
+        pos = int(multihost_utils.broadcast_one_to_all(np.asarray(st[2])))
+        np.random.set_state((st[0], np.asarray(keys), pos, 0, 0.0))
+    elif rng_type == RNGType.PYTHON:
+        seed = int(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(_py_random.getrandbits(63), dtype=np.int64)
+            )
+        )
+        _py_random.seed(seed)
+    elif rng_type in (RNGType.TORCH, RNGType.GENERATOR):
+        try:
+            import torch
+        except ImportError:
+            return
+        seed = int(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(torch.initial_seed() % (2**63 - 1), dtype=np.int64)
+            )
+        )
+        if rng_type == RNGType.TORCH:
+            torch.manual_seed(seed)
+        elif generator is not None:
+            generator.manual_seed(seed)
+
+
+def synchronize_rng_states(rng_types: Iterable[RNGType | str], generator=None) -> None:
+    """ref utils/random.py:115-124."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type), generator=generator)

@@ -1,0 +1,17 @@
+"""Main-process-gated tqdm (ref src/accelerate/utils/tqdm.py)."""
+
+from __future__ import annotations
+
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    if not is_tqdm_available():
+        raise ImportError("tqdm is not installed; `pip install tqdm`.")
+    from tqdm.auto import tqdm as _tqdm
+
+    from ..state import PartialState
+
+    if main_process_only:
+        kwargs["disable"] = kwargs.get("disable", False) or not PartialState().is_main_process
+    return _tqdm(*args, **kwargs)

@@ -1,0 +1,47 @@
+"""Version comparison helpers (ref src/accelerate/utils/versions.py, 56 LoC)."""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator
+import re
+
+_OPS = {
+    "<": operator.lt,
+    "<=": operator.le,
+    "==": operator.eq,
+    "!=": operator.ne,
+    ">=": operator.ge,
+    ">": operator.gt,
+}
+
+
+def _parse(version: str):
+    try:
+        from packaging.version import parse
+
+        return parse(version)
+    except ImportError:
+        # fallback: numeric-only tuple; pre-release tags compare as 0
+        parts = []
+        for piece in re.split(r"[.\-+]", version):
+            digits = re.match(r"\d+", piece)
+            parts.append(int(digits.group()) if digits else 0)
+        return tuple(parts)
+
+
+def compare_versions(library_or_version: str, operation: str, requirement: str) -> bool:
+    """``compare_versions("jax", ">=", "0.4.30")`` or compare two literals."""
+    if operation not in _OPS:
+        raise ValueError(f"operation must be one of {list(_OPS)}, got {operation}")
+    try:
+        version = importlib.metadata.version(library_or_version)
+    except importlib.metadata.PackageNotFoundError:
+        version = library_or_version
+    return _OPS[operation](_parse(version), _parse(requirement))
+
+
+def is_jax_version(operation: str, requirement: str) -> bool:
+    import jax
+
+    return _OPS[operation](_parse(jax.__version__), _parse(requirement))

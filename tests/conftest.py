@@ -1,0 +1,41 @@
+"""Test harness: a virtual 8-device CPU mesh stands in for a TPU slice.
+
+This replaces the reference's `debug_launcher` gloo world
+(ref launchers.py:225-257, SURVEY.md §4): distributed semantics run in one
+process over 8 XLA host devices, so sharding/collective logic is exercised
+without hardware.
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The hosted-TPU image pins jax_platforms to the tunnel backend at import
+# time, which silently overrides JAX_PLATFORMS — force CPU before any backend
+# initializes so tests always run on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
+
+
+@pytest.fixture(autouse=True)
+def reset_state():
+    """Clear the shared-state singletons between tests
+    (ref test_utils/testing.py:394-439 AccelerateTestCase)."""
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    yield
+    PartialState._reset_state()
+
+
+@pytest.fixture
+def devices():
+    return jax.devices()

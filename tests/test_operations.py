@@ -1,0 +1,131 @@
+"""Collectives/ops tests (ref tests/test_utils.py + test_utils/scripts/test_ops.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    broadcast,
+    broadcast_object_list,
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    find_device,
+    gather,
+    gather_object,
+    get_data_structure,
+    initialize_tensors,
+    listify,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+
+
+def test_send_to_device_pytree():
+    batch = {"x": np.ones((2, 3)), "y": [np.zeros(4), "keep-me"]}
+    out = send_to_device(batch, jax.devices()[0])
+    assert isinstance(out["x"], jax.Array)
+    assert out["y"][1] == "keep-me"
+    assert list(out["x"].devices())[0] == jax.devices()[0]
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(2), "meta": np.zeros(2)}
+    out = send_to_device(batch, jax.devices()[1], skip_keys=["meta"])
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_gather_sharded_global_array():
+    """gather() on a mesh-sharded array returns the full value."""
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("data",))
+    sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+    x = jax.device_put(jnp.arange(16.0).reshape(16, 1), sharding)
+    out = gather(x)
+    np.testing.assert_array_equal(np.asarray(out), np.arange(16.0).reshape(16, 1))
+
+
+def test_gather_host_local_single_process():
+    out = gather({"a": np.ones((2, 2))})
+    np.testing.assert_array_equal(out["a"], np.ones((2, 2)))
+
+
+def test_gather_object_single():
+    assert gather_object({"k": 1}) == [{"k": 1}]
+
+
+def test_broadcast_and_object_list_single():
+    x = {"a": np.arange(3)}
+    np.testing.assert_array_equal(broadcast(x)["a"], np.arange(3))
+    objs = ["a", 2]
+    assert broadcast_object_list(objs) == ["a", 2]
+
+
+def test_reduce_mean_sum():
+    x = np.asarray([2.0, 4.0])
+    np.testing.assert_allclose(reduce(x, "mean"), x)
+    np.testing.assert_allclose(reduce(x, "sum"), x)
+    with pytest.raises(ValueError):
+        reduce(x, "max")
+
+
+def test_reduce_sharded_array_identity():
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("data",))
+    sharding = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("data"))
+    x = jax.device_put(jnp.ones((8,)), sharding)
+    np.testing.assert_allclose(np.asarray(reduce(x, "mean")), np.ones(8))
+
+
+def test_pad_across_processes_noop_and_dim():
+    x = np.ones((3, 5))
+    out = pad_across_processes(x, dim=1)
+    assert out.shape == (3, 5)
+    assert pad_across_processes(np.float32(1.0)) == 1.0
+
+
+def test_pad_input_tensors():
+    x = {"input_ids": np.arange(10).reshape(5, 2)}
+    out = pad_input_tensors(x, batch_size=5, num_processes=4)
+    assert out["input_ids"].shape == (8, 2)
+    np.testing.assert_array_equal(out["input_ids"][5], out["input_ids"][4])
+
+
+def test_concatenate_nested():
+    a = {"x": np.ones((2, 3)), "y": (np.zeros(1),)}
+    b = {"x": np.ones((4, 3)), "y": (np.ones(2),)}
+    out = concatenate([a, b])
+    assert out["x"].shape == (6, 3)
+    assert out["y"][0].shape == (3,)
+
+
+def test_structure_roundtrip():
+    data = {"a": np.ones((2, 4), np.float32), "b": [np.zeros(3, np.int32)]}
+    skeleton = get_data_structure(data)
+    assert skeleton["a"].shape == (2, 4)
+    zeros = initialize_tensors(skeleton)
+    assert zeros["a"].dtype == np.float32
+    assert find_batch_size(data) == 2
+    assert listify(data)["b"][0] == [0, 0, 0]
+
+
+def test_slice_and_find_device():
+    data = {"x": jnp.ones((4, 2))}
+    sliced = slice_tensors(data, slice(0, 2))
+    assert sliced["x"].shape == (2, 2)
+    assert find_device(data) in jax.devices()
+
+
+def test_convert_to_fp32():
+    out = convert_to_fp32({"x": jnp.ones(2, dtype=jnp.bfloat16), "i": jnp.ones(2, jnp.int32)})
+    assert out["x"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32
+
+
+def test_recursively_apply_error_on_other_type():
+    with pytest.raises(TypeError):
+        recursively_apply(lambda x: x, {"a": "str"}, error_on_other_type=True)

@@ -1,0 +1,92 @@
+"""State/mesh core tests (ref tests/test_state_checkpointing.py + test_utils)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    DistributedType,
+    GradientAccumulationPlugin,
+    MeshConfig,
+)
+
+
+def test_partial_state_topology():
+    state = PartialState()
+    assert state.num_processes == 1
+    assert state.process_index == 0
+    assert state.device_count == 8
+    assert state.is_main_process
+    assert state.is_last_process
+    assert state.distributed_type == DistributedType.JAX
+
+
+def test_partial_state_singleton():
+    a = PartialState()
+    b = PartialState()
+    assert a.__dict__ is b.__dict__
+
+
+def test_default_mesh_is_data_parallel():
+    state = PartialState()
+    assert dict(state.mesh.shape) == {AXIS_DATA: 8}
+
+
+def test_split_between_processes_single():
+    state = PartialState()
+    with state.split_between_processes([1, 2, 3]) as x:
+        assert x == [1, 2, 3]
+
+
+def test_on_main_process_decorators():
+    state = PartialState()
+    calls = []
+    state.on_main_process(lambda: calls.append("main"))()
+    state.on_last_process(lambda: calls.append("last"))()
+    state.on_process(lambda: calls.append("p0"), 0)()
+    assert calls == ["main", "last", "p0"]
+
+
+def test_accelerator_state_mesh_config():
+    state = AcceleratorState(mesh_config=MeshConfig(axes={AXIS_DATA: 2, AXIS_MODEL: 4}))
+    assert dict(state.mesh.shape) == {AXIS_DATA: 2, AXIS_MODEL: 4}
+    assert state.dp_size == 2
+    assert state.axis_size(AXIS_MODEL) == 4
+
+
+def test_accelerator_state_mixed_precision_conflict():
+    AcceleratorState(mixed_precision="bf16")
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp16")
+
+
+def test_mesh_config_wildcard_resolution():
+    cfg = MeshConfig(axes={AXIS_DATA: 2, AXIS_FSDP: -1})
+    assert cfg.resolved_axes(8) == {AXIS_DATA: 2, AXIS_FSDP: 4}
+    with pytest.raises(ValueError):
+        MeshConfig(axes={AXIS_DATA: 3}).resolved_axes(8)
+    with pytest.raises(ValueError):
+        MeshConfig(axes={"bogus": 2})
+
+
+def test_mesh_config_canonical_order():
+    cfg = MeshConfig(axes={AXIS_MODEL: 4, AXIS_DATA: -1})
+    mesh = cfg.build()
+    assert mesh.axis_names == (AXIS_DATA, AXIS_MODEL)  # data outermost
+
+
+def test_gradient_state_accumulation_flags():
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    assert gs.sync_gradients
+    gs._set_sync_gradients(False)
+    assert not GradientState().sync_gradients  # singleton
+    assert gs.remainder == -1  # no dataloader registered
+
+
+def test_wait_for_everyone_noop_single_host():
+    PartialState().wait_for_everyone()  # must not raise

@@ -1,0 +1,117 @@
+"""Tests for env parsing, versions, memory, misc utils (ref tests/test_utils.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.utils import (
+    convert_bytes,
+    find_executable_batch_size,
+    flatten_dict,
+    merge_dicts,
+    parse_mesh_shape,
+    patch_environment,
+    release_memory,
+    set_seed,
+    should_reduce_batch_size,
+    str_to_bool,
+    unflatten_dict,
+)
+from accelerate_tpu.utils.versions import compare_versions
+
+
+def test_str_to_bool():
+    assert str_to_bool("TRUE") and str_to_bool("1") and str_to_bool("yes")
+    assert not str_to_bool("0") and not str_to_bool("off") and not str_to_bool("")
+    with pytest.raises(ValueError):
+        str_to_bool("maybe")
+
+
+def test_patch_environment():
+    assert "ACC_TEST_VAR" not in os.environ
+    with patch_environment(acc_test_var="7"):
+        assert os.environ["ACC_TEST_VAR"] == "7"
+    assert "ACC_TEST_VAR" not in os.environ
+
+
+def test_parse_mesh_shape():
+    assert parse_mesh_shape("data=8,model=4") == {"data": 8, "model": 4}
+    assert parse_mesh_shape("8x4") == {"data": 8, "fsdp": 4}
+    assert parse_mesh_shape("") == {}
+
+
+def test_flatten_unflatten_roundtrip():
+    tree = {"a": {"b": 1, "c": [2, 3]}, "d": 4}
+    flat = flatten_dict(tree)
+    assert flat["a.b"] == 1 and flat["a.c.0"] == 2
+    restored = unflatten_dict(flat)
+    assert restored["a"]["b"] == 1 and restored["a"]["c"]["1"] == 3
+
+
+def test_merge_dicts():
+    dst = {"a": {"x": 1}, "b": 2}
+    merge_dicts({"a": {"y": 3}, "b": 9}, dst)
+    assert dst == {"a": {"x": 1, "y": 3}, "b": 9}
+
+
+def test_convert_bytes():
+    assert convert_bytes(1024) == "1.0 KB"
+    assert convert_bytes(3 * 1024**3) == "3.0 GB"
+
+
+def test_find_executable_batch_size_halves_on_oom():
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=16)
+    def run(batch_size):
+        attempts.append(batch_size)
+        if batch_size > 4:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating")
+        return batch_size
+
+    assert run() == 4
+    assert attempts == [16, 8, 4]
+
+
+def test_find_executable_batch_size_reraises_non_oom():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size):
+        raise ValueError("not oom")
+
+    with pytest.raises(ValueError):
+        run()
+
+
+def test_find_executable_batch_size_rejects_explicit_batch():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size, other):
+        return batch_size
+
+    with pytest.raises(TypeError):
+        run(128, "x")
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert should_reduce_batch_size(MemoryError())
+    assert not should_reduce_batch_size(ValueError("nope"))
+
+
+def test_set_seed_deterministic():
+    set_seed(1234)
+    a = np.random.rand(3)
+    set_seed(1234)
+    b = np.random.rand(3)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_compare_versions():
+    assert compare_versions("1.2.3", "<", "1.10.0")
+    assert compare_versions("jax", ">=", "0.4.0")
+
+
+def test_release_memory():
+    x, y = np.ones(10), np.ones(10)
+    x, y = release_memory(x, y)
+    assert x is None and y is None
