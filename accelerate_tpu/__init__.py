@@ -23,9 +23,14 @@ from .utils import (
     set_seed,
 )
 
-# Populated as subsystems land; late imports keep startup light.
+# Populated as subsystems land; late imports keep startup light (optax et al.
+# only load when the training surface is touched).
 _LAZY = {
     "Accelerator": ".accelerator",
+    "AcceleratedOptimizer": ".optimizer",
+    "AcceleratedScheduler": ".scheduler",
+    "TrainState": ".training",
+    "DynamicLossScale": ".training",
     "prepare_data_loader": ".data",
     "skip_first_batches": ".data",
     "DataLoaderShard": ".data",

@@ -1,0 +1,875 @@
+"""The Accelerator facade.
+
+TPU-native analogue of ref src/accelerate/accelerator.py (3409 LoC,
+`Accelerator` at :163). The public surface is kept — prepare / accumulate /
+backward / clip_grad_norm_ / gather / gather_for_metrics / save_state /
+trackers — but the engine underneath is different by design (SURVEY.md §7):
+
+- `prepare()` does not wrap modules in DDP/FSDP/DeepSpeed engines
+  (ref :1428-1550); it plans `NamedSharding`s over one mesh and places
+  pytrees (sharding/planner.py).
+- The hot loop does not orchestrate backward/clip/step eagerly
+  (ref :2093-2270); `train_step()` compiles loss, grad, accumulation, clip,
+  optimizer update, and the mixed-precision policy into ONE donated XLA
+  program. An eager-compatible path (`compute_gradients`/`backward`/`step`)
+  remains for reference-style loops.
+- Mixed precision is a compile-time dtype policy, not a runtime autocast
+  (ref :3293): bf16 compute over fp32 master params; fp16 gets a dynamic
+  loss scale (training.DynamicLossScale) replacing torch GradScaler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import math
+import os
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .data import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
+from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .scheduler import AcceleratedScheduler
+from .sharding import (
+    batch_sharding,
+    batch_spec,
+    plan_optimizer_sharding,
+    plan_sharding,
+    shard_pytree,
+    transformer_rules,
+)
+from .state import AcceleratorState, GradientState, PartialState
+from .training import (
+    DynamicLossScale,
+    TrainState,
+    cast_floating,
+    clip_by_global_norm,
+)
+from .utils import operations as ops
+from .utils.dataclasses import (
+    ContextParallelPlugin,
+    DataLoaderConfiguration,
+    DeepSpeedPlugin,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    JitConfig,
+    MegatronLMPlugin,
+    MeshConfig,
+    PrecisionType,
+    ProjectConfiguration,
+)
+from .utils.memory import release_memory
+
+logger = get_logger(__name__)
+
+
+def _is_params_pytree(obj: Any) -> bool:
+    if not isinstance(obj, dict) or not obj:
+        return False
+    leaves = jax.tree_util.tree_leaves(obj)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) or hasattr(l, "shape") for l in leaves
+    )
+
+
+def _is_optimizer(obj: Any) -> bool:
+    return isinstance(obj, optax.GradientTransformation) or (
+        hasattr(obj, "init") and hasattr(obj, "update") and not isinstance(obj, TrainState)
+    )
+
+
+def _is_dataloader(obj: Any) -> bool:
+    if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
+        return True
+    return hasattr(obj, "__iter__") and not isinstance(obj, (dict, str, bytes))
+
+
+class Accelerator:
+    """ref accelerator.py:163. One instance per process; state is global."""
+
+    def __init__(
+        self,
+        *,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: str | PrecisionType | None = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: DataLoaderConfiguration | None = None,
+        deepspeed_plugin: DeepSpeedPlugin | None = None,
+        fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
+        megatron_lm_plugin: MegatronLMPlugin | None = None,
+        context_parallel_plugin: ContextParallelPlugin | None = None,
+        mesh_config: MeshConfig | None = None,
+        sharding_rules=None,
+        rng_types: list | None = None,
+        log_with=None,
+        project_dir: str | None = None,
+        project_config: ProjectConfiguration | None = None,
+        gradient_accumulation_plugin: GradientAccumulationPlugin | None = None,
+        step_scheduler_with_optimizer: bool = True,
+        jit_config: JitConfig | None = None,
+        gradient_clipping: float | None = None,
+        kwargs_handlers: list | None = None,
+    ):
+        self.project_configuration = project_config or ProjectConfiguration(
+            project_dir=project_dir
+        )
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # --- mesh resolution: explicit > env > plugins > default DP ----------
+        # (replaces ref env promotion ACCELERATE_USE_* state.py:892-910)
+        self.deepspeed_plugin = deepspeed_plugin
+        self.fsdp_plugin = fsdp_plugin
+        self.megatron_lm_plugin = megatron_lm_plugin
+        self.context_parallel_plugin = context_parallel_plugin
+        resolved_mesh = mesh_config or MeshConfig.from_env()
+        if resolved_mesh is None:
+            axes: dict[str, int] = {}
+            for plugin in (fsdp_plugin, deepspeed_plugin, megatron_lm_plugin,
+                           context_parallel_plugin):
+                if plugin is not None:
+                    for a, s in plugin.to_mesh_axes().items():
+                        axes[a] = s
+            wilds = [a for a, s in axes.items() if s == -1]
+            for a in wilds[:-1]:
+                axes.pop(a)
+            resolved_mesh = MeshConfig(axes=axes) if axes else None
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision, cpu=cpu, mesh_config=resolved_mesh
+        )
+
+        # --- gradient accumulation (ref :421, dataclasses.py:586) ------------
+        if gradient_accumulation_plugin is None:
+            env_steps = int(os.environ.get("ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS",
+                                           gradient_accumulation_steps))
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=env_steps)
+        self.gradient_state = GradientState(gradient_accumulation_plugin)
+
+        self.device_placement = device_placement
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(
+            split_batches=split_batches
+        )
+        self.rng_types = rng_types
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.jit_config = jit_config or JitConfig()
+        self.sharding_rules = sharding_rules or transformer_rules()
+        if gradient_clipping is None and deepspeed_plugin is not None:
+            gradient_clipping = deepspeed_plugin.gradient_clipping
+        self.gradient_clipping = gradient_clipping
+
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list = []
+        self._models: list = []
+        self._custom_objects: list = []
+        self._prepared_params_sharding = None
+        self.flag_tensor = None
+        self.step = 0
+
+        # trackers (ref :399-402, tracking wired in init_trackers)
+        self.log_with = log_with if isinstance(log_with, (list, tuple)) else (
+            [log_with] if log_with is not None else []
+        )
+        self.trackers = []
+
+        # checkpoint hooks (ref :2798,:2964)
+        self._save_model_state_pre_hook = {}
+        self._load_model_state_pre_hook = {}
+
+    # ------------------------------------------------------------------ state
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def distributed_type(self):
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self) -> int:
+        return self.state.num_processes
+
+    @property
+    def process_index(self) -> int:
+        return self.state.process_index
+
+    @property
+    def local_process_index(self) -> int:
+        return self.state.local_process_index
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self) -> str:
+        return str(self.state.mixed_precision)
+
+    @property
+    def compute_dtype(self):
+        if self.state.mixed_precision == PrecisionType.BF16:
+            return jnp.bfloat16
+        if self.state.mixed_precision == PrecisionType.FP16:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value: int) -> None:
+        self.gradient_state.plugin.num_steps = value
+
+    @property
+    def sync_gradients(self) -> bool:
+        return self.gradient_state.sync_gradients
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.state.use_distributed
+
+    # ---------------------------------------------------------- process ctl
+    def wait_for_everyone(self) -> None:
+        self.state.wait_for_everyone()
+
+    def print(self, *args, **kwargs) -> None:
+        self.state.print(*args, **kwargs)
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding)
+
+    def on_main_process(self, function):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function):
+        return self.state.on_local_main_process(function)
+
+    def on_process(self, function, process_index: int = 0):
+        return self.state.on_process(function, process_index)
+
+    def main_process_first(self):
+        return self.state.main_process_first()
+
+    def local_main_process_first(self):
+        return self.state.local_main_process_first()
+
+    # -------------------------------------------------------------- prepare
+    def prepare(self, *args, device_placement: list | None = None):
+        """Shard/wrap each object by type (ref accelerator.py:1180-1314).
+
+        - param pytree (dict of arrays) -> sharded per the rule planner
+        - `TrainState`                  -> params+opt_state sharded
+        - optax transformation          -> `AcceleratedOptimizer` (bound to the
+                                           params prepared in the same call)
+        - iterable / torch DataLoader   -> `DataLoaderShard`
+        - schedule callable             -> `AcceleratedScheduler`
+        """
+        if device_placement is not None and len(device_placement) != len(args):
+            raise ValueError(
+                f"device_placement has {len(device_placement)} entries for {len(args)} objects"
+            )
+        # pass 1: params/TrainState (so optimizers can bind to sharded params)
+        results: list[Any] = list(args)
+        prepared_params = None
+        for i, obj in enumerate(args):
+            if isinstance(obj, TrainState):
+                results[i] = self.prepare_train_state(obj)
+                prepared_params = results[i].params
+            elif _is_params_pytree(obj):
+                results[i] = self.prepare_params(obj)
+                prepared_params = results[i]
+        # pass 2: everything else
+        for i, obj in enumerate(results):
+            if isinstance(obj, TrainState) or obj is prepared_params:
+                continue
+            if _is_optimizer(obj) and not isinstance(obj, AcceleratedOptimizer):
+                results[i] = self.prepare_optimizer(obj, params=prepared_params)
+            elif isinstance(obj, AcceleratedScheduler):
+                pass
+            elif callable(obj) and not _is_dataloader(obj) and not _is_params_pytree(obj):
+                results[i] = self.prepare_scheduler(obj)
+            elif _is_dataloader(obj) and not isinstance(
+                obj, (DataLoaderShard, DataLoaderDispatcher)
+            ):
+                results[i] = self.prepare_data_loader(obj)
+        return results[0] if len(results) == 1 else tuple(results)
+
+    def prepare_params(self, params: Any) -> Any:
+        """Plan + place a parameter pytree (replaces model.to(device) + wrap,
+        ref :1411-1550)."""
+        shard = True
+        if self.fsdp_plugin is not None:
+            shard = self.fsdp_plugin.shard_params
+        elif self.deepspeed_plugin is not None:
+            shard = self.deepspeed_plugin.shard_params
+        plan = plan_sharding(params, self.mesh, self.sharding_rules, shard_params=shard)
+        self._prepared_params_sharding = plan
+        if not self.device_placement:
+            return params
+        return shard_pytree(params, plan)
+
+    def prepare_model(self, model: Any, device_placement: bool | None = None) -> Any:
+        """Parity alias (ref :1316): params pytrees are the model here."""
+        if _is_params_pytree(model):
+            return self.prepare_params(model)
+        if isinstance(model, TrainState):
+            return self.prepare_train_state(model)
+        self._models.append(model)
+        return model
+
+    def prepare_train_state(self, ts: TrainState) -> TrainState:
+        shard = True
+        if self.fsdp_plugin is not None:
+            shard = self.fsdp_plugin.shard_params
+        elif self.deepspeed_plugin is not None:
+            shard = self.deepspeed_plugin.shard_params
+        param_plan = plan_sharding(ts.params, self.mesh, self.sharding_rules,
+                                   shard_params=shard)
+        self._prepared_params_sharding = param_plan
+        params = shard_pytree(ts.params, param_plan)
+        shard_opt = True
+        if self.deepspeed_plugin is not None:
+            shard_opt = self.deepspeed_plugin.shard_optimizer_state
+        opt_plan_source = param_plan if shard_opt else jax.tree_util.tree_map(
+            lambda _: jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
+            param_plan,
+        )
+        opt_plan = plan_optimizer_sharding(ts.tx, ts.opt_state, opt_plan_source, self.mesh)
+        opt_state = shard_pytree(ts.opt_state, opt_plan)
+        needs_scale = self.state.mixed_precision == PrecisionType.FP16
+        return dataclasses.replace(
+            ts,
+            params=params,
+            opt_state=opt_state,
+            loss_scale=ts.loss_scale
+            if ts.loss_scale is not None or not needs_scale
+            else DynamicLossScale.create(),
+        )
+
+    def prepare_optimizer(
+        self, tx, params: Any = None, device_placement: bool | None = None
+    ) -> AcceleratedOptimizer:
+        """ref :2011. Binds the optax transformation to prepared params."""
+        opt_sharding = None
+        if params is not None and self._prepared_params_sharding is not None:
+            opt_state = tx.init(params)
+            opt_sharding = plan_optimizer_sharding(
+                tx, opt_state, self._prepared_params_sharding, self.mesh
+            )
+            opt_state = shard_pytree(opt_state, opt_sharding)
+            opt = AcceleratedOptimizer(
+                tx, params=params, opt_state=opt_state,
+                param_sharding=self._prepared_params_sharding,
+                opt_sharding=opt_sharding,
+            )
+        else:
+            opt = AcceleratedOptimizer(tx, params=params)
+        self._optimizers.append(opt)
+        return opt
+
+    def prepare_data_loader(self, data_loader, device_placement: bool | None = None,
+                            slice_fn_for_dispatch=None):
+        """ref :1958."""
+        put_on_device = (
+            device_placement if device_placement is not None else self.device_placement
+        )
+        prepared = prepare_data_loader(
+            data_loader,
+            put_on_device=put_on_device,
+            rng_types=self.rng_types,
+            mesh=self.mesh,
+            config=self.dataloader_config,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def prepare_scheduler(self, schedule: Callable) -> AcceleratedScheduler:
+        """ref :2052."""
+        sched = AcceleratedScheduler(
+            schedule,
+            self._optimizers,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.dataloader_config.split_batches,
+        )
+        self._schedulers.append(sched)
+        return sched
+
+    # ------------------------------------------------------------- hot loop
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """ref accelerator.py:1025-1059. Tracks the micro-step counter and
+        flips `sync_gradients` at accumulation boundaries (or end of epoch
+        when `sync_with_dataloader`)."""
+        self.step += 1
+        end = (
+            self.gradient_state.sync_with_dataloader
+            and self.gradient_state.end_of_dataloader
+        )
+        sync = (
+            self.step % self.gradient_state.num_steps == 0
+            or end
+            or self.gradient_state.plugin.sync_each_batch
+        )
+        self.gradient_state._set_sync_gradients(sync)
+        yield
+
+    @contextlib.contextmanager
+    def no_sync(self, model=None):
+        """ref :910-948. Forces accumulation (no optimizer step)."""
+        prev = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(prev)
+
+    def compute_gradients(
+        self, loss_fn: Callable, params: Any, *batch, has_aux: bool = False
+    ):
+        """Jitted value_and_grad with the mixed-precision policy applied —
+        the functional stand-in for `loss.backward()` (ref :2093). Returns
+        (loss, grads) or ((loss, aux), grads)."""
+        fn = self._grad_fn_cache_get(loss_fn, has_aux)
+        return fn(params, *batch)
+
+    def _grad_fn_cache_get(self, loss_fn, has_aux):
+        cache = getattr(self, "_grad_fns", None)
+        if cache is None:
+            cache = self._grad_fns = {}
+        key = (id(loss_fn), has_aux)
+        if key not in cache:
+            dtype = self.compute_dtype
+
+            def wrapped(params, *batch):
+                cparams = cast_floating(params, dtype)
+                return loss_fn(cparams, *batch)
+
+            cache[key] = jax.jit(jax.value_and_grad(wrapped, has_aux=has_aux))
+        return cache[key]
+
+    def backward(self, loss_or_grads: Any = None, *, grads: Any = None, **kwargs) -> None:
+        """Accumulate gradients scaled by 1/num_steps (ref :2093-2125).
+
+        In the functional world the caller passes *gradients* (from
+        `compute_gradients`); passing a bare loss raises with guidance."""
+        if grads is None:
+            grads = loss_or_grads
+        if grads is None or not jax.tree_util.tree_leaves(grads):
+            raise ValueError(
+                "accelerator.backward needs gradients: "
+                "loss, grads = accelerator.compute_gradients(loss_fn, params, batch); "
+                "accelerator.backward(grads)"
+            )
+        if isinstance(grads, (jax.Array, np.ndarray)) and np.ndim(grads) == 0:
+            raise ValueError(
+                "Got a scalar loss. JAX has no backward tape: compute grads with "
+                "accelerator.compute_gradients(...) and pass them here, or use the "
+                "fused accelerator.train_step(...)."
+            )
+        scale = 1.0 / self.gradient_state.num_steps
+        for opt in self._optimizers:
+            opt.accumulate_grads(grads, scale)
+
+    def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2):
+        """ref :2221-2270. Clips each prepared optimizer's gradient buffer;
+        returns the pre-clip global norm."""
+        if norm_type != 2:
+            raise NotImplementedError("only L2 global-norm clipping is supported")
+        if not self.sync_gradients:
+            return None
+        norm = None
+        for opt in self._optimizers:
+            if opt.gradients is not None:
+                clipped, norm = clip_by_global_norm(opt.gradients, max_norm)
+                opt.gradients = clipped
+        return norm
+
+    def clip_grad_value_(self, parameters=None, clip_value: float = 1.0):
+        """ref :2272."""
+        if not self.sync_gradients:
+            return
+        for opt in self._optimizers:
+            if opt.gradients is not None:
+                opt.gradients = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, -clip_value, clip_value), opt.gradients
+                )
+
+    # ------------------------------------------------- fused compiled path
+    def train_step(
+        self,
+        loss_fn: Callable,
+        has_aux: bool = False,
+        max_grad_norm: float | None = None,
+        donate: bool = True,
+    ) -> Callable:
+        """Compile (TrainState, batch) -> (TrainState, metrics): forward,
+        backward, 1/k accumulation, clip, optimizer update, loss-scale — one
+        XLA program (replaces the eager chain in SURVEY.md §3.3).
+
+        Gradient accumulation uses an in-state buffer: the optimizer applies
+        every `gradient_accumulation_steps` calls (micro-step counter lives in
+        the state; XLA `cond` gates the apply), so the Python loop stays a
+        flat `for batch: state, m = step(state, batch)`.
+        """
+        k = self.gradient_accumulation_steps
+        dtype = self.compute_dtype
+        max_grad_norm = (
+            max_grad_norm if max_grad_norm is not None else self.gradient_clipping
+        )
+        use_scale = self.state.mixed_precision == PrecisionType.FP16
+
+        def step_fn(state: TrainState, *batch):
+            if use_scale and state.loss_scale is None:
+                raise ValueError(
+                    "fp16 mixed precision needs a loss scale: create the state "
+                    "with TrainState.create(use_loss_scale=True) or run it "
+                    "through accelerator.prepare()."
+                )
+            if k > 1 and state.grad_accum is None:
+                raise ValueError(
+                    "gradient_accumulation_steps>1 needs TrainState.create("
+                    "use_grad_accum_buffer=True)"
+                )
+
+            def compute_loss(params):
+                out = loss_fn(cast_floating(params, dtype), *batch)
+                loss = out[0] if has_aux else out
+                aux = out[1] if has_aux else None
+                scaled = loss * state.loss_scale.scale if use_scale else loss
+                return scaled, (loss, aux)
+
+            grads, (loss, aux) = jax.grad(compute_loss, has_aux=True)(state.params)
+            if use_scale:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / state.loss_scale.scale, grads
+                )
+            finite = jnp.isfinite(optax.global_norm(grads)) if use_scale else jnp.bool_(True)
+
+            if k > 1:
+                # overflowed micro-batches must not poison the buffer: their
+                # contribution is zeroed (GradScaler-style skip per micro-step)
+                accum = jax.tree_util.tree_map(
+                    lambda a, g: a + jnp.where(finite, g, 0.0) / k,
+                    state.grad_accum,
+                    grads,
+                )
+                micro = state.step + 1
+                do_apply = micro % k == 0
+
+                def apply(st):
+                    g = accum
+                    if max_grad_norm is not None:
+                        g, _ = clip_by_global_norm(g, max_grad_norm)
+                    new = st.apply_gradients(g)
+                    return dataclasses.replace(
+                        new,
+                        grad_accum=jax.tree_util.tree_map(jnp.zeros_like, accum),
+                    )
+
+                def skip(st):
+                    return dataclasses.replace(
+                        st, step=st.step + 1, grad_accum=accum
+                    )
+
+                new_state = jax.lax.cond(do_apply, apply, skip, state)
+            else:
+                g = grads
+                if max_grad_norm is not None:
+                    g, _ = clip_by_global_norm(g, max_grad_norm)
+
+                def apply(st):
+                    return st.apply_gradients(g)
+
+                def skip(st):
+                    return dataclasses.replace(st, step=st.step + 1)
+
+                new_state = jax.lax.cond(finite, apply, skip, state)
+
+            if use_scale:
+                new_state = dataclasses.replace(
+                    new_state, loss_scale=state.loss_scale.update(finite)
+                )
+            metrics = {"loss": loss}
+            if has_aux:
+                metrics["aux"] = aux
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    def eval_step(self, eval_fn: Callable) -> Callable:
+        """Compile an inference/eval function with the precision policy."""
+        dtype = self.compute_dtype
+
+        def step_fn(params, *batch):
+            return eval_fn(cast_floating(params, dtype), *batch)
+
+        return jax.jit(step_fn)
+
+    # --------------------------------------------------------- collectives
+    def gather(self, tensor):
+        """ref :2299."""
+        return ops.gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """ref :2331-2403 — gather then drop the duplicated tail samples of
+        the final uneven batch (tracked by the dataloader's `remainder`)."""
+        try:
+            recursively = bool(jax.tree_util.tree_leaves(input_data)) and all(
+                isinstance(l, (jax.Array, np.ndarray))
+                for l in jax.tree_util.tree_leaves(input_data)
+            )
+        except Exception:
+            recursively = False
+        if use_gather_object or not recursively:
+            data = ops.gather_object(input_data)
+            flattened = [x for sub in data for x in (sub if isinstance(sub, list) else [sub])]
+            data = flattened
+        else:
+            data = self.gather(input_data)
+        remainder = self.gradient_state.remainder
+        if (
+            self.gradient_state.end_of_dataloader
+            and remainder is not None
+            and remainder > 0
+        ):
+            layout = self.gradient_state.tail_layout
+
+            def _truncate(x):
+                if not hasattr(x, "__getitem__"):
+                    return x
+                if layout is not None and hasattr(x, "shape"):
+                    hosts, padded, real = layout
+                    if x.shape[0] == hosts * padded:
+                        # gathered order is [host0: real+pad, host1: ...] —
+                        # keep each host block's real rows, drop its pads
+                        x = np.asarray(x)
+                        blocks = x.reshape((hosts, padded) + x.shape[1:])
+                        return blocks[:, :real].reshape((hosts * real,) + x.shape[1:])
+                return x[:remainder]
+
+            data = jax.tree_util.tree_map(_truncate, data) if recursively else data[:remainder]
+        return data
+
+    def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
+        """ref :2404."""
+        return ops.reduce(tensor, reduction, scale)
+
+    def pad_across_processes(self, tensor, dim: int = 0, pad_index: int = 0,
+                             pad_first: bool = False):
+        """ref :2440."""
+        return ops.pad_across_processes(tensor, dim, pad_index, pad_first)
+
+    def broadcast(self, tensor, from_process: int = 0):
+        return ops.broadcast(tensor, from_process)
+
+    # --------------------------------------------- early stop coordination
+    def set_trigger(self) -> None:
+        """ref :2127-2150."""
+        self.flag_tensor = np.asarray([1.0], dtype=np.float32)
+
+    def check_trigger(self) -> bool:
+        """ref :2152-2184 — true if ANY host set the trigger."""
+        local = self.flag_tensor if self.flag_tensor is not None else np.zeros(1, np.float32)
+        total = ops.reduce(local, "sum")
+        if float(np.asarray(total)[0]) >= 1:
+            self.flag_tensor = None
+            return True
+        return False
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches: bool | None = None):
+        """ref :1061-1146. GSPMD programs are globally scheduled, so uneven
+        inputs never deadlock; the loader's even_batches padding already
+        equalizes counts. Context kept for API parity."""
+        yield
+
+    # ----------------------------------------------------------- lifecycle
+    def free_memory(self, *objects):
+        """ref :3150. Drop prepared references + device caches."""
+        self._optimizers = []
+        self._schedulers = []
+        self._dataloaders = []
+        self._models = []
+        self._grad_fns = {}
+        self.step = 0
+        return release_memory(*objects)
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        """ref :2475 — no wrappers exist; returns the object unchanged."""
+        return model
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler=None):
+        """ref :3293 — precision is a compile-time policy here; context kept
+        for source compatibility."""
+        yield
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        """ref :3340."""
+        return skip_first_batches(dataloader, num_batches)
+
+    # ------------------------------------------------------------ trackers
+    def init_trackers(self, project_name: str, config: dict | None = None,
+                      init_kwargs: dict | None = None) -> None:
+        """ref :2533."""
+        from .tracking import filter_trackers
+
+        self.trackers = filter_trackers(
+            self.log_with, self.project_configuration.logging_dir, project_name,
+            init_kwargs or {},
+        )
+        if config is not None:
+            for tracker in self.trackers:
+                tracker.store_init_configuration(config)
+
+    def log(self, values: dict, step: int | None = None, log_kwargs: dict | None = None) -> None:
+        """ref :2609."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        """ref :2582."""
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"tracker {name} not initialized; call init_trackers first")
+
+    def end_training(self) -> None:
+        """ref :2653."""
+        for tracker in self.trackers:
+            tracker.finish()
+        self.wait_for_everyone()
+
+    # --------------------------------------------------------- checkpoints
+    def register_for_checkpointing(self, *objects) -> None:
+        """ref :3256. Objects must expose state_dict/load_state_dict."""
+        invalid = [o for o in objects if not (
+            hasattr(o, "state_dict") and hasattr(o, "load_state_dict")
+        )]
+        if invalid:
+            raise ValueError(
+                f"Objects {invalid} lack state_dict/load_state_dict and cannot be "
+                "registered for checkpointing"
+            )
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook: Callable):
+        from .hooks_registry import RemovableHandle
+
+        handle = RemovableHandle(self._save_model_state_pre_hook)
+        self._save_model_state_pre_hook[handle.id] = hook
+        return handle
+
+    def register_load_state_pre_hook(self, hook: Callable):
+        from .hooks_registry import RemovableHandle
+
+        handle = RemovableHandle(self._load_model_state_pre_hook)
+        self._load_model_state_pre_hook[handle.id] = hook
+        return handle
+
+    def save_state(self, output_dir: str | None = None, state: TrainState | None = None,
+                   **save_model_kwargs) -> str:
+        """ref :2830-2994 + checkpointing.py:51."""
+        from .checkpointing import save_accelerator_state
+
+        if output_dir is None:
+            output_dir = self._checkpoint_dir(new=True)
+        for hook in self._save_model_state_pre_hook.values():
+            hook(self._models, None, output_dir)
+        return save_accelerator_state(
+            output_dir,
+            train_states=[state] if state is not None else [],
+            optimizers=self._optimizers,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+            step=self.step,
+        )
+
+    def load_state(self, input_dir: str | None = None, state: TrainState | None = None,
+                   **load_model_kwargs):
+        """ref :2995-3127."""
+        from .checkpointing import load_accelerator_state
+
+        if input_dir is None:
+            input_dir = self._checkpoint_dir(new=False)
+        for hook in self._load_model_state_pre_hook.values():
+            hook(self._models, input_dir)
+        return load_accelerator_state(
+            input_dir,
+            train_states=[state] if state is not None else [],
+            optimizers=self._optimizers,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+        )
+
+    def _checkpoint_dir(self, new: bool) -> str:
+        from .utils.constants import CHECKPOINT_DIR_PREFIX
+
+        base = os.path.join(self.project_configuration.project_dir or ".", "checkpoints")
+        if not self.project_configuration.automatic_checkpoint_naming:
+            return base
+        os.makedirs(base, exist_ok=True)
+        existing = sorted(
+            int(d.rsplit("_", 1)[1])
+            for d in os.listdir(base)
+            if d.startswith(CHECKPOINT_DIR_PREFIX + "_")
+        )
+        if new:
+            idx = (existing[-1] + 1) if existing else 0
+            self.project_configuration.iteration = idx
+            limit = self.project_configuration.total_limit
+            if limit is not None and len(existing) + 1 > limit:
+                import shutil
+
+                for old in existing[: len(existing) + 1 - limit]:
+                    shutil.rmtree(
+                        os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{old}"),
+                        ignore_errors=True,
+                    )
+        else:
+            if not existing:
+                raise FileNotFoundError(f"no checkpoints under {base}")
+            idx = existing[-1]
+        return os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{idx}")
+
+    def save_model(self, params: Any, save_directory: str,
+                   max_shard_size: str | int = "10GB", safe_serialization: bool = True):
+        """ref :2691-2797 — portable safetensors export of a (possibly
+        sharded) param pytree."""
+        from .checkpointing import save_model as _save_model
+
+        return _save_model(params, save_directory, max_shard_size, safe_serialization)
+
+    def get_state_dict(self, model, unwrap: bool = True):
+        """ref :3200 — with GSPMD there are no flattened/offloaded wrappers;
+        gather shards to host for export."""
+        if isinstance(model, TrainState):
+            model = model.params
+        return jax.tree_util.tree_map(lambda x: np.asarray(ops._to_local(x)), model)

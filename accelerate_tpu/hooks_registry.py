@@ -1,0 +1,23 @@
+"""Removable hook handles (torch.utils.hooks.RemovableHandle analogue, used
+by Accelerator.register_*_pre_hook — ref accelerator.py:2798,2964)."""
+
+from __future__ import annotations
+
+import itertools
+
+_counter = itertools.count()
+
+
+class RemovableHandle:
+    def __init__(self, hooks_dict: dict):
+        self.hooks_dict = hooks_dict
+        self.id = next(_counter)
+
+    def remove(self) -> None:
+        self.hooks_dict.pop(self.id, None)
+
+    def __enter__(self) -> "RemovableHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.remove()

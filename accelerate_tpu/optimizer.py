@@ -1,0 +1,156 @@
+"""Optimizer wrapper.
+
+TPU-native analogue of ref src/accelerate/optimizer.py (214 LoC). The
+reference wraps a stateful torch optimizer and *skips* `step()` during
+gradient accumulation (ref optimizer.py:153), runs the AMP scaler's
+overflow-skip logic (:155-168), and on XLA all-reduces fetched grads
+(:140-146). Here the optimizer is an optax `GradientTransformation` — pure
+functions over pytrees — and gradients arrive already globally averaged
+(GSPMD inserts the reductions), so what remains is:
+
+- owning the (sharded) `opt_state` and the accumulation buffer,
+- the accumulate-then-apply step gate,
+- fp16 overflow skipping (`is_overflow`, ref optimizer.py:192),
+- device placement of loaded state (ref :28-35).
+
+`AcceleratedOptimizer` is the *eager-parity* facade for reference-style
+loops; the fused `Accelerator.train_step` path folds the same update into
+one compiled program and does not use this class's Python-side gate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .state import AcceleratorState, GradientState
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accumulate(buffer, grads, scale):
+    return jax.tree_util.tree_map(lambda b, g: b + g * scale, buffer, grads)
+
+
+def _zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+class AcceleratedOptimizer:
+    """Stateful facade over an optax transformation.
+
+    Usage (reference-style loop):
+        optimizer = accelerator.prepare(optax.adamw(1e-3), params=params)
+        ...
+        loss, grads = accelerator.compute_gradients(loss_fn, optimizer.params, batch)
+        accelerator.backward(grads)        # accumulates
+        optimizer.step()                   # no-op unless sync boundary
+        optimizer.zero_grad()
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        params: Any = None,
+        opt_state: Any = None,
+        param_sharding: Any = None,
+        opt_sharding: Any = None,
+    ):
+        self.tx = tx
+        self.gradient_state = GradientState()
+        self.params = params
+        self.param_sharding = param_sharding
+        self.opt_sharding = opt_sharding
+        if opt_state is None and params is not None:
+            opt_state = tx.init(params)
+            if opt_sharding is not None:
+                opt_state = jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(x, s), opt_state, opt_sharding
+                )
+        self.opt_state = opt_state
+        self._grad_buffer = None
+        self._accum_count = 0
+        self._overflow = False
+        self._apply = None  # jitted (params, opt_state, grads) -> (params, opt_state)
+
+    # -- gradient buffer (torch `.grad` analogue) ---------------------------
+    def accumulate_grads(self, grads: Any, scale: float = 1.0) -> None:
+        if self._grad_buffer is None:
+            self._grad_buffer = _zeros_like(grads)
+        self._grad_buffer = _accumulate(self._grad_buffer, grads, scale)
+        self._accum_count += 1
+
+    @property
+    def gradients(self) -> Any:
+        return self._grad_buffer
+
+    @gradients.setter
+    def gradients(self, value: Any) -> None:
+        self._grad_buffer = value
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        """ref optimizer.py:119 — drop the accumulation buffer."""
+        self._grad_buffer = None
+        self._accum_count = 0
+
+    # -- step ----------------------------------------------------------------
+    def _build_apply(self):
+        @jax.jit
+        def apply(params, opt_state, grads):
+            updates, new_opt_state = self.tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt_state
+
+        return apply
+
+    def step(self, grads: Any = None) -> None:
+        """Apply the update unless we're mid-accumulation
+        (ref optimizer.py:136-168)."""
+        if not self.gradient_state.sync_gradients:
+            return  # accumulating: skip, like DDP no_sync (ref :153)
+        if grads is None:
+            grads = self._grad_buffer
+        if grads is None:
+            raise ValueError(
+                "No gradients: call accelerator.backward(grads) first or pass "
+                "grads to step()."
+            )
+        if self._check_overflow(grads):
+            self._overflow = True
+            return  # fp16 scaler overflow: skip step (ref :155-168)
+        self._overflow = False
+        if self._apply is None:
+            self._apply = self._build_apply()
+        self.params, self.opt_state = self._apply(self.params, self.opt_state, grads)
+
+    def _check_overflow(self, grads) -> bool:
+        state = AcceleratorState() if AcceleratorState._shared_state else None
+        if state is None or state.mixed_precision != "fp16":
+            return False
+        norm = optax.global_norm(grads)
+        return not bool(jnp.isfinite(norm))
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """ref optimizer.py:192 `is_overflow`/`step_was_skipped`."""
+        return self._overflow
+
+    # -- state_dict parity ---------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"opt_state": self.opt_state}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        opt_state = state_dict["opt_state"]
+        if self.opt_sharding is not None:
+            opt_state = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, s), opt_state, self.opt_sharding
+            )
+        # keep pytree structure of the existing state (loaded dicts may be raw)
+        if self.opt_state is not None:
+            flat = jax.tree_util.tree_leaves(opt_state)
+            treedef = jax.tree_util.tree_structure(self.opt_state)
+            self.opt_state = jax.tree_util.tree_unflatten(treedef, flat)
+        else:
+            self.opt_state = opt_state

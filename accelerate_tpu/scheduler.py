@@ -1,0 +1,71 @@
+"""LR scheduler wrapper.
+
+TPU-native analogue of ref src/accelerate/scheduler.py (98 LoC). In optax the
+idiomatic path embeds a schedule *inside* the transformation
+(`optax.scale_by_schedule` / injected hyperparams), stepped by the update
+count — nothing to wrap. `AcceleratedScheduler` exists for reference-style
+loops that step an explicit scheduler object:
+
+- steps only when the optimizer actually stepped (not during accumulation /
+  fp16 overflow skip — ref scheduler.py:54-69)
+- multiplies steps by the batch-sharding degree when `split_batches=False`
+  so per-sample schedules see the true global progress (ref :70-83)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from .optimizer import AcceleratedOptimizer
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        schedule: Callable[[int], float],
+        optimizers: list[AcceleratedOptimizer] | AcceleratedOptimizer,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.schedule = schedule
+        self.optimizers = (
+            optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        )
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+        self.count = 0
+        self._last_lr = float(schedule(0))
+
+    def step(self) -> None:
+        if not self.step_with_optimizer:
+            self.count += 1
+            self._last_lr = float(self.schedule(self.count))
+            return
+        if not self.gradient_state.sync_gradients:
+            return  # optimizer skipped: scheduler skips too (ref :54)
+        if any(opt.step_was_skipped for opt in self.optimizers):
+            return  # fp16 overflow skip (ref :62-69)
+        if self.split_batches:
+            increment = 1
+        else:
+            # one scheduler tick per shard of the global batch (ref :70-83)
+            state = AcceleratorState() if AcceleratorState._shared_state else None
+            increment = state.dp_size if state is not None else 1
+        self.count += increment
+        self._last_lr = float(self.schedule(self.count))
+
+    def get_last_lr(self) -> list[float]:
+        return [self._last_lr]
+
+    @property
+    def last_lr(self) -> float:
+        return self._last_lr
+
+    def state_dict(self) -> dict:
+        return {"count": self.count, "last_lr": self._last_lr}
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self.count = int(state_dict["count"])
+        self._last_lr = float(state_dict["last_lr"])

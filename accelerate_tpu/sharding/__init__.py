@@ -1,0 +1,11 @@
+from .planner import (
+    auto_fsdp_spec,
+    batch_sharding,
+    batch_spec,
+    constrain,
+    describe_plan,
+    plan_optimizer_sharding,
+    plan_sharding,
+    shard_pytree,
+)
+from .rules import ShardingRule, ShardingRules, transformer_rules

@@ -1,0 +1,206 @@
+"""Sharding planner: param pytree + mesh + rules -> NamedSharding plan.
+
+This single component is the TPU re-target of the reference's entire
+parallelism-wrapper layer (SURVEY.md §7 step 6):
+
+- ZeRO-3 / FSDP FULL_SHARD  -> params sharded on the `fsdp` axis
+- ZeRO-1/2 / SHARD_GRAD_OP  -> only optimizer state sharded (params replicated)
+- Megatron TP               -> `model`-axis entries in the rule templates
+- MoE expert parallel       -> `expert`-axis entries
+- DDP                       -> no axes present; everything replicates
+
+Where the reference wraps modules (`FSDP(module)` ref accelerator.py:1431,
+`deepspeed.initialize` :1751), we emit `jax.sharding.NamedSharding` per leaf
+and let GSPMD insert the collectives.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils.constants import AXIS_FSDP, BATCH_AXES
+from .rules import ShardingRules, SpecTemplate, transformer_rules
+
+logger = logging.getLogger(__name__)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _axis_sizes(mesh: Mesh) -> dict[str, int]:
+    return dict(mesh.shape)
+
+
+def _prune_template(template: SpecTemplate, shape: tuple, mesh: Mesh) -> PartitionSpec:
+    """Fit a spec template to a concrete shape on a concrete mesh: drop axes
+    that aren't in the mesh, are size 1, or don't divide the dim. Templates
+    shorter than the rank align to the *trailing* dims (leading batch/expert
+    dims handled by explicit longer templates)."""
+    sizes = _axis_sizes(mesh)
+    rank = len(shape)
+    entries: list = [None] * rank
+    template = tuple(template)[:rank] if len(template) > rank else tuple(template)
+    offset = rank - len(template)
+    used: set[str] = set()
+    for i, entry in enumerate(template):
+        dim = offset + i
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        kept = []
+        group = 1
+        for a in axes:
+            if a in used or sizes.get(a, 1) == 1:
+                continue
+            if shape[dim] % (group * sizes[a]) != 0:
+                continue
+            kept.append(a)
+            group *= sizes[a]
+        for a in kept:
+            used.add(a)
+        if kept:
+            entries[dim] = tuple(kept) if len(kept) > 1 else kept[0]
+    return PartitionSpec(*entries)
+
+
+def auto_fsdp_spec(shape: tuple, mesh: Mesh, axis: str = AXIS_FSDP) -> PartitionSpec:
+    """ZeRO-style auto rule: shard the largest dim divisible by the fsdp axis
+    (prefers later dims on ties — usually the output/feature dim)."""
+    size = _axis_sizes(mesh).get(axis, 1)
+    if size == 1 or not shape:
+        return PartitionSpec()
+    best_dim, best = -1, 0
+    for dim, n in enumerate(shape):
+        if n % size == 0 and n >= best:
+            best, best_dim = n, dim
+    if best_dim < 0:
+        return PartitionSpec()
+    entries = [None] * len(shape)
+    entries[best_dim] = axis
+    return PartitionSpec(*entries)
+
+
+def plan_sharding(
+    params: Any,
+    mesh: Mesh,
+    rules: ShardingRules | None = None,
+    shard_params: bool = True,
+) -> Any:
+    """Return a pytree of `NamedSharding` matching `params` (arrays or
+    ShapeDtypeStructs — pass `jax.eval_shape` output to plan without
+    materializing, the meta-device trick of ref big_modeling.py:56-166).
+
+    `shard_params=False` replicates parameters (ZeRO-1/2: only the optimizer
+    state adopts the sharded plan — see `plan_optimizer_sharding`).
+    """
+    rules = rules if rules is not None else transformer_rules()
+
+    def _plan(path, leaf):
+        shape = tuple(leaf.shape)
+        if not shard_params:
+            return NamedSharding(mesh, PartitionSpec())
+        nelems = int(np.prod(shape)) if shape else 1
+        if nelems < rules.min_weight_size:
+            return NamedSharding(mesh, PartitionSpec())
+        template = rules.find(_path_str(path))
+        if template is not None:
+            spec = _prune_template(template, shape, mesh)
+        elif rules.default_fsdp:
+            spec = auto_fsdp_spec(shape, mesh)
+        else:
+            spec = PartitionSpec()
+        # fall back to auto-fsdp if a matched rule pruned to fully-replicated
+        if (
+            template is not None
+            and len(template) > 0
+            and spec == PartitionSpec(*([None] * len(shape)))
+            and rules.default_fsdp
+        ):
+            spec = auto_fsdp_spec(shape, mesh)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(_plan, params)
+
+
+def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Mesh) -> Any:
+    """Shard optimizer state like its params (ZeRO-1/2/3 optimizer-state
+    sharding, ref DeepSpeed engine).
+
+    Uses `optax.tree_map_params` so param-shaped leaves (e.g. Adam mu/nu)
+    adopt the param's sharding while step counters replicate.
+    """
+    import optax
+
+    replicated = NamedSharding(mesh, PartitionSpec())
+    try:
+        mapped = optax.tree_map_params(
+            optimizer,
+            lambda _, sharding: sharding,
+            opt_state,
+            param_plan,
+            transform_non_params=lambda _: replicated,
+        )
+        return mapped
+    except Exception:
+        # fallback: shape-match each leaf against nothing -> replicate
+        logger.warning("optax.tree_map_params failed; replicating optimizer state")
+        return jax.tree_util.tree_map(lambda _: replicated, opt_state)
+
+
+def batch_spec(mesh: Mesh, batch_axes=BATCH_AXES, extra_dims: int = 0) -> PartitionSpec:
+    """PartitionSpec for a batch: leading dim over the data-like axes."""
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+    return PartitionSpec(lead, *([None] * extra_dims))
+
+
+def batch_sharding(mesh: Mesh, batch_axes=BATCH_AXES) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec(mesh, batch_axes))
+
+
+def shard_pytree(tree: Any, plan: Any) -> Any:
+    """Place/reshard a pytree according to a plan (device_put handles both
+    host arrays and resharding of existing jax.Arrays)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s) if hasattr(x, "shape") else x, tree, plan
+    )
+
+
+def constrain(tree: Any, mesh: Mesh, spec: PartitionSpec) -> Any:
+    """In-jit sharding constraint helper (GSPMD activation hints — how SP
+    falls out for free, SURVEY.md §2.2 row SP)."""
+    import jax.numpy as jnp  # noqa: F401
+    from jax.lax import with_sharding_constraint
+
+    return jax.tree_util.tree_map(
+        lambda x: with_sharding_constraint(x, NamedSharding(mesh, spec)), tree
+    )
+
+
+def describe_plan(plan: Any, max_rows: int = 120) -> str:
+    """Human-readable sharding table (debug aid; no reference equivalent)."""
+    rows = []
+    for path, sharding in jax.tree_util.tree_leaves_with_path(
+        plan, is_leaf=lambda x: isinstance(x, NamedSharding)
+    ):
+        rows.append(f"  {_path_str(path):60s} {sharding.spec}")
+        if len(rows) >= max_rows:
+            rows.append("  ...")
+            break
+    return "\n".join(rows)

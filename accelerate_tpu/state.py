@@ -444,6 +444,14 @@ class GradientState:
         return getattr(self.active_dataloader, "remainder", -1)
 
     @property
+    def tail_layout(self):
+        """(num_hosts, padded_per_host, real_per_host) of the final uneven
+        batch, or None — lets gather_for_metrics drop pads per host block."""
+        if not self.in_dataloader:
+            return None
+        return getattr(self.active_dataloader, "tail_layout", None)
+
+    @property
     def in_dataloader(self) -> bool:
         return self.active_dataloader is not None
 

@@ -1,0 +1,398 @@
+"""Experiment trackers.
+
+TPU-native analogue of ref src/accelerate/tracking.py (1023 LoC): a
+`GeneralTracker` ABC with `@on_main_process`-gated methods and concrete
+backends gated on availability (ref :91-163, selection `filter_trackers`
+:971). The reference ships 8 backends (TensorBoard/WandB/Comet/Aim/MLflow/
+ClearML/DVCLive); here the always-available native backend is `JSONLTracker`
+(dependency-free, one JSON line per log call), with TensorBoard/WandB/MLflow/
+Comet/Aim/ClearML wired when their packages exist.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+from typing import Any
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.dataclasses import LoggerType
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+
+def on_main_process(function):
+    """ref tracking.py:67-84."""
+
+    @functools.wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """ref tracking.py:91. Subclass with `name`, `requires_logging_directory`,
+    and implement `store_init_configuration` / `log`."""
+
+    name: str = "generic"
+    requires_logging_directory: bool = False
+    main_process_only: bool = True
+
+    def __init__(self, run_name: str | None = None, **kwargs: Any):
+        self.run_name = run_name
+
+    @property
+    def tracker(self):
+        return None
+
+    def store_init_configuration(self, values: dict) -> None:
+        raise NotImplementedError
+
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        raise NotImplementedError
+
+    def log_images(self, values: dict, step: int | None = None, **kwargs) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Native dependency-free tracker: one JSON object per line. No reference
+    equivalent — our always-on default so `log_with="all"` works hermetically."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs):
+        super().__init__(run_name)
+        logging_dir = logging_dir or "."
+        os.makedirs(os.path.join(logging_dir, run_name), exist_ok=True)
+        self.path = os.path.join(logging_dir, run_name, "metrics.jsonl")
+        self._fh = open(self.path, "a")
+
+    @property
+    def tracker(self):
+        return self._fh
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self._write({"event": "config", "config": _jsonable(values)})
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        self._write({"event": "log", "step": step, "ts": time.time(), **_jsonable(values)})
+
+    def _write(self, obj: dict) -> None:
+        self._fh.write(json.dumps(obj) + "\n")
+        self._fh.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self._fh.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """ref tracking.py:165."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs):
+        super().__init__(run_name)
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+
+        self.logging_dir = os.path.join(logging_dir or ".", run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.add_hparams(_flatten_scalars(values), metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """ref tracking.py:276."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """ref tracking.py:579."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs):
+        super().__init__(run_name)
+        import mlflow
+
+        mlflow.set_experiment(run_name)
+        self.run = mlflow.start_run(**kwargs)
+        self._mlflow = mlflow
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        for k, v in _flatten_scalars(values).items():
+            self._mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        self._mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self) -> None:
+        self._mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    """ref tracking.py:399."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        from comet_ml import Experiment
+
+        self.writer = Experiment(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    """ref tracking.py:480."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs):
+        super().__init__(run_name)
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    """ref tracking.py:724."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__(run_name)
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        logger_obj = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                logger_obj.report_scalar(title=k, series=k, value=v, iteration=step or 0)
+
+    @on_main_process
+    def finish(self) -> None:
+        self.task.close()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+}
+
+_AVAILABILITY = {
+    "jsonl": lambda: True,
+    "tensorboard": is_tensorboard_available,
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+}
+
+
+def filter_trackers(
+    log_with: list,
+    logging_dir: str | None = None,
+    run_name: str = "accelerate_tpu",
+    init_kwargs: dict | None = None,
+) -> list[GeneralTracker]:
+    """ref tracking.py:971. Resolves names/'all'/instances into live trackers,
+    skipping unavailable backends with a warning."""
+    init_kwargs = init_kwargs or {}
+    names: list = []
+    for entry in log_with or []:
+        if isinstance(entry, GeneralTracker):
+            names.append(entry)
+        else:
+            value = str(LoggerType(str(entry).lower()) if not isinstance(entry, LoggerType) else entry)
+            if value == "all":
+                names.extend(n for n in LOGGER_TYPE_TO_CLASS if _AVAILABILITY[n]())
+            else:
+                names.append(value)
+    trackers: list[GeneralTracker] = []
+    seen = set()
+    for entry in names:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+            continue
+        if entry in seen:
+            continue
+        seen.add(entry)
+        cls = LOGGER_TYPE_TO_CLASS.get(entry)
+        if cls is None or not _AVAILABILITY[entry]():
+            logger.warning(f"Tracker {entry} unavailable; skipping")
+            continue
+        kwargs = dict(init_kwargs.get(entry, {}))
+        if cls.requires_logging_directory:
+            kwargs.setdefault("logging_dir", logging_dir)
+        trackers.append(cls(run_name, **kwargs))
+    return trackers
+
+
+def _jsonable(tree: Any) -> Any:
+    if isinstance(tree, dict):
+        return {k: _jsonable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_jsonable(v) for v in tree]
+    if hasattr(tree, "item") and getattr(tree, "ndim", 1) == 0:
+        return tree.item()
+    if isinstance(tree, (int, float, str, bool, type(None))):
+        return tree
+    return str(tree)
+
+
+def _flatten_scalars(values: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in values.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten_scalars(v, key))
+        elif isinstance(v, (int, float, str, bool)):
+            out[key] = v
+        else:
+            out[key] = str(v)
+    return out

@@ -1,0 +1,124 @@
+"""TrainState: the unit of training the fused path operates on.
+
+The reference's `prepare()` returns wrapped *objects* (DDP module, optimizer,
+scheduler) that coordinate eagerly per step (SURVEY.md §3.3). TPU-natively the
+unit is one pytree carrying (params, opt_state, step, accumulation buffer,
+loss scale) so the whole update — forward, backward, accumulate, clip,
+optimizer, schedule — compiles into a single donated XLA program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DynamicLossScale:
+    """fp16 dynamic loss scaling — replaces torch.cuda.amp.GradScaler
+    (ref accelerator.py:455-479). bf16 never needs it; kept for fp16 parity."""
+
+    scale: jax.Array
+    growth_tracker: jax.Array
+    growth_interval: int = dataclasses.field(default=2000, metadata={"static": True})
+    growth_factor: float = dataclasses.field(default=2.0, metadata={"static": True})
+    backoff_factor: float = dataclasses.field(default=0.5, metadata={"static": True})
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**16) -> "DynamicLossScale":
+        return cls(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+        )
+
+    def update(self, grads_finite: jax.Array) -> "DynamicLossScale":
+        tracker = jnp.where(grads_finite, self.growth_tracker + 1, 0)
+        grow = tracker >= self.growth_interval
+        scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.scale * self.growth_factor, self.scale),
+            self.scale * self.backoff_factor,
+        )
+        return dataclasses.replace(
+            self, scale=scale, growth_tracker=jnp.where(grow, 0, tracker)
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    """Params + optimizer state + accumulation state as one pytree.
+
+    `apply_fn`/`tx` are static (not traced). `grad_accum` exists only when
+    gradient accumulation is driven per-micro-batch (the eager-compatible
+    fused step); the scan-fused step needs no buffer.
+    """
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    grad_accum: Any
+    loss_scale: DynamicLossScale | None
+    apply_fn: Callable = dataclasses.field(metadata={"static": True})
+    tx: optax.GradientTransformation = dataclasses.field(metadata={"static": True})
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        apply_fn: Callable,
+        params: Any,
+        tx: optax.GradientTransformation,
+        use_grad_accum_buffer: bool = False,
+        use_loss_scale: bool = False,
+    ) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            grad_accum=(
+                jax.tree_util.tree_map(jnp.zeros_like, params)
+                if use_grad_accum_buffer
+                else None
+            ),
+            loss_scale=DynamicLossScale.create() if use_loss_scale else None,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads: Any) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        return dataclasses.replace(
+            self,
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt_state,
+        )
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast floating leaves (the bf16 compute policy: fp32 master params cast
+    at trace time — replaces torch autocast, ref accelerator.py:1356-1365)."""
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def global_norm(tree: Any) -> jax.Array:
+    return optax.global_norm(tree)
+
+
+def clip_by_global_norm(tree: Any, max_norm: float) -> tuple[Any, jax.Array]:
+    """Returns (clipped, pre-clip norm) — matches torch
+    clip_grad_norm_'s return (ref accelerator.py:2221)."""
+    norm = optax.global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor, tree), norm

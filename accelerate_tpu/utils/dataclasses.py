@@ -300,6 +300,10 @@ class ProjectConfiguration(KwargsHandler):
     iteration: int = 0
     save_on_each_node: bool = False
 
+    def __post_init__(self) -> None:
+        if self.logging_dir is None:
+            self.logging_dir = self.project_dir
+
     def set_directories(self, project_dir: str | None = None) -> None:
         self.project_dir = project_dir
         if self.logging_dir is None:

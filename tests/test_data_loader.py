@@ -1,0 +1,247 @@
+"""Data layer tests (ref tests/test_data_loader.py, 529 LoC; same scenarios
+re-expressed for the host-shard + global-array design)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import GradientState, PartialState
+from accelerate_tpu.data import (
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SkipDataLoader,
+    make_global_batch,
+    pad_batch_to,
+    prepare_data_loader,
+    skip_first_batches,
+)
+
+
+class SimpleBatchSampler:
+    def __init__(self, n, batch_size, drop_last=False):
+        self.indices = list(range(n))
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for i in self.indices:
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return len(self.indices) // self.batch_size
+        return -(-len(self.indices) // self.batch_size)
+
+
+def make_batches(n, batch_size):
+    """Iterable of dict batches over arange data."""
+    data = np.arange(n)
+    for i in range(0, n, batch_size):
+        chunk = data[i : i + batch_size]
+        yield {"x": chunk.reshape(-1, 1).astype(np.float32), "y": chunk.astype(np.int32)}
+
+
+# --- samplers ---------------------------------------------------------------
+
+
+def test_seedable_sampler_deterministic_and_epoch_varying():
+    s = SeedableRandomSampler(10, seed=3)
+    first = list(s)
+    assert sorted(first) == list(range(10))
+    assert list(s) == first  # same epoch -> same order
+    s.set_epoch(1)
+    assert list(s) != first
+
+
+def test_batch_sampler_shard_stride_even():
+    # 8 batches over 2 shards -> 4 each, disjoint, strided
+    base = SimpleBatchSampler(16, 2)
+    shards = [
+        list(BatchSamplerShard(base, num_processes=2, process_index=i)) for i in range(2)
+    ]
+    assert len(shards[0]) == len(shards[1]) == 4
+    assert shards[0][0] == [0, 1] and shards[1][0] == [2, 3]
+    seen = sorted(i for shard in shards for b in shard for i in b)
+    assert seen == list(range(16))
+
+
+def test_batch_sampler_shard_uneven_wraparound():
+    # 5 batches of 2 over 2 shards: tail batch -> shard0 real, shard1 recycled
+    base = SimpleBatchSampler(10, 2)
+    s0 = list(BatchSamplerShard(base, num_processes=2, process_index=0))
+    s1 = list(BatchSamplerShard(base, num_processes=2, process_index=1))
+    assert len(s0) == len(s1) == 3
+    assert all(len(b) == 2 for b in s0 + s1)
+    assert s0[-1] == [8, 9]
+    assert all(i < 4 for i in s1[-1])  # recycled from the initial batches
+
+
+def test_batch_sampler_shard_uneven_no_even_batches():
+    base = SimpleBatchSampler(10, 2)
+    s0 = list(BatchSamplerShard(base, 2, 0, even_batches=False))
+    s1 = list(BatchSamplerShard(base, 2, 1, even_batches=False))
+    assert len(s0) == 3 and len(s1) == 2
+
+
+def test_batch_sampler_shard_split_batches():
+    base = SimpleBatchSampler(16, 4)
+    s0 = list(BatchSamplerShard(base, 2, 0, split_batches=True))
+    s1 = list(BatchSamplerShard(base, 2, 1, split_batches=True))
+    assert len(s0) == len(s1) == 4
+    assert s0[0] == [0, 1] and s1[0] == [2, 3]
+    with pytest.raises(ValueError):
+        list(BatchSamplerShard(SimpleBatchSampler(9, 3), 2, 0, split_batches=True))
+    # lazy validation must not consume batches from one-shot iterators
+    gen = iter(SimpleBatchSampler(16, 4))
+    shard = BatchSamplerShard(gen, 2, 0, split_batches=True)
+    assert list(shard)[0] == [0, 1]
+
+
+def test_iterable_dataset_shard():
+    shards = [
+        list(IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=i))
+        for i in range(2)
+    ]
+    # buffers of 4: [0..3] -> p0 gets 0,1 p1 gets 2,3; [4..7] -> 4,5 / 6,7;
+    # tail [8,9] padded with first-loop items [0,1]
+    assert shards[0] == [0, 1, 4, 5, 8, 9]
+    assert shards[1] == [2, 3, 6, 7, 0, 1]
+
+
+def test_iterable_dataset_shard_drop_last():
+    out = list(
+        IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=0, drop_last=True)
+    )
+    assert out == [0, 1, 4, 5]
+
+
+# --- global assembly --------------------------------------------------------
+
+
+def test_make_global_batch_shards_over_data_axis():
+    batch = {"x": np.arange(16.0).reshape(16, 1)}
+    out = make_global_batch(batch)
+    arr = out["x"]
+    assert isinstance(arr, jax.Array)
+    assert arr.shape == (16, 1)
+    assert len(arr.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(arr), batch["x"])
+
+
+def test_make_global_batch_replicates_indivisible():
+    out = make_global_batch({"x": np.ones((3, 2)), "s": np.float32(2.0)})
+    assert out["x"].sharding.is_fully_replicated
+    assert out["s"].sharding.is_fully_replicated
+
+
+def test_pad_batch_to_wraparound():
+    out = pad_batch_to({"x": np.arange(3)}, 8)
+    np.testing.assert_array_equal(out["x"], [0, 1, 2, 0, 1, 2, 0, 1])
+
+
+# --- loaders ----------------------------------------------------------------
+
+
+def test_dataloader_shard_end_detection_and_gradient_state():
+    gs = GradientState()
+    loader = DataLoaderShard(list(make_batches(32, 8)))
+    ends = []
+    for batch in loader:
+        assert isinstance(batch["x"], jax.Array)
+        ends.append(gs.end_of_dataloader)
+    assert ends == [False, False, False, True]
+    assert not gs.in_dataloader  # unregistered after epoch
+
+
+def test_dataloader_shard_uneven_final_batch_padded():
+    loader = DataLoaderShard(list(make_batches(20, 8)))  # final batch of 4
+    batches = list(loader)
+    assert batches[-1]["x"].shape[0] == 8  # padded to divisible
+    assert loader.remainder == 4
+
+
+def test_dataloader_shard_epoch_advances():
+    class EpochAware:
+        epoch = None
+
+        def __init__(self):
+            self.batches = list(make_batches(8, 4))
+
+        def set_epoch(self, e):
+            EpochAware.epoch = e
+
+        def __iter__(self):
+            return iter(self.batches)
+
+        def __len__(self):
+            return len(self.batches)
+
+    src = EpochAware()
+    loader = DataLoaderShard(src)
+    list(loader)
+    assert EpochAware.epoch == 1  # advanced for next epoch
+
+
+def test_dataloader_dispatcher_single_host():
+    loader = DataLoaderDispatcher(list(make_batches(16, 4)))
+    batches = list(loader)
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(b["y"]) for b in batches]), np.arange(16)
+    )
+
+
+def test_skip_first_batches():
+    loader = DataLoaderShard(list(make_batches(32, 8)))
+    skipped = skip_first_batches(loader, 2)
+    batches = list(skipped)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(np.asarray(batches[0]["y"]), np.arange(16, 24))
+    # original loader unaffected
+    assert len(list(loader)) == 4
+
+
+def test_skip_dataloader_plain():
+    out = list(SkipDataLoader(list(range(5)), 3))
+    assert out == [3, 4]
+
+
+def test_prepare_data_loader_plain_iterable():
+    loader = prepare_data_loader(list(make_batches(16, 4)))
+    assert isinstance(loader, DataLoaderShard)
+    assert len(list(loader)) == 4
+
+
+def test_prepare_data_loader_dispatch_mode():
+    loader = prepare_data_loader(list(make_batches(16, 4)), dispatch_batches=True)
+    assert isinstance(loader, DataLoaderDispatcher)
+    assert len(list(loader)) == 4
+
+
+def test_prepare_torch_loader_resharded():
+    torch = pytest.importorskip("torch")
+    import torch.utils.data as tud
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    dl = tud.DataLoader(DS(), batch_size=2, shuffle=False)
+    out = prepare_data_loader(dl, num_processes=2, process_index=0, put_on_device=False)
+    batches = list(out)
+    assert len(batches) == 4  # 8 batches strided over 2 hosts
+    np.testing.assert_array_equal(np.asarray(batches[0]["x"]), [0.0, 1.0])
+    np.testing.assert_array_equal(np.asarray(batches[1]["x"]), [4.0, 5.0])

@@ -1,0 +1,129 @@
+"""Sharding planner tests — the FSDP/TP/MoE plugin re-target (SURVEY.md §7.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu.sharding import (
+    auto_fsdp_spec,
+    batch_spec,
+    plan_optimizer_sharding,
+    plan_sharding,
+    shard_pytree,
+    transformer_rules,
+)
+from accelerate_tpu.utils import MeshConfig
+
+
+def make_params():
+    return {
+        "embed_tokens": {"embedding": jnp.zeros((256, 64))},
+        "layers": {
+            "attn": {
+                "q_proj": {"kernel": jnp.zeros((64, 64))},
+                "o_proj": {"kernel": jnp.zeros((64, 64))},
+            },
+            "mlp": {
+                "up_proj": {"kernel": jnp.zeros((64, 256))},
+                "down_proj": {"kernel": jnp.zeros((256, 64))},
+            },
+            "norm": {"scale": jnp.ones((64,))},
+        },
+    }
+
+
+def test_fsdp_only_mesh_plan():
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    plan = plan_sharding(make_params(), mesh)
+    # column-parallel template prunes model axis (absent) -> fsdp on dim0
+    assert plan["layers"]["attn"]["q_proj"]["kernel"].spec == P("fsdp", None)
+    assert plan["layers"]["mlp"]["down_proj"]["kernel"].spec == P(None, "fsdp")
+    # small norm scale replicates (min_weight_size)
+    assert plan["layers"]["norm"]["scale"].spec == P()
+
+
+def test_tp_fsdp_mesh_plan():
+    mesh = MeshConfig(axes={"fsdp": 2, "model": 4}).build()
+    plan = plan_sharding(make_params(), mesh)
+    assert plan["layers"]["attn"]["q_proj"]["kernel"].spec == P("fsdp", "model")
+    assert plan["layers"]["attn"]["o_proj"]["kernel"].spec == P("model", "fsdp")
+    assert plan["embed_tokens"]["embedding"].spec == P("model", "fsdp")
+
+
+def test_replicated_plan_when_shard_params_false():
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    plan = plan_sharding(make_params(), mesh, shard_params=False)
+    specs = {s.spec for s in jax.tree_util.tree_leaves(plan)}
+    assert specs == {P()}
+
+
+def test_plan_from_eval_shape():
+    """Meta planning: works on ShapeDtypeStructs without materializing."""
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    shapes = jax.eval_shape(make_params)
+    plan = plan_sharding(shapes, mesh)
+    assert plan["layers"]["mlp"]["up_proj"]["kernel"].spec == P("fsdp", None)
+
+
+def test_auto_fsdp_spec_picks_divisible_dim():
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    assert auto_fsdp_spec((100, 64), mesh) == P(None, "fsdp")
+    assert auto_fsdp_spec((100, 30), mesh) == P()  # nothing divisible
+    assert auto_fsdp_spec((64, 128), mesh) == P(None, "fsdp")  # prefers larger/later
+
+
+def test_indivisible_tp_dim_falls_back():
+    mesh = MeshConfig(axes={"model": 8}).build()
+    params = {"attn": {"q_proj": {"kernel": jnp.zeros((64, 100))}}}  # 100 % 8 != 0
+    plan = plan_sharding(params, mesh)
+    # model axis dropped on dim1; auto-fsdp has no fsdp axis -> replicated
+    assert plan["attn"]["q_proj"]["kernel"].spec == P()
+
+
+def test_shard_pytree_places_arrays():
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    params = make_params()
+    plan = plan_sharding(params, mesh)
+    sharded = shard_pytree(params, plan)
+    q = sharded["layers"]["attn"]["q_proj"]["kernel"]
+    assert len(q.sharding.device_set) == 8
+    assert q.addressable_shards[0].data.shape == (8, 64)
+
+
+def test_optimizer_state_sharding_adam():
+    import optax
+
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    params = make_params()
+    plan = plan_sharding(params, mesh)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+    opt_plan = plan_optimizer_sharding(opt, opt_state, plan, mesh)
+    # mu/nu adopt the param plan
+    mu_q = opt_state[0].mu["layers"]["attn"]["q_proj"]["kernel"]
+    mu_plan_q = opt_plan[0].mu["layers"]["attn"]["q_proj"]["kernel"]
+    assert mu_plan_q.spec == P("fsdp", None)
+    assert mu_q.shape == (64, 64)
+    # count replicates
+    assert opt_plan[0].count.spec == P()
+    # and the plan is device_put-able
+    sharded = shard_pytree(opt_state, opt_plan)
+    assert len(sharded[0].mu["layers"]["attn"]["q_proj"]["kernel"].sharding.device_set) == 8
+
+
+def test_batch_spec():
+    mesh = MeshConfig(axes={"data": 2, "fsdp": 4}).build()
+    assert batch_spec(mesh) == P(("data", "fsdp"))
+    mesh2 = MeshConfig(axes={"data": 8}).build()
+    assert batch_spec(mesh2, extra_dims=1) == P("data", None)
+    mesh3 = MeshConfig(axes={"model": 8}).build()
+    assert batch_spec(mesh3) == P(None)
+
+
+def test_expert_rules():
+    mesh = MeshConfig(axes={"expert": 4, "model": 2}).build()
+    params = {"moe": {"experts": {"up_proj": {"kernel": jnp.zeros((4, 64, 128))}}}}
+    plan = plan_sharding(params, mesh)
+    assert plan["moe"]["experts"]["up_proj"]["kernel"].spec == P("expert", None, "model")
