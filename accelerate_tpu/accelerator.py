@@ -37,8 +37,6 @@ from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .scheduler import AcceleratedScheduler
 from .sharding import (
-    batch_sharding,
-    batch_spec,
     plan_optimizer_sharding,
     plan_sharding,
     shard_pytree,
@@ -492,17 +490,24 @@ class Accelerator:
             opt.accumulate_grads(grads, scale)
 
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2):
-        """ref :2221-2270. Clips each prepared optimizer's gradient buffer;
-        returns the pre-clip global norm."""
+        """ref :2221-2270. Clips all prepared optimizers' gradient buffers as
+        ONE group (matching torch's clip over the full parameter list) and
+        returns the joint pre-clip global norm. `parameters` is accepted for
+        signature parity but gradients live on the optimizer facades here."""
         if norm_type != 2:
             raise NotImplementedError("only L2 global-norm clipping is supported")
         if not self.sync_gradients:
             return None
-        norm = None
+        buffers = [o.gradients for o in self._optimizers if o.gradients is not None]
+        if not buffers:
+            return None
+        norm = optax.global_norm(buffers)
+        factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
         for opt in self._optimizers:
             if opt.gradients is not None:
-                clipped, norm = clip_by_global_norm(opt.gradients, max_norm)
-                opt.gradients = clipped
+                opt.gradients = jax.tree_util.tree_map(
+                    lambda g: g * factor, opt.gradients
+                )
         return norm
 
     def clip_grad_value_(self, parameters=None, clip_value: float = 1.0):
@@ -820,7 +825,7 @@ class Accelerator:
             input_dir = self._checkpoint_dir(new=False)
         for hook in self._load_model_state_pre_hook.values():
             hook(self._models, input_dir)
-        return load_accelerator_state(
+        result = load_accelerator_state(
             input_dir,
             train_states=[state] if state is not None else [],
             optimizers=self._optimizers,
@@ -828,6 +833,9 @@ class Accelerator:
             dataloaders=self._dataloaders,
             custom_objects=self._custom_objects,
         )
+        # resume the micro-step counter so accumulate() boundaries line up
+        self.step = int(result.get("step", 0))
+        return result
 
     def _checkpoint_dir(self, new: bool) -> str:
         from .utils.constants import CHECKPOINT_DIR_PREFIX

@@ -272,6 +272,14 @@ def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
         if not isinstance(x, np.ndarray):
             return x
         if x.ndim == 0 or (x.shape[0] * jax.process_count()) % dp != 0:
+            if x.ndim > 0 and jax.process_count() > 1:
+                # replicated sharding over divergent per-host data would build
+                # a silently inconsistent "global" array — refuse loudly
+                raise ValueError(
+                    f"leading dim {x.shape[0]} x {jax.process_count()} hosts is "
+                    f"not divisible by dp={dp}; pad the batch (see pad_batch_to) "
+                    "before make_global_batch on multi-host runs"
+                )
             spec = jax.sharding.PartitionSpec()
         else:
             spec = jax.sharding.PartitionSpec(axes if len(axes) > 1 else axes[0] if axes else None)
@@ -365,7 +373,6 @@ class DataLoaderShard(DataLoaderStateMixin):
         prefetch_size: int = 2,
         even_batches: bool = True,
         generator=None,
-        _drop_remainder: bool = False,
     ):
         self.loader = loader
         self.mesh = mesh
@@ -377,7 +384,6 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.generator = generator
         self.gradient_state = GradientState()
         self.epoch = 0
-        self._drop_remainder = _drop_remainder
 
     @property
     def total_batch_size(self) -> int | None:
@@ -409,7 +415,12 @@ class DataLoaderShard(DataLoaderStateMixin):
         per_host = self.dp_size // jax.process_count()
         remainder = -1
         tail_layout = None
-        if self.put_on_device and n is not None and n % per_host != 0:
+        if (
+            self.even_batches
+            and self.put_on_device
+            and n is not None
+            and n % per_host != 0
+        ):
             target = math.ceil(n / per_host) * per_host
             # SPMD keeps per-host shapes identical, so every host sees the
             # same (n, target): global real count is n * num_hosts, and after
@@ -426,21 +437,25 @@ class DataLoaderShard(DataLoaderStateMixin):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.generator)
         self.begin()
-        source = iter(self.loader)
-        prepared = _PrefetchIterator(source, self._prepare, self.prefetch_size)
-        current = next(prepared, _SENTINEL)
-        while current is not _SENTINEL:
-            nxt = next(prepared, _SENTINEL)
-            batch, remainder, tail_layout = current
-            if nxt is _SENTINEL:
-                self.end_of_dataloader = True
-                if remainder != -1:
-                    self.remainder = remainder
-                    self.tail_layout = tail_layout
-            yield batch
-            current = nxt
-        self.set_epoch(self.epoch + 1)
-        self.end()
+        try:
+            source = iter(self.loader)
+            prepared = _PrefetchIterator(source, self._prepare, self.prefetch_size)
+            current = next(prepared, _SENTINEL)
+            while current is not _SENTINEL:
+                nxt = next(prepared, _SENTINEL)
+                batch, remainder, tail_layout = current
+                if nxt is _SENTINEL:
+                    self.end_of_dataloader = True
+                    if remainder != -1:
+                        self.remainder = remainder
+                        self.tail_layout = tail_layout
+                yield batch
+                current = nxt
+            self.set_epoch(self.epoch + 1)
+        finally:
+            # breaking out early must still unregister from GradientState —
+            # a stale reference would corrupt accumulate() sync decisions
+            self.end()
 
     def __len__(self) -> int:
         return len(self.loader)  # type: ignore[arg-type]
@@ -497,25 +512,40 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
 
     def __iter__(self):
         self.begin()
-        source = iter(self.loader) if self.state.is_main_process else iter(())
-        current, stop = self._fetch_and_broadcast(source)
-        while not stop:
-            nxt, stop = self._fetch_and_broadcast(source)
-            # slice this host's shard of the global batch
-            n = find_batch_size(current)
-            per_host = max(n // self.state.num_processes, 1) if n else None
-            if per_host is not None and self.state.num_processes > 1:
-                start = self.state.process_index * per_host
-                local = slice_tensors(current, slice(start, start + per_host))
-            else:
-                local = current
-            if stop:
-                self.end_of_dataloader = True
-            if self.put_on_device:
-                local = make_global_batch(local, self.mesh, self.batch_axes)
-            yield local
-            current = nxt
-        self.end()
+        try:
+            source = iter(self.loader) if self.state.is_main_process else iter(())
+            current, stop = self._fetch_and_broadcast(source)
+            while not stop:
+                nxt, stop = self._fetch_and_broadcast(source)
+                n = find_batch_size(current)
+                P = self.state.num_processes
+                remainder = -1
+                if n is not None and n % P != 0:
+                    # pad to divisible (wraparound) instead of dropping tail
+                    # rows; real count recorded for gather_for_metrics —
+                    # dispatcher pads at the GLOBAL tail, so plain [:n]
+                    # truncation is correct (no per-host layout needed)
+                    target = math.ceil(n / P) * P
+                    current = pad_batch_to(current, target)
+                    remainder = n
+                    n = target
+                # slice this host's shard of the global batch
+                per_host = n // P if n else None
+                if per_host is not None and P > 1:
+                    start = self.state.process_index * per_host
+                    local = slice_tensors(current, slice(start, start + per_host))
+                else:
+                    local = current
+                if stop:
+                    self.end_of_dataloader = True
+                    if remainder != -1:
+                        self.remainder = remainder
+                if self.put_on_device:
+                    local = make_global_batch(local, self.mesh, self.batch_axes)
+                yield local
+                current = nxt
+        finally:
+            self.end()
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch

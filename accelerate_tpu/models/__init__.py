@@ -1,0 +1,6 @@
+"""Runnable model families (flagship workloads for benchmarks/examples)."""
+
+from . import bert, common, llama, mixtral
+from .bert import BertConfig
+from .llama import LlamaConfig
+from .mixtral import MixtralConfig

@@ -1,0 +1,142 @@
+"""BERT-style encoder + sequence classifier.
+
+Backs the `nlp_example` path (BERT-base GLUE/MRPC is the BASELINE.md target
+workload for steps/sec/chip). Same stacked-layer + scan design as llama.py;
+bidirectional attention, learned positions, GELU MLP, pooler + classifier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    init_dense,
+    layer_norm,
+    normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def base(cls, **overrides) -> "BertConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "BertConfig":
+        return cls(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128, **overrides,
+        )
+
+
+def init_params(config: BertConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 12)
+    h, L = config.hidden_size, config.num_hidden_layers
+
+    def stack(k, d_in, d_out):
+        return {
+            "kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype),
+            "bias": jnp.zeros((L, d_out), dtype),
+        }
+
+    return {
+        "embed_tokens": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "position_embeddings": {"embedding": normal_init(keys[1], (config.max_position_embeddings, h), 0.02, dtype)},
+        "token_type_embeddings": {"embedding": normal_init(keys[2], (config.type_vocab_size, h), 0.02, dtype)},
+        "embeddings_layernorm": {"scale": jnp.ones((h,), dtype), "bias": jnp.zeros((h,), dtype)},
+        "layers": {
+            "attn": {
+                "q_proj": stack(keys[3], h, h),
+                "k_proj": stack(keys[4], h, h),
+                "v_proj": stack(keys[5], h, h),
+                "o_proj": stack(keys[6], h, h),
+            },
+            "attention_layernorm": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
+            "mlp": {
+                "up_proj": stack(keys[7], h, config.intermediate_size),
+                "down_proj": stack(keys[8], config.intermediate_size, h),
+            },
+            "output_layernorm": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
+        },
+        "pooler": init_dense(keys[9], h, h, 0.02, bias=True, dtype=dtype),
+        "classifier": init_dense(keys[10], h, config.num_labels, 0.02, bias=True, dtype=dtype),
+    }
+
+
+def _layer_body(config: BertConfig, x, layer, mask):
+    b, s, h = x.shape
+    nh, hd = config.num_attention_heads, config.head_dim
+    a = layer["attn"]
+    q = dense(x, a["q_proj"]["kernel"], a["q_proj"]["bias"]).reshape(b, s, nh, hd)
+    k = dense(x, a["k_proj"]["kernel"], a["k_proj"]["bias"]).reshape(b, s, nh, hd)
+    v = dense(x, a["v_proj"]["kernel"], a["v_proj"]["bias"]).reshape(b, s, nh, hd)
+    attn = dot_product_attention(q, k, v, mask=mask).reshape(b, s, h)
+    attn = dense(attn, a["o_proj"]["kernel"], a["o_proj"]["bias"])
+    x = layer_norm(x + attn, layer["attention_layernorm"]["scale"],
+                   layer["attention_layernorm"]["bias"], config.layer_norm_eps)
+    m = layer["mlp"]
+    hmid = jax.nn.gelu(dense(x, m["up_proj"]["kernel"], m["up_proj"]["bias"]))
+    out = dense(hmid, m["down_proj"]["kernel"], m["down_proj"]["bias"])
+    return layer_norm(x + out, layer["output_layernorm"]["scale"],
+                      layer["output_layernorm"]["bias"], config.layer_norm_eps)
+
+
+def forward(
+    config: BertConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    token_type_ids: jax.Array | None = None,
+) -> jax.Array:
+    """Pooled logits [B, num_labels]."""
+    b, s = input_ids.shape
+    x = params["embed_tokens"]["embedding"][input_ids]
+    x = x + params["position_embeddings"]["embedding"][jnp.arange(s)][None]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + params["token_type_embeddings"]["embedding"][token_type_ids]
+    x = layer_norm(x, params["embeddings_layernorm"]["scale"],
+                   params["embeddings_layernorm"]["bias"], config.layer_norm_eps)
+    mask = attention_mask.astype(jnp.bool_) if attention_mask is not None else None
+
+    def scan_body(carry, layer):
+        return _layer_body(config, carry, layer, mask), None
+
+    if config.remat:
+        scan_body = jax.checkpoint(scan_body, prevent_cse=False)
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    pooled = jnp.tanh(dense(x[:, 0], params["pooler"]["kernel"], params["pooler"]["bias"]))
+    return dense(pooled, params["classifier"]["kernel"], params["classifier"]["bias"])
+
+
+def classification_loss(config: BertConfig, params: dict, batch: dict) -> jax.Array:
+    logits = forward(
+        config, params, batch["input_ids"],
+        attention_mask=batch.get("attention_mask"),
+        token_type_ids=batch.get("token_type_ids"),
+    )
+    return cross_entropy_loss(logits, batch["labels"])

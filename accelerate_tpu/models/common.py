@@ -1,0 +1,139 @@
+"""Shared pure-function model components.
+
+No reference equivalent (Accelerate wraps user torch models); these exist so
+the framework ships runnable model families for its examples/benchmarks, the
+way the reference leans on HF Transformers. Everything is a pure function over
+a params pytree whose naming matches sharding/rules.py, so the planner shards
+any of these models with zero per-model annotation.
+
+TPU notes: matmuls accumulate in fp32 (`preferred_element_type`), attention
+uses einsum forms XLA maps onto the MXU, layers stack on a leading dim for
+`lax.scan` (one compiled layer body regardless of depth).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None = None) -> jax.Array:
+    out = jnp.einsum("...d,df->...f", x, kernel, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(dtype) * scale
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out.astype(dtype) * scale + bias
+
+
+# --- rotary embeddings ------------------------------------------------------
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> tuple:
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_len)
+    freqs = np.outer(t, inv_freq)
+    return jnp.asarray(np.cos(freqs), jnp.float32), jnp.asarray(np.sin(freqs), jnp.float32)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """x: [B, S, H, D]; positions: [B, S]."""
+    dtype = x.dtype
+    cos = cos[positions][:, :, None, :]  # [B, S, 1, D/2]
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)
+
+
+# --- attention --------------------------------------------------------------
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: repeat kv heads [B,S,Hkv,D] -> [B,S,Hkv*n_rep,D]."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array | None = None,
+    causal: bool = False,
+) -> jax.Array:
+    """[B, S, H, D] attention with fp32 softmax (MXU-friendly einsum form)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) / math.sqrt(depth)
+    if causal:
+        s_q, s_k = q.shape[1], k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
+        scores = jnp.where(causal_mask[None, None], scores, -1e30)
+    if mask is not None:
+        # mask: [B, S_k] padding, [B, S_q, S_k], or [B, H|1, S_q, S_k]
+        if mask.ndim == 2:
+            mask = mask[:, None, None, :]
+        elif mask.ndim == 3:
+            mask = mask[:, None, :, :]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+# --- initializers -----------------------------------------------------------
+
+
+def normal_init(key, shape, stddev: float = 0.02, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * stddev
+
+
+def init_dense(key, d_in: int, d_out: int, stddev: float = 0.02, bias: bool = False,
+               dtype=jnp.float32) -> dict:
+    params = {"kernel": normal_init(key, (d_in, d_out), stddev, dtype)}
+    if bias:
+        params["bias"] = jnp.zeros((d_out,), dtype)
+    return params
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array | None = None,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean token cross-entropy in fp32 (stable under bf16 logits)."""
+    logits = logits.astype(jnp.float32)
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    if label_smoothing > 0:
+        smooth = -jnp.mean(log_probs, axis=-1)
+        nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def count_params(params: Any) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,299 @@
+"""Llama-family causal LM, TPU-first.
+
+Flagship model for the framework's benchmarks (BASELINE.md targets Llama-3-8B
+tokens/sec/chip). Design:
+
+- params stack the L transformer layers on a leading dim; the forward runs
+  `lax.scan` over them, so XLA compiles ONE layer body (fast compiles at any
+  depth) — the idiomatic TPU replacement for Python-level layer loops.
+- `remat` option wraps the scanned body in `jax.checkpoint` (activation
+  checkpointing — replaces FSDP plugin activation_checkpointing,
+  ref utils/dataclasses.py:1105-1112).
+- attention backends: 'einsum' (XLA), 'flash' (pallas kernel,
+  ops/flash_attention.py), 'ring' (sequence-parallel over the mesh `seq`
+  axis, parallel/ring_attention.py).
+- naming matches sharding/rules.py so the planner yields Megatron-style
+  TP + ZeRO layouts with no per-model code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    apply_rope,
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    init_dense,
+    normal_init,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 2048
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    attention_backend: str = "einsum"  # einsum | flash | ring
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama3_8b(cls, **overrides) -> "LlamaConfig":
+        return cls(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192, rope_theta=500000.0, **overrides,
+        )
+
+    @classmethod
+    def tiny(cls, **overrides) -> "LlamaConfig":
+        """Test/debug size."""
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, **overrides,
+        )
+
+
+def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    """Stacked-layer param pytree."""
+    keys = jax.random.split(key, 8)
+    h, kv = config.hidden_size, config.num_key_value_heads * config.head_dim
+    L = config.num_hidden_layers
+
+    def stack(k, d_in, d_out):
+        return {"kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype)}
+
+    params = {
+        "embed_tokens": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "layers": {
+            "input_layernorm": {"scale": jnp.ones((L, h), dtype)},
+            "attn": {
+                "q_proj": stack(keys[1], h, h),
+                "k_proj": stack(keys[2], h, kv),
+                "v_proj": stack(keys[3], h, kv),
+                "o_proj": stack(keys[4], h, h),
+            },
+            "post_attention_layernorm": {"scale": jnp.ones((L, h), dtype)},
+            "mlp": {
+                "gate_proj": stack(keys[5], h, config.intermediate_size),
+                "up_proj": stack(keys[6], h, config.intermediate_size),
+                "down_proj": stack(keys[7], config.intermediate_size, h),
+            },
+        },
+        "norm": {"scale": jnp.ones((h,), dtype)},
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = init_dense(
+            jax.random.fold_in(key, 99), h, config.vocab_size, 0.02, dtype=dtype
+        )
+    return params
+
+
+def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
+               kv_cache=None):
+    b, s, h = x.shape
+    nh, nkv, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    q = dense(x, layer["attn"]["q_proj"]["kernel"]).reshape(b, s, nh, hd)
+    k = dense(x, layer["attn"]["k_proj"]["kernel"]).reshape(b, s, nkv, hd)
+    v = dense(x, layer["attn"]["v_proj"]["kernel"]).reshape(b, s, nkv, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv, cache_len = kv_cache
+        zero = jnp.zeros((), jnp.int32)
+        k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (zero, cache_len, zero, zero))
+        v = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (zero, cache_len, zero, zero))
+        new_cache = (k, v, cache_len + s)
+        # each query position p attends to cached positions <= p (causality
+        # holds within the prefill chunk too)
+        kv_mask = (
+            jnp.arange(k.shape[1])[None, None, :] <= positions[:, :, None]
+        )  # [B, S_q, S_k]
+        mask = kv_mask if mask is None else mask[:, None, :] & kv_mask
+        causal = False
+    else:
+        causal = True
+    k = repeat_kv(k, nh // nkv)
+    v = repeat_kv(v, nh // nkv)
+    if config.attention_backend == "flash" and kv_cache is None:
+        from ..ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+    elif config.attention_backend == "ring" and kv_cache is None:
+        from ..parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, causal=True)
+    else:
+        out = dot_product_attention(q, k, v, mask=mask, causal=causal)
+    out = out.reshape(b, s, nh * hd)
+    return dense(out, layer["attn"]["o_proj"]["kernel"]), new_cache
+
+
+def _mlp(layer: dict, x):
+    gate = jax.nn.silu(dense(x, layer["mlp"]["gate_proj"]["kernel"]))
+    up = dense(x, layer["mlp"]["up_proj"]["kernel"])
+    return dense(gate * up, layer["mlp"]["down_proj"]["kernel"])
+
+
+def _layer_body(config: LlamaConfig, x, layer, cos, sin, positions, mask,
+                kv_cache=None):
+    attn_out, new_cache = _attention(
+        config, layer,
+        rms_norm(x, layer["input_layernorm"]["scale"], config.rms_norm_eps),
+        cos, sin, positions, mask, kv_cache,
+    )
+    x = x + attn_out
+    x = x + _mlp(layer, rms_norm(x, layer["post_attention_layernorm"]["scale"],
+                                 config.rms_norm_eps))
+    return x, new_cache
+
+
+def forward(
+    config: LlamaConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    positions: jax.Array | None = None,
+    kv_caches: Any = None,
+) -> jax.Array | tuple:
+    """Logits [B, S, V]; with kv_caches, returns (logits, new_caches)."""
+    x = params["embed_tokens"]["embedding"][input_ids]
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1]), input_ids.shape
+        )
+    max_len = (
+        kv_caches[0][0].shape[1] if kv_caches is not None
+        else config.max_position_embeddings
+    )
+    cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta)
+
+    if kv_caches is not None:
+        # decode path: python loop over per-layer caches (stacked scan would
+        # need stacked caches; decode favors simplicity)
+        new_caches = []
+        for i in range(config.num_hidden_layers):
+            layer = jax.tree_util.tree_map(lambda p: p[i], params["layers"])
+            x, cache = _layer_body(config, x, layer, cos, sin, positions,
+                                   attention_mask, kv_caches[i])
+            new_caches.append(cache)
+        x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+        logits = _project_out(config, params, x)
+        return logits, new_caches
+
+    body = partial(_layer_body, config)
+
+    def scan_body(carry, layer):
+        y, _ = body(carry, layer, cos, sin, positions, attention_mask)
+        return y, None
+
+    if config.remat:
+        scan_body = jax.checkpoint(scan_body, prevent_cse=False)
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+    return _project_out(config, params, x)
+
+
+def _project_out(config: LlamaConfig, params: dict, x):
+    if config.tie_word_embeddings:
+        return jnp.einsum(
+            "bsh,vh->bsv", x, params["embed_tokens"]["embedding"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict) -> jax.Array:
+    """Next-token loss over a batch {input_ids, attention_mask?}."""
+    input_ids = batch["input_ids"]
+    logits = forward(config, params, input_ids[:, :-1],
+                     attention_mask=None)
+    labels = input_ids[:, 1:]
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    return cross_entropy_loss(logits, labels, mask)
+
+
+def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16):
+    kv_heads = config.num_key_value_heads
+    # cache_len is a traced scalar so decode steps never retrigger tracing
+    return [
+        (
+            jnp.zeros((batch, max_len, kv_heads, config.head_dim), dtype),
+            jnp.zeros((batch, max_len, kv_heads, config.head_dim), dtype),
+            jnp.zeros((), jnp.int32),
+        )
+        for _ in range(config.num_hidden_layers)
+    ]
+
+
+def generate(
+    config: LlamaConfig,
+    params: dict,
+    input_ids: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy/temperature decode with a KV cache (big-model-inference path;
+    benchmark analogue of ref benchmarks/big_model_inference.py)."""
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    caches = init_kv_caches(config, b, total)
+    if key is None:
+        key = jax.random.key(0)
+
+    def select(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits[:, -1], axis=-1)
+        return jax.random.categorical(k, logits[:, -1] / temperature)
+
+    prefill = jax.jit(partial(forward, config))
+    logits, caches = prefill(params, input_ids, kv_caches=caches)
+    key, sub = jax.random.split(key)
+    last = select(logits, sub)
+
+    # one compiled program reused for every decode token (traced cache_len
+    # and positions keep the trace static)
+    @jax.jit
+    def decode_step(params, last, caches, pos, k):
+        positions = jnp.broadcast_to(pos, (b, 1))
+        logits, caches = forward(
+            config, params, last[:, None], positions=positions, kv_caches=caches
+        )
+        return select(logits, k), caches
+
+    tokens = [input_ids]
+    for step in range(max_new_tokens - 1):
+        tokens.append(last[:, None])
+        key, sub = jax.random.split(key)
+        last, caches = decode_step(
+            params, last, caches, jnp.asarray(prompt_len + step, jnp.int32), sub
+        )
+    tokens.append(last[:, None])
+    return jnp.concatenate(tokens, axis=1)

@@ -1,0 +1,197 @@
+"""Mixtral-style MoE causal LM.
+
+The BASELINE.json Mixtral-8x7B config targets "DeepSpeed ZeRO-3 plugin ->
+expert-parallel GSPMD" — the reference could only do MoE through DeepSpeed
+leaf-module config (ref utils/dataclasses.py:724-730). Here experts live on a
+leading E dim sharded over the `expert` mesh axis (sharding/rules.py), and
+token routing is dense one-hot dispatch einsum (XLA turns it into an
+all-to-all across the expert axis when sharded; an explicit shard_map a2a
+variant lives in parallel/moe.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    apply_rope,
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    normal_init,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+)
+from .llama import LlamaConfig, _attention
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    max_position_embeddings: int = 4096
+    rope_theta: float = 1000000.0
+    rms_norm_eps: float = 1e-5
+    router_aux_loss_coef: float = 0.02
+    remat: bool = False
+    attention_backend: str = "einsum"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def mixtral_8x7b(cls, **overrides) -> "MixtralConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "MixtralConfig":
+        return cls(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_local_experts=4, num_experts_per_tok=2,
+            max_position_embeddings=128, **overrides,
+        )
+
+    def _as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rope_theta=self.rope_theta, rms_norm_eps=self.rms_norm_eps,
+            attention_backend=self.attention_backend,
+        )
+
+
+def init_params(config: MixtralConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 10)
+    h, L, E = config.hidden_size, config.num_hidden_layers, config.num_local_experts
+    f = config.intermediate_size
+
+    def stack(k, d_in, d_out):
+        return {"kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype)}
+
+    def estack(k, d_in, d_out):
+        return {"kernel": normal_init(k, (L, E, d_in, d_out), 0.02, dtype)}
+
+    kv = config.num_key_value_heads * config.head_dim
+    return {
+        "embed_tokens": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "layers": {
+            "input_layernorm": {"scale": jnp.ones((L, h), dtype)},
+            "attn": {
+                "q_proj": stack(keys[1], h, h),
+                "k_proj": stack(keys[2], h, kv),
+                "v_proj": stack(keys[3], h, kv),
+                "o_proj": stack(keys[4], h, h),
+            },
+            "post_attention_layernorm": {"scale": jnp.ones((L, h), dtype)},
+            "moe": {
+                "router": {"kernel": normal_init(keys[5], (L, h, E), 0.02, dtype)},
+                "experts": {
+                    "gate_proj": estack(keys[6], h, f),
+                    "up_proj": estack(keys[7], h, f),
+                    "down_proj": estack(keys[8], f, h),
+                },
+            },
+        },
+        "norm": {"scale": jnp.ones((h,), dtype)},
+        "lm_head": {"kernel": normal_init(keys[9], (h, config.vocab_size), 0.02, dtype)},
+    }
+
+
+def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert MLP via dense one-hot dispatch.
+
+    Returns (output, router_aux_loss). The [B,S,E] combine weights contract
+    against expert-stacked weights with einsum — when `experts` shard on the
+    expert axis GSPMD lowers this to a2a dispatch/combine.
+    """
+    b, s, h = x.shape
+    E, k = config.num_local_experts, config.num_experts_per_tok
+    router_logits = jnp.einsum(
+        "bsh,he->bse", x, moe["router"]["kernel"], preferred_element_type=jnp.float32
+    )
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    topk_probs, topk_idx = jax.lax.top_k(probs, k)
+    topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    # combine weights [B,S,E]
+    combine = jnp.sum(
+        jax.nn.one_hot(topk_idx, E, dtype=x.dtype) * topk_probs[..., None].astype(x.dtype),
+        axis=2,
+    )
+    # every expert processes every token (dense); combine selects
+    gate = jax.nn.silu(jnp.einsum("bsh,ehf->besf", x, moe["experts"]["gate_proj"]["kernel"],
+                                  preferred_element_type=jnp.float32).astype(x.dtype))
+    up = jnp.einsum("bsh,ehf->besf", x, moe["experts"]["up_proj"]["kernel"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = jnp.einsum("besf,efh->besh", gate * up, moe["experts"]["down_proj"]["kernel"],
+                            preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.einsum("besh,bse->bsh", expert_out, combine)
+    # load-balancing aux loss (Switch-style)
+    token_frac = jnp.mean(
+        jax.nn.one_hot(topk_idx, E, dtype=jnp.float32).sum(axis=2), axis=(0, 1)
+    ) / k
+    prob_frac = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(token_frac * prob_frac)
+    return out, aux
+
+
+def forward(
+    config: MixtralConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (logits [B,S,V], total router aux loss)."""
+    lcfg = config._as_llama()
+    x = params["embed_tokens"]["embedding"][input_ids]
+    positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    cos, sin = rope_frequencies(config.head_dim, config.max_position_embeddings,
+                                config.rope_theta)
+
+    def scan_body(carry, layer):
+        x, aux_sum = carry
+        attn_out, _ = _attention(
+            lcfg, layer,
+            rms_norm(x, layer["input_layernorm"]["scale"], config.rms_norm_eps),
+            cos, sin, positions, attention_mask,
+        )
+        x = x + attn_out
+        moe_out, aux = moe_block(
+            config, layer["moe"],
+            rms_norm(x, layer["post_attention_layernorm"]["scale"], config.rms_norm_eps),
+        )
+        return (x + moe_out, aux_sum + aux), None
+
+    body = scan_body
+    if config.remat:
+        body = jax.checkpoint(body, prevent_cse=False)
+    (x, aux_total), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
+    x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+    logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, aux_total / config.num_hidden_layers
+
+
+def causal_lm_loss(config: MixtralConfig, params: dict, batch: dict) -> jax.Array:
+    input_ids = batch["input_ids"]
+    logits, aux = forward(config, params, input_ids[:, :-1])
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    loss = cross_entropy_loss(logits, input_ids[:, 1:], mask)
+    return loss + config.router_aux_loss_coef * aux

