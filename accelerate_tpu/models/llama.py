@@ -137,11 +137,12 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         causal = True
     k = repeat_kv(k, nh // nkv)
     v = repeat_kv(v, nh // nkv)
-    if config.attention_backend == "flash" and kv_cache is None:
+    # flash/ring paths take no padding mask: use them only when there is none
+    if config.attention_backend == "flash" and kv_cache is None and mask is None:
         from ..ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
-    elif config.attention_backend == "ring" and kv_cache is None:
+    elif config.attention_backend == "ring" and kv_cache is None and mask is None:
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=True)

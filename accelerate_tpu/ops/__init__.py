@@ -1,0 +1,3 @@
+"""Custom TPU ops (pallas kernels + XLA fallbacks)."""
+
+from .flash_attention import flash_attention

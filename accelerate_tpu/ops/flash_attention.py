@@ -1,0 +1,154 @@
+"""Pallas flash attention (TPU).
+
+Blockwise attention with online softmax: O(S) memory instead of the S x S
+score matrix. No reference equivalent — the reference delegates attention to
+torch/bnb kernels; this is part of the long-context answer (SURVEY.md §5)
+together with parallel/ring_attention.py.
+
+Forward is a pallas kernel (grid over [batch*heads, q_blocks], fori_loop over
+k blocks with running max/sum in VMEM scratch; causal variant skips fully
+masked k blocks). Backward is a custom_vjp that recomputes attention with the
+XLA einsum path — correct everywhere, O(S^2) only in the backward; a pallas
+backward kernel is a planned optimization.
+
+On non-TPU backends the kernel runs in pallas interpret mode (slow, for
+tests); prefer `dot_product_attention` there.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, block_q: int, seq_k: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        # q rows in this block end at (qi+1)*block_q - 1: k blocks beyond
+        # that are fully masked — skip them entirely
+        last_block = jax.lax.div((qi + 1) * block_q - 1, block_k) + 1
+    else:
+        last_block = num_k_blocks
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, last_block, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    """q,k,v: [BH, S, D] -> [BH, S, D]."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    grid = (bh, seq_q // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, seq_k=seq_k,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, causal):
+    """XLA einsum attention on [BH, S, D] (backward recompute path)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32)
+    s = s / math.sqrt(d)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v, preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """[B, S, H, D] flash attention. Heads must already be repeated (GQA:
+    call models.common.repeat_kv first). Sequence lengths must divide the
+    block sizes; shorter sequences fall back to einsum attention."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    # sq != sk would make the kernel's top-aligned causal mask disagree with
+    # the bottom-aligned reference (and read past the k buffer when sq > sk)
+    if sq % block_q or sk % block_k or (causal and sq != sk):
+        from ..models.common import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    out = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,6 @@
+"""Parallel schedules beyond plain GSPMD: ring attention (context parallel),
+pipeline parallelism, expert-parallel MoE dispatch."""
+
+from .moe import expert_parallel_moe
+from .pipeline import pipeline_apply, stack_layers_into_stages
+from .ring_attention import ring_attention

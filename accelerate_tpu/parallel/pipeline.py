@@ -1,0 +1,134 @@
+"""Pipeline parallelism over the mesh `stage` axis.
+
+Replaces the reference's two delegated PP paths: Megatron's 1F1B/interleaved
+schedules for training (ref utils/megatron_lm.py:964-1063) and PiPPy stage
+graphs for inference (ref inference.py:78-188). TPU-native design: the S
+pipeline stages live on a `stage` mesh axis; a `shard_map`-wrapped GPipe
+schedule rotates micro-batch activations stage-to-stage with `lax.ppermute`.
+The whole schedule (fills, steady state, drains) is ONE `lax.scan` inside
+jit, so forward AND backward (autodiff through ppermute) compile into a
+single XLA program — the backward drains in reverse automatically, giving
+GPipe memory/throughput semantics without a hand-written 1F1B interleave.
+
+Stage-stacked params: a pytree whose leaves lead with dim S (one slice per
+stage), sharded over the `stage` axis by the planner.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import AXIS_STAGE
+
+
+def stack_layers_into_stages(params: Any, num_stages: int) -> Any:
+    """[L, ...]-stacked layer params -> [S, L//S, ...] stage-stacked."""
+
+    def _split(x):
+        L = x.shape[0]
+        if L % num_stages:
+            raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+        return x.reshape((num_stages, L // num_stages) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, params)
+
+
+def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name, num_stages,
+                    num_micro):
+    """Runs INSIDE shard_map.
+
+    stage_params: this stage's params (leading stage dim of size 1, squeezed).
+    x_micro: [M, micro_b, ...] all micro-batches (replicated input); only
+    stage 0 consumes them. Returns [M, micro_b, ...] outputs valid on the
+    LAST stage (others carry zeros).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    micro_shape = x_micro.shape[1:]
+    total_ticks = num_micro + num_stages - 1
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    out0 = jnp.zeros((num_micro,) + micro_shape, x_micro.dtype)
+    carry0 = jnp.zeros(micro_shape, x_micro.dtype)
+
+    def tick(carry, t):
+        inbound, outputs = carry
+        # stage 0 ingests micro-batch t (when in range); others use inbound
+        feed = jnp.where(
+            t < num_micro, x_micro[jnp.minimum(t, num_micro - 1)], jnp.zeros(micro_shape, x_micro.dtype)
+        )
+        x = jnp.where(idx == 0, feed, inbound)
+        y = stage_fn(params, x)
+        # last stage banks micro-batch m = t - (S-1) when valid
+        m = t - (num_stages - 1)
+        valid = (idx == num_stages - 1) & (m >= 0)
+        outputs = jax.lax.cond(
+            valid,
+            lambda o: o.at[jnp.maximum(m, 0)].set(y),
+            lambda o: o,
+            outputs,
+        )
+        # hand activations to the next stage
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (carry0, out0), jnp.arange(total_ticks)
+    )
+    # broadcast final outputs from the last stage to all (psum of one-hot)
+    mine = jnp.where(idx == num_stages - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(mine, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    num_micro_batches: int,
+    mesh=None,
+    axis_name: str = AXIS_STAGE,
+) -> jax.Array:
+    """GPipe-schedule apply: y = stages(x), differentiable.
+
+    - `stage_fn(params_slice, x_micro) -> y_micro` is one stage's compute
+      (activations and outputs must share x's shape/dtype).
+    - `stage_params`: pytree with leading stage dim S, sharded on `stage`.
+    - `x`: [B, ...] global batch; split into `num_micro_batches` micro-batches.
+
+    Replaces Megatron `get_forward_backward_func` micro-batch chunking
+    (ref utils/megatron_lm.py:975-1011).
+    """
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+    num_stages = mesh.shape.get(axis_name, 1)
+    if num_stages == 1:
+        raise ValueError(
+            f"mesh has no '{axis_name}' axis (or size 1); apply the stages "
+            "sequentially instead of via pipeline_apply"
+        )
+    b = x.shape[0]
+    if b % num_micro_batches:
+        raise ValueError(f"batch {b} not divisible by {num_micro_batches} micro-batches")
+    micro = x.reshape((num_micro_batches, b // num_micro_batches) + x.shape[1:])
+
+    stage_spec = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
+    )
+    fn = partial(
+        _pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
+        num_stages=num_stages, num_micro=num_micro_batches,
+    )
+    out = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(stage_spec, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, micro)
+    return out.reshape((b,) + out.shape[2:])
