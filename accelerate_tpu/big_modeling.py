@@ -1,0 +1,350 @@
+"""Big-model init, dispatch, and offloaded inference.
+
+TPU-native analogue of ref src/accelerate/big_modeling.py (627 LoC) +
+hooks.py (709 LoC). The reference's machinery is torch-shaped: meta-device
+init, per-module ``device_map``, ``AlignDevicesHook`` moving weights at
+forward time (ref hooks.py:315-383). Here:
+
+- meta init  = ``jax.eval_shape`` (``init_empty_weights``) — shapes/dtypes
+  with zero bytes allocated, no monkey-patching needed
+  (ref big_modeling.py:56-166).
+- the *preferred* multi-device path is GSPMD: ``dispatch_model`` with
+  ``device_map="sharded"`` delegates to sharding/planner.py (TP+FSDP specs),
+  and one jit'd forward runs across all chips — no per-module hooks, XLA
+  inserts the collectives. This is the TPU answer to naive model parallel.
+- the *offload* path keeps row groups of scan-stacked layer modules on
+  device / host RAM / disk (``RowGroups``), and ``streamed_forward`` plays
+  the AlignDevicesHook role: device_put each layer's slice right before its
+  compiled step, double-buffered so the host→device copy of layer i+1
+  overlaps compute of layer i (ref hooks.py pre_forward/post_forward,
+  without graph breaks).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from collections import OrderedDict
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+from .logging import get_logger
+from .utils.modeling import (
+    check_device_map,
+    find_stacked_modules,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    load_state_dict,
+    _LAYER_ROW,
+)
+from .utils.offload import load_offloaded_weight, offload_weight, save_offload_index
+from .utils.other import flatten_dict, unflatten_dict
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "init_empty_weights",
+    "init_on_device",
+    "infer_auto_device_map",
+    "get_max_memory",
+    "dispatch_model",
+    "load_checkpoint_and_dispatch",
+    "cpu_offload",
+    "disk_offload",
+    "RowGroups",
+    "streamed_forward",
+]
+
+
+def init_empty_weights(init_fn: Callable, *args, **kwargs) -> Any:
+    """Abstract params: shapes/dtypes only, nothing allocated
+    (ref big_modeling.py:56-102 ``init_empty_weights``; here it is just
+    ``jax.eval_shape`` — JAX's tracing *is* the meta device). All arguments
+    are closed over (static), so configs/dtypes pass through untouched."""
+    return jax.eval_shape(lambda: init_fn(*args, **kwargs))
+
+
+def init_on_device(device) -> Any:
+    """Context manager placing fresh arrays on `device`
+    (ref big_modeling.py:105-166)."""
+    return jax.default_device(device)
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+class RowGroups:
+    """A scan-stacked leaf split into contiguous row groups living on
+    different storage tiers: jax.Array (device), np.ndarray (host), or
+    np.memmap (disk). ``row(i)`` fetches one layer's slice."""
+
+    def __init__(self, groups: list[tuple[int, int, Any]], shape, dtype):
+        self.groups = sorted(groups, key=lambda g: g[0])
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def row(self, i: int):
+        for start, end, arr in self.groups:
+            if start <= i < end:
+                return arr[i - start]
+        raise IndexError(i)
+
+    def __repr__(self) -> str:
+        tiers = [
+            f"[{s}:{e})->{'dev' if isinstance(a, jax.Array) else 'host'}"
+            for s, e, a in self.groups
+        ]
+        return f"RowGroups({', '.join(tiers)})"
+
+
+def _resolve_target(target):
+    """device_map value -> ('device', jax.Device) | ('cpu'|'disk', None)."""
+    if target in ("cpu", "disk"):
+        return (target, None)
+    if isinstance(target, int):
+        return ("device", jax.local_devices()[target])
+    return ("device", target)  # already a jax.Device
+
+
+def _placement_plan(params: Any, device_map: Mapping[str, Any]) -> dict[str, Any]:
+    """flat key -> target, or (for stacked leaves with per-row map entries)
+    list of (start_row, end_row, target)."""
+    check_device_map(params, device_map)
+    flat = flatten_dict(params)
+    stacked = find_stacked_modules(params)
+    # collect per-module row assignments: {'layers': {0: dev, 1: 'cpu', ...}}
+    row_maps: dict[str, dict[int, Any]] = {}
+    plain: dict[str, Any] = {}
+    for key, target in device_map.items():
+        m = _LAYER_ROW.match(key)
+        if m and m.group(1) in stacked:
+            row_maps.setdefault(m.group(1), {})[int(m.group(2))] = target
+        elif m and isinstance(params, dict) and m.group(1) in params:
+            raise ValueError(
+                f"device_map key {key!r} addresses module {m.group(1)!r} per-row, "
+                "but it is not a stacked scan-layer module"
+            )
+        else:
+            plain[key] = target
+
+    plan: dict[str, Any] = {}
+    for key in flat:
+        mod = key.split(".", 1)[0]
+        if mod in row_maps:
+            rows = row_maps[mod]
+            n = stacked[mod]
+            groups: list[tuple[int, int, Any]] = []
+            for i in range(n):
+                t = rows.get(i, "cpu")
+                if groups and groups[-1][2] == t:
+                    groups[-1] = (groups[-1][0], i + 1, t)
+                else:
+                    groups.append((i, i + 1, t))
+            plan[key] = groups if len(groups) > 1 else groups[0][2]
+        else:
+            hits = [mk for mk in plain if mk == "" or key == mk or key.startswith(mk + ".")]
+            plan[key] = plain[max(hits, key=len)]
+    return plan
+
+
+def _place_one(key: str, arr, target, offload_folder, offload_index):
+    kind, dev = _resolve_target(target)
+    if kind == "device":
+        return jax.device_put(arr, dev)
+    if kind == "cpu":
+        return np.asarray(arr)
+    if offload_folder is None:
+        raise ValueError(f"{key!r} mapped to disk but no offload_folder given")
+    offload_weight(arr, key, offload_folder, offload_index)
+    return load_offloaded_weight(
+        os.path.join(offload_folder, f"{key}.dat"), offload_index[key]
+    )
+
+
+def _place_flat(
+    flat: Mapping[str, Any], plan: Mapping[str, Any], offload_folder: str | None
+) -> tuple[dict[str, Any], dict]:
+    offload_index: dict = {}
+    out: dict[str, Any] = {}
+    for key, arr in flat.items():
+        target = plan[key]
+        if isinstance(target, list):  # row groups of a stacked leaf
+            groups = []
+            for start, end, t in target:
+                placed = _place_one(
+                    f"{key}.rows{start}-{end}", np.asarray(arr[start:end]), t,
+                    offload_folder, offload_index,
+                )
+                groups.append((start, end, placed))
+            out[key] = RowGroups(groups, arr.shape, arr.dtype)
+        else:
+            out[key] = _place_one(key, arr, target, offload_folder, offload_index)
+    return out, offload_index
+
+
+def dispatch_model(
+    params: Any,
+    device_map: Mapping[str, Any] | str | None = "sharded",
+    offload_folder: str | None = None,
+    mesh_axis: str = "model",
+) -> Any:
+    """Lay a params pytree out across devices (ref big_modeling.py:305-495).
+
+    - ``device_map='sharded'`` (default, the TPU-idiomatic path): build a 1-D
+      mesh over all local devices and apply the transformer sharding rules —
+      the whole model runs in one jit, GSPMD moving data. Replaces per-module
+      hooks entirely.
+    - explicit ``{module: device|'cpu'|'disk'}`` map (including per-row
+      ``layers.{i}`` entries from ``infer_auto_device_map``): leaves are
+      placed per tier; host/disk row groups come back as ``RowGroups`` for
+      ``streamed_forward``.
+    """
+    if device_map == "sharded" or device_map is None:
+        from jax.sharding import Mesh
+
+        from .sharding.planner import plan_sharding, shard_pytree
+        from .sharding.rules import transformer_rules
+
+        devices = np.array(jax.local_devices())
+        mesh = Mesh(devices, (mesh_axis,))
+        plan = plan_sharding(params, mesh, rules=transformer_rules())
+        return shard_pytree(params, plan)
+    if device_map == "auto":
+        device_map = infer_auto_device_map(params)
+    plan = _placement_plan(params, device_map)
+    flat = flatten_dict(params)
+    placed, offload_index = _place_flat(flat, plan, offload_folder)
+    if offload_index and offload_folder:
+        save_offload_index(offload_index, offload_folder)
+    return unflatten_dict(placed)
+
+
+def cpu_offload(params: Any, keep_modules: tuple = ()) -> Any:
+    """All params to host RAM except `keep_modules`
+    (ref big_modeling.py:169-212)."""
+    device_map = OrderedDict(
+        (name, 0 if name in keep_modules else "cpu") for name in params
+    )
+    return dispatch_model(params, device_map)
+
+
+def disk_offload(params: Any, offload_folder: str, keep_modules: tuple = ()) -> Any:
+    """All params to disk memmaps except `keep_modules`
+    (ref big_modeling.py:259-302)."""
+    device_map = OrderedDict(
+        (name, 0 if name in keep_modules else "disk") for name in params
+    )
+    return dispatch_model(params, device_map, offload_folder=offload_folder)
+
+
+def load_checkpoint_and_dispatch(
+    params_abstract: Any,
+    checkpoint: str,
+    device_map: Mapping[str, Any] | str | None = "auto",
+    max_memory: dict | None = None,
+    no_split_modules: tuple = (),
+    offload_folder: str | None = None,
+    dtype=None,
+) -> Any:
+    """Stream a checkpoint straight onto its planned placement
+    (ref big_modeling.py:498-627). `params_abstract` comes from
+    ``init_empty_weights`` — nothing is materialized host-side beyond one
+    tensor at a time for safetensors checkpoints."""
+    if device_map in ("auto", "balanced"):
+        device_map = infer_auto_device_map(
+            params_abstract, max_memory=max_memory,
+            no_split_modules=no_split_modules, dtype=dtype,
+        )
+    if device_map == "sharded":
+        if checkpoint.endswith((".safetensors", ".bin")):
+            loaded = unflatten_dict(load_state_dict(checkpoint))
+        else:
+            from .checkpointing import load_model
+
+            loaded = load_model(checkpoint)
+        return dispatch_model(loaded, "sharded")
+    loaded, _ = load_checkpoint_in_model(
+        params_abstract, checkpoint, device_map=device_map,
+        offload_folder=offload_folder, dtype=dtype,
+    )
+    return loaded
+
+
+# ---------------------------------------------------------------------------
+# streamed forward (the AlignDevicesHook replacement)
+# ---------------------------------------------------------------------------
+
+
+def _module_rowgroups(params_mod: dict) -> bool:
+    return any(
+        isinstance(l, RowGroups)
+        for l in jax.tree_util.tree_leaves(params_mod, is_leaf=lambda x: isinstance(x, RowGroups))
+    )
+
+
+def streamed_forward(
+    params: Any,
+    inputs: Any,
+    embed_fn: Callable[[Any, Any], Any],
+    layer_fn: Callable[[Any, Any, int], Any],
+    final_fn: Callable[[Any, Any], Any],
+    stacked_module: str = "layers",
+    device=None,
+    dtype=None,
+) -> Any:
+    """Run a scan-family model whose stacked layers are (partly) offloaded
+    (ref hooks.py:212-517 AlignDevicesHook, functional form).
+
+    For each layer i: slice its params from wherever they live (device array /
+    host RAM / disk memmap — ``RowGroups.row``), ``device_put`` (async — the
+    copy of layer i+1 overlaps layer i's compute), run the jit'd `layer_fn`.
+    Non-stacked modules are fetched to the device once up front.
+    """
+    device = device or jax.local_devices()[0]
+
+    def _fetch(leaf):
+        if isinstance(leaf, jax.Array):
+            # cast device-resident leaves too: mixed tiers must execute at one
+            # dtype or layer_fn recompiles per tier boundary
+            return leaf.astype(dtype) if dtype is not None else leaf
+        arr = np.asarray(leaf)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        return jax.device_put(arr, device)
+
+    resident = {
+        k: jax.tree_util.tree_map(_fetch, v)
+        for k, v in params.items()
+        if k != stacked_module
+    }
+    stacked = params[stacked_module]
+    flat_stacked = flatten_dict(stacked)
+    n_layers = min(leaf.shape[0] for leaf in flat_stacked.values())
+
+    def _layer_slice(i: int):
+        def get(leaf):
+            row = leaf.row(i) if isinstance(leaf, RowGroups) else leaf[i]
+            if isinstance(row, jax.Array):
+                return row.astype(dtype) if dtype is not None else row
+            row = np.asarray(row)
+            if dtype is not None:
+                row = row.astype(dtype)
+            return jax.device_put(row, device)
+
+        return jax.tree_util.tree_map(
+            get, stacked, is_leaf=lambda x: isinstance(x, RowGroups)
+        )
+
+    x = embed_fn(resident, inputs)
+    nxt = _layer_slice(0)  # double buffer: prefetch layer 0
+    for i in range(n_layers):
+        cur = nxt
+        if i + 1 < n_layers:
+            nxt = _layer_slice(i + 1)  # async H2D while layer i computes
+        x = layer_fn(cur, x, i)
+    return final_fn(resident, x)

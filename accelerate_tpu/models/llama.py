@@ -217,6 +217,43 @@ def forward(
     return _project_out(config, params, x)
 
 
+def forward_offloaded(
+    config: LlamaConfig,
+    dispatched_params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+    dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Forward for params laid out by `big_modeling.dispatch_model` with a
+    cpu/disk device map (ref big-model-inference path, SURVEY.md §2.4):
+    layer slices stream host→device double-buffered around a jit'd layer
+    body. Matches `forward` output on the same weights."""
+    from ..big_modeling import streamed_forward
+
+    positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    cos, sin = rope_frequencies(
+        config.head_dim, config.max_position_embeddings, config.rope_theta
+    )
+    layer_step = jax.jit(
+        lambda layer, x: _layer_body(
+            config, x, layer, cos, sin, positions, attention_mask
+        )[0]
+    )
+
+    def final(resident, x):
+        x = rms_norm(x, resident["norm"]["scale"], config.rms_norm_eps)
+        return _project_out(config, resident, x)
+
+    return streamed_forward(
+        dispatched_params,
+        input_ids,
+        embed_fn=lambda res, ids: res["embed_tokens"]["embedding"][ids],
+        layer_fn=lambda layer, x, i: layer_step(layer, x),
+        final_fn=final,
+        dtype=dtype,
+    )
+
+
 def _project_out(config: LlamaConfig, params: dict, x):
     if config.tie_word_embeddings:
         return jnp.einsum(

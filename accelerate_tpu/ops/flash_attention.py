@@ -5,9 +5,12 @@ score matrix. No reference equivalent — the reference delegates attention to
 torch/bnb kernels; this is part of the long-context answer (SURVEY.md §5)
 together with parallel/ring_attention.py.
 
-Forward is a pallas kernel (grid over [batch*heads, q_blocks], fori_loop over
-k blocks with running max/sum in VMEM scratch; causal variant skips fully
-masked k blocks). Backward is a custom_vjp that recomputes attention with the
+Forward is a pallas kernel with grid [batch*heads, q_blocks, k_blocks]
+(k innermost): each step stages only (block_q, d) of Q and (block_k, d) of
+K/V into VMEM — VMEM use is O(block), not O(S), so 32k+ contexts fit — and
+carries the online-softmax state (running max / sum / accumulator) in VMEM
+scratch across the k dimension. Causal variant no-ops fully masked k blocks
+via `pl.when`. Backward is a custom_vjp that recomputes attention with the
 XLA einsum path — correct everywhere, O(S^2) only in the backward; a pallas
 backward kernel is a planned optimization.
 
@@ -23,48 +26,54 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # TPU vector lane width; scalar-per-row state is kept 2D
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, block_q: int, seq_k: int):
-    qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
-    d = q.shape[-1]
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  causal: bool, sm_scale: float, block_q: int, block_k: int,
+                  num_k_blocks: int):
+    qi, ki = pl.program_id(1), pl.program_id(2)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_k_blocks = seq_k // block_k
-    if causal:
-        # q rows in this block end at (qi+1)*block_q - 1: k blocks beyond
-        # that are fully masked — skip them entirely
-        last_block = jax.lax.div((qi + 1) * block_q - 1, block_k) + 1
-    else:
-        last_block = num_k_blocks
+    # causal: this k block contributes iff its first position is visible to
+    # the last q row of the block
+    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
 
-    def body(ki, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
+        k = k_ref[0].astype(jnp.float32)  # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jnp.dot(
-            p, v, preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+        m_prev = m_scr[...][:, :1]  # [bq, 1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, last_block, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -73,21 +82,30 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     sm_scale = 1.0 / math.sqrt(d)
-    grid = (bh, seq_q // block_q)
+    num_k_blocks = seq_k // block_k
+    grid = (bh, seq_q // block_q, num_k_blocks)
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        block_q=block_q, seq_k=seq_k,
+        _flash_kernel, causal=causal, sm_scale=sm_scale,
+        block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
     )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(q, k, v)
 

@@ -15,6 +15,8 @@ RNG_STATE_NAME = "random_states"
 PARAMS_INDEX_NAME = "params_index.json"
 SAFE_WEIGHTS_NAME = "model.safetensors"
 SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "pytorch_model.bin"  # torch-ecosystem import (ref constants.py:16)
+WEIGHTS_INDEX_NAME = "pytorch_model.bin.index.json"
 CHECKPOINT_DIR_PREFIX = "checkpoint"
 
 # --- env-var protocol (ACCELERATE_*-style, ref utils/launch.py:76-400) ------

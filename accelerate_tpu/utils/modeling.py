@@ -1,0 +1,373 @@
+"""Model memory accounting, device-map planning, and checkpoint loading.
+
+TPU-native analogue of ref src/accelerate/utils/modeling.py (1815 LoC):
+
+- ``compute_module_sizes`` (ref :706-747) over a params pytree (concrete
+  arrays or ``jax.ShapeDtypeStruct`` from ``jax.eval_shape`` — the meta-device
+  trick without a meta device).
+- ``get_max_memory`` (ref :799-878) from live ``device.memory_stats()``.
+- ``infer_auto_device_map`` (ref :1084-1386): greedy fill device 0..N → cpu →
+  disk, respecting no-split prefixes. One TPU-specific twist: models here
+  stack their L layers on a leading dim for ``lax.scan``, so the planner
+  splits the stacked module into L virtual rows ``layers.{i}`` and dispatch
+  re-groups contiguous rows per device (sliced, not moved whole).
+- ``load_state_dict`` / ``load_checkpoint_in_model`` (ref :1413-1777):
+  streaming safetensors (per-tensor lazy reads via ``safe_open``) and torch
+  ``.bin`` import (torch→numpy), placing each tensor straight onto its target
+  from the device map — peak host memory stays one-tensor-sized for
+  safetensors checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import OrderedDict
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+from .constants import (
+    SAFE_WEIGHTS_INDEX_NAME,
+    SAFE_WEIGHTS_NAME,
+    WEIGHTS_INDEX_NAME,
+    WEIGHTS_NAME,
+)
+from .offload import offload_weight, save_offload_index
+from .other import flatten_dict, unflatten_dict
+
+_LAYER_ROW = re.compile(r"^(.*)\.(\d+)$")
+
+
+def dtype_byte_size(dtype) -> float:
+    """Bytes per element (ref utils/modeling.py:124-139); handles sub-byte
+    int4 (0.5)."""
+    name = str(np.dtype(dtype).name) if not hasattr(dtype, "name") else str(dtype.name)
+    if "int4" in name:
+        return 0.5
+    if name == "bool":
+        return 1.0
+    m = re.search(r"(\d+)$", name)
+    if not m:
+        raise ValueError(f"dtype {dtype} is not a valid dtype")
+    return int(m.group(1)) / 8
+
+
+def _leaf_bytes(leaf, dtype=None) -> int:
+    d = dtype if dtype is not None else leaf.dtype
+    return int(np.prod(leaf.shape) * dtype_byte_size(d)) if leaf.shape else int(dtype_byte_size(d))
+
+
+def compute_module_sizes(
+    params: Any, dtype=None, stacked_modules: Mapping[str, int] | None = None
+) -> dict[str, int]:
+    """Byte size of every module prefix (ref utils/modeling.py:706-747).
+
+    `params` may be concrete arrays or ShapeDtypeStructs. Stacked scan-layer
+    modules (detected via `find_stacked_modules`, or passed explicitly) also
+    get per-row entries ``module.{i}``.
+    """
+    flat = flatten_dict(params)
+    if stacked_modules is None:
+        stacked_modules = find_stacked_modules(params)
+    sizes: dict[str, int] = {}
+    for key, leaf in flat.items():
+        nbytes = _leaf_bytes(leaf, dtype)
+        parts = key.split(".")
+        for i in range(1, len(parts) + 1):
+            prefix = ".".join(parts[:i])
+            sizes[prefix] = sizes.get(prefix, 0) + nbytes
+        sizes[""] = sizes.get("", 0) + nbytes
+    for mod, n_rows in stacked_modules.items():
+        if mod in sizes and n_rows > 0:
+            per_row = sizes[mod] // n_rows
+            for i in range(n_rows):
+                sizes[f"{mod}.{i}"] = per_row
+    return sizes
+
+
+def find_stacked_modules(params: Any, min_rows: int = 2) -> dict[str, int]:
+    """Detect scan-stacked layer modules: a top-level subtree whose every leaf
+    shares the same leading dim (the layer count)."""
+    out: dict[str, int] = {}
+    if not isinstance(params, dict):
+        return out
+    for name, sub in params.items():
+        if not isinstance(sub, dict):
+            continue
+        leaves = jax.tree_util.tree_leaves(
+            sub, is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict)
+        )
+        leading = {l.shape[0] for l in leaves if getattr(l, "shape", ())}
+        if len(leaves) >= 2 and len(leading) == 1:
+            n = leading.pop()
+            if n >= min_rows:
+                out[name] = int(n)
+    return out
+
+
+def get_max_memory(max_memory: dict | None = None) -> "OrderedDict[Any, int]":
+    """{device_index: usable bytes, 'cpu': bytes, 'disk': inf}
+    (ref utils/modeling.py:799-878). Accepts '20GiB'-style strings."""
+    if max_memory is not None:
+        out: "OrderedDict[Any, int]" = OrderedDict()
+        for k, v in max_memory.items():
+            out[k] = _parse_mem(v)
+        out.setdefault("cpu", 0)
+        out.setdefault("disk", 2**62)
+        return out
+    out = OrderedDict()
+    local = jax.local_devices()
+    for i, dev in enumerate(local):
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            pass
+        limit = stats.get("bytes_limit")
+        in_use = stats.get("bytes_in_use", 0)
+        if limit is None:
+            # CPU backend reports nothing; all "devices" share host RAM, so
+            # split half of it across them (the other half stays for 'cpu')
+            limit, in_use = _host_ram() // (2 * len(local)), 0
+        # leave 10% headroom for XLA temporaries (ref leaves first-GPU slack)
+        out[i] = int((limit - in_use) * 0.9)
+    out["cpu"] = int(_host_ram() * 0.45)
+    out["disk"] = 2**62
+    return out
+
+
+def _host_ram() -> int:
+    try:
+        return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+    except (ValueError, OSError):
+        return 16 * 2**30
+
+
+def _parse_mem(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    units = {"KIB": 2**10, "MIB": 2**20, "GIB": 2**30, "KB": 10**3, "MB": 10**6, "GB": 10**9}
+    s = str(v).strip().upper()
+    for suffix, mult in sorted(units.items(), key=lambda kv: -len(kv[0])):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)]) * mult)
+    return int(s)
+
+
+def named_module_tensors(params: Any, module: str) -> dict[str, Any]:
+    """Flat {name: leaf} for one module prefix."""
+    flat = flatten_dict(params)
+    prefix = module + "." if module else ""
+    return {k: v for k, v in flat.items() if module == "" or k == module or k.startswith(prefix)}
+
+
+def infer_auto_device_map(
+    params: Any,
+    max_memory: dict | None = None,
+    no_split_modules: tuple = (),
+    dtype=None,
+    offload_buffers: bool = False,
+    verbose: bool = False,
+) -> "OrderedDict[str, Any]":
+    """Greedy device map: fill device 0..N-1, then 'cpu', then 'disk'
+    (ref utils/modeling.py:1084-1386).
+
+    Returns {module_name: device_index | 'cpu' | 'disk'}. Stacked scan-layer
+    modules are planned per virtual row (``layers.0`` … ``layers.{L-1}``) so a
+    model bigger than one device splits mid-stack; other modules are atomic
+    (the no-split analogue — a template's `no_split_module_classes` maps to
+    `no_split_modules` prefixes here).
+    """
+    if not isinstance(params, dict):
+        raise TypeError("params must be a (nested) dict pytree")
+    stacked = {
+        k: v for k, v in find_stacked_modules(params).items() if k not in no_split_modules
+    }
+    sizes = compute_module_sizes(params, dtype=dtype, stacked_modules=stacked)
+    memory = get_max_memory(max_memory)
+    devices = [k for k in memory if k not in ("cpu", "disk")] + ["cpu", "disk"]
+    free = {d: memory[d] for d in devices}
+
+    # planning units, in traversal order
+    units: list[str] = []
+    for name, sub in params.items():
+        if name in stacked:
+            units.extend(f"{name}.{i}" for i in range(stacked[name]))
+        else:
+            units.append(name)
+
+    device_map: "OrderedDict[str, Any]" = OrderedDict()
+    cursor = 0
+    for unit in units:
+        size = sizes[unit]
+        while cursor < len(devices) - 1 and free[devices[cursor]] < size:
+            cursor += 1
+        target = devices[cursor]
+        device_map[unit] = target
+        free[target] -= size
+        if verbose:
+            print(f"  {unit:40s} -> {target} ({size / 2**20:.1f} MiB)")
+    return device_map  # cursor loop makes 'disk' the unconditional sink
+
+
+def check_device_map(params: Any, device_map: Mapping[str, Any]) -> None:
+    """Every leaf must be covered by a device-map entry, and a stacked module
+    addressed per-row must have ALL rows covered (ref utils/modeling.py:1389-1412)."""
+    flat = flatten_dict(params)
+    stacked = find_stacked_modules(params)
+    row_entries: dict[str, set[int]] = {}
+    plain_entries: list[str] = []
+    for m in device_map:
+        rm = _LAYER_ROW.match(m)
+        if rm and rm.group(1) in stacked:
+            row_entries.setdefault(rm.group(1), set()).add(int(rm.group(2)))
+        else:
+            plain_entries.append(m)
+    for mod, rows in row_entries.items():
+        whole = any(mod == p or mod.startswith(p + ".") or p == "" for p in plain_entries)
+        missing = set(range(stacked[mod])) - rows
+        if missing and not whole:
+            raise ValueError(
+                f"stacked module {mod!r} addressed per-row but rows "
+                f"{sorted(missing)} have no device_map entry"
+            )
+        bad = {r for r in rows if r >= stacked[mod]}
+        if bad:
+            raise ValueError(f"device_map rows {sorted(bad)} out of range for {mod!r} "
+                             f"(has {stacked[mod]} rows)")
+    covered = set()
+    for key in flat:
+        hits = [
+            m
+            for m in device_map
+            if m == "" or key == m or key.startswith(m + ".") or _covers_row(m, key)
+        ]
+        if not hits:
+            raise ValueError(f"param {key!r} not covered by device_map")
+        covered.update(hits)
+    extra = set(device_map) - covered
+    if extra:
+        raise ValueError(f"device_map entries match no params: {sorted(extra)}")
+
+
+def _covers_row(map_key: str, param_key: str) -> bool:
+    """'layers.3' covers flat key 'layers.attn.q.kernel' row 3 (stacked)."""
+    m = _LAYER_ROW.match(map_key)
+    return bool(m) and param_key.startswith(m.group(1) + ".")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint reading (streaming)
+# ---------------------------------------------------------------------------
+
+
+def _torch_to_numpy(t) -> np.ndarray:
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        return t.view(torch.uint16).numpy().view("bfloat16") if hasattr(
+            np, "bfloat16"
+        ) else np.asarray(jax.numpy.asarray(t.float().numpy(), dtype="bfloat16"))
+    return t.numpy()
+
+
+def load_state_dict(checkpoint_file: str, keys: list[str] | None = None) -> dict[str, np.ndarray]:
+    """Read a checkpoint file to {name: np.ndarray}
+    (ref utils/modeling.py:1413-1504). safetensors reads lazily per key;
+    torch ``.bin`` falls back to a full CPU load."""
+    if checkpoint_file.endswith(".safetensors"):
+        from safetensors import safe_open
+
+        out = {}
+        with safe_open(checkpoint_file, framework="np") as f:
+            for k in keys if keys is not None else f.keys():
+                out[k] = f.get_tensor(k)
+        return out
+    import torch
+
+    sd = torch.load(checkpoint_file, map_location="cpu", weights_only=True)
+    if keys is not None:
+        sd = {k: sd[k] for k in keys}
+    return {k: _torch_to_numpy(v) for k, v in sd.items() if hasattr(v, "numpy")}
+
+
+def resolve_checkpoint_files(checkpoint: str) -> list[str]:
+    """A checkpoint path may be a single file, an index json, or a directory
+    (ref big_modeling.py:552-597)."""
+    if os.path.isfile(checkpoint):
+        if checkpoint.endswith(".json"):
+            folder = os.path.dirname(checkpoint)
+            with open(checkpoint) as f:
+                index = json.load(f)
+            return [os.path.join(folder, v) for v in sorted(set(index["weight_map"].values()))]
+        return [checkpoint]
+    if os.path.isdir(checkpoint):
+        for name in (SAFE_WEIGHTS_INDEX_NAME, WEIGHTS_INDEX_NAME):
+            p = os.path.join(checkpoint, name)
+            if os.path.exists(p):
+                return resolve_checkpoint_files(p)
+        for name in (SAFE_WEIGHTS_NAME, WEIGHTS_NAME):
+            p = os.path.join(checkpoint, name)
+            if os.path.exists(p):
+                return [p]
+        sts = sorted(
+            os.path.join(checkpoint, f)
+            for f in os.listdir(checkpoint)
+            if f.endswith(".safetensors")
+        )
+        if sts:
+            return sts
+    raise FileNotFoundError(f"no checkpoint found at {checkpoint}")
+
+
+def load_checkpoint_in_model(
+    params: Any,
+    checkpoint: str,
+    device_map: Mapping[str, Any] | None = None,
+    offload_folder: str | None = None,
+    dtype=None,
+    strict: bool = False,
+) -> tuple[Any, dict]:
+    """Stream a checkpoint into a params pytree laid out per `device_map`
+    (ref utils/modeling.py:1554-1777 + set_module_tensor_to_device :288-477).
+
+    `params` is the abstract (eval_shape) or concrete pytree giving structure
+    and expected shapes. Returns (loaded_params, disk_offload_index). Stacked
+    scan-layer modules whose rows map to several devices are assembled
+    host-side row-group by row-group, then device_put per contiguous group.
+    """
+    from ..big_modeling import _placement_plan, _place_flat  # shared with dispatch
+
+    flat_spec = flatten_dict(params)
+    files = resolve_checkpoint_files(checkpoint)
+    loaded: dict[str, Any] = {}
+    offload_index: dict = {}
+    missing = set(flat_spec)
+    for file in files:
+        sd = load_state_dict(file)
+        for name, tensor in sd.items():
+            if name not in flat_spec:
+                if strict:
+                    raise KeyError(f"unexpected key {name!r} in {file}")
+                continue
+            expected = tuple(flat_spec[name].shape)
+            if tuple(tensor.shape) != expected:
+                raise ValueError(
+                    f"shape mismatch for {name}: checkpoint {tensor.shape} vs model {expected}"
+                )
+            if dtype is not None and tensor.dtype != np.dtype(dtype):
+                tensor = tensor.astype(dtype)
+            loaded[name] = tensor
+            missing.discard(name)
+    if missing and strict:
+        raise KeyError(f"missing keys: {sorted(missing)}")
+    if device_map is None:
+        return unflatten_dict(loaded), {}
+    plan = _placement_plan(params, device_map)
+    placed, offload_index = _place_flat(loaded, plan, offload_folder)
+    if offload_index and offload_folder:
+        save_offload_index(offload_index, offload_folder)
+    return unflatten_dict(placed), offload_index

@@ -1,0 +1,244 @@
+"""Big-model inference stack tests (SURVEY.md §2.4; ref tests/test_big_modeling.py,
+test_modeling_utils.py, test_offload.py — meta init, device-map planner,
+dispatch, checkpoint streaming, disk offload, streamed forward)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.big_modeling import (
+    RowGroups,
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    load_checkpoint_and_dispatch,
+    streamed_forward,
+)
+from accelerate_tpu.checkpointing import save_model
+from accelerate_tpu.utils.modeling import (
+    compute_module_sizes,
+    dtype_byte_size,
+    find_stacked_modules,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+)
+from accelerate_tpu.utils.offload import (
+    OffloadedWeightsLoader,
+    load_offload_index,
+    offload_state_dict,
+)
+
+L, D, V = 6, 8, 32
+
+
+def tiny_init(key):
+    keys = jax.random.split(key, 4)
+    return {
+        "embed": {"embedding": jax.random.normal(keys[0], (V, D))},
+        "layers": {
+            "w1": jax.random.normal(keys[1], (L, D, 4 * D)),
+            "w2": jax.random.normal(keys[2], (L, 4 * D, D)),
+        },
+        "head": {"kernel": jax.random.normal(keys[3], (D, V))},
+    }
+
+
+def tiny_forward(params, ids):
+    x = params["embed"]["embedding"][ids]
+
+    def body(x, layer):
+        return x + jnp.tanh(x @ layer["w1"]) @ layer["w2"], None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x @ params["head"]["kernel"]
+
+
+def test_init_empty_weights_allocates_nothing():
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+    leaf = abstract["layers"]["w1"]
+    assert isinstance(leaf, jax.ShapeDtypeStruct)
+    assert leaf.shape == (L, D, 4 * D)
+
+
+def test_find_stacked_and_sizes():
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+    stacked = find_stacked_modules(abstract)
+    assert stacked == {"layers": L}
+    sizes = compute_module_sizes(abstract)
+    assert sizes["layers.0"] == sizes["layers"] // L
+    assert sizes[""] == sizes["embed"] + sizes["layers"] + sizes["head"]
+    assert dtype_byte_size(jnp.bfloat16) == 2
+
+
+def test_infer_auto_device_map_splits_layers():
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+    sizes = compute_module_sizes(abstract)
+    # room for embed + head + 3 layers on device 0, rest spills to cpu
+    # (planning order is pytree order: embed, head, layers.*)
+    budget = sizes["embed"] + sizes["head"] + 3 * sizes["layers.0"] + 100
+    dmap = infer_auto_device_map(abstract, max_memory={0: budget, "cpu": 2**40})
+    assert dmap["embed"] == 0
+    assert dmap["head"] == 0
+    assert dmap["layers.0"] == 0
+    assert dmap["layers.5"] == "cpu"
+    targets = {dmap[f"layers.{i}"] for i in range(L)}
+    assert targets == {0, "cpu"}
+
+
+def test_get_max_memory_parses_strings():
+    mm = get_max_memory({0: "1GiB", "cpu": "500MB"})
+    assert mm[0] == 2**30 and mm["cpu"] == 500 * 10**6
+
+
+def test_partial_row_map_rejected():
+    params = tiny_init(jax.random.key(0))
+    # rows 1..L-1 uncovered must raise, not silently go to cpu
+    with pytest.raises(ValueError, match="addressed per-row"):
+        dispatch_model(params, {"embed": 0, "head": 0, "layers.0": 0})
+
+
+def test_row_key_on_unstacked_module_rejected():
+    params = tiny_init(jax.random.key(0))
+    dmap = {"embed.0": 0, "head": 0, "layers": 0}
+    with pytest.raises(ValueError):
+        dispatch_model(params, dmap)
+
+
+def test_scalar_offload_roundtrip(tmp_path):
+    from accelerate_tpu.utils.offload import load_offloaded_weight, offload_weight
+
+    idx = {}
+    offload_weight(np.float32(3.0), "s", str(tmp_path), idx)
+    back = load_offloaded_weight(str(tmp_path / "s.dat"), idx["s"])
+    assert back.shape == () and float(back) == 3.0
+
+
+def test_dispatch_sharded_runs_in_jit():
+    params = tiny_init(jax.random.key(0))
+    dispatched = dispatch_model(params, "sharded")
+    ids = jnp.arange(8, dtype=jnp.int32)[None]
+    ref = tiny_forward(params, ids)
+    out = jax.jit(tiny_forward)(dispatched, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_dispatch_rowgroups_and_streamed_forward(tmp_path):
+    params = tiny_init(jax.random.key(0))
+    dmap = {"embed": 0, "head": 0}
+    dmap.update({f"layers.{i}": (0 if i < 2 else ("cpu" if i < 4 else "disk")) for i in range(L)})
+    dispatched = dispatch_model(params, dmap, offload_folder=str(tmp_path))
+    w1 = dispatched["layers"]["w1"]
+    assert isinstance(w1, RowGroups)
+    kinds = [type(a) for _, _, a in w1.groups]
+    assert len(w1.groups) == 3
+    # disk rows are memmaps
+    assert isinstance(w1.groups[-1][2], np.memmap)
+    np.testing.assert_allclose(np.asarray(w1.row(3)), np.asarray(params["layers"]["w1"][3]))
+
+    ids = jnp.arange(8, dtype=jnp.int32)[None]
+    ref = tiny_forward(params, ids)
+
+    layer_step = jax.jit(
+        lambda layer, x: x + jnp.tanh(x @ layer["w1"]) @ layer["w2"]
+    )
+    out = streamed_forward(
+        dispatched,
+        ids,
+        embed_fn=lambda res, i: res["embed"]["embedding"][i],
+        layer_fn=lambda layer, x, i: layer_step(layer, x),
+        final_fn=lambda res, x: x @ res["head"]["kernel"],
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_cpu_and_disk_offload(tmp_path):
+    params = tiny_init(jax.random.key(0))
+    off = cpu_offload(params, keep_modules=("head",))
+    assert isinstance(off["embed"]["embedding"], np.ndarray)
+    assert isinstance(off["head"]["kernel"], jax.Array)
+    doff = disk_offload(params, str(tmp_path), keep_modules=("embed",))
+    assert isinstance(doff["layers"]["w1"], np.memmap)
+    idx = load_offload_index(str(tmp_path))
+    assert "layers.w1" in idx
+
+
+def test_offloaded_weights_loader(tmp_path):
+    sd = {"a": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones((4,), np.int32)}
+    offload_state_dict(str(tmp_path), sd)
+    loader = OffloadedWeightsLoader(
+        state_dict={"c": np.zeros(2)}, offload_folder=str(tmp_path)
+    )
+    assert set(loader) == {"a", "b", "c"}
+    np.testing.assert_array_equal(np.asarray(loader["a"]), sd["a"])
+    assert len(loader) == 3
+
+
+def test_load_checkpoint_and_dispatch_roundtrip(tmp_path):
+    params = tiny_init(jax.random.key(0))
+    ckpt_dir = tmp_path / "ckpt"
+    save_model(params, str(ckpt_dir))
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+
+    loaded, _ = load_checkpoint_in_model(abstract, str(ckpt_dir))
+    np.testing.assert_allclose(
+        np.asarray(loaded["head"]["kernel"]), np.asarray(params["head"]["kernel"])
+    )
+
+    # with a device map spilling to cpu+disk, streamed forward still matches
+    dmap = {"embed": 0, "head": "cpu"}
+    dmap.update({f"layers.{i}": ("cpu" if i % 2 else "disk") for i in range(L)})
+    dispatched = load_checkpoint_and_dispatch(
+        abstract, str(ckpt_dir), device_map=dmap, offload_folder=str(tmp_path / "off")
+    )
+    ids = jnp.arange(4, dtype=jnp.int32)[None]
+    ref = tiny_forward(params, ids)
+    layer_step = jax.jit(lambda layer, x: x + jnp.tanh(x @ layer["w1"]) @ layer["w2"])
+    out = streamed_forward(
+        dispatched,
+        ids,
+        embed_fn=lambda res, i: res["embed"]["embedding"][i],
+        layer_fn=lambda layer, x, i: layer_step(layer, x),
+        final_fn=lambda res, x: x @ res["head"]["kernel"],
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_llama_forward_offloaded_matches_forward(tmp_path):
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    dmap = {"embed_tokens": 0, "norm": 0, "lm_head": 0}
+    n = cfg.num_hidden_layers
+    dmap.update({f"layers.{i}": ("disk" if i >= n - 1 else "cpu") for i in range(n)})
+    dispatched = dispatch_model(params, dmap, offload_folder=str(tmp_path))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    ref = llama.forward(cfg, params, ids)
+    out = llama.forward_offloaded(cfg, dispatched, ids, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_load_checkpoint_shape_mismatch_raises(tmp_path):
+    params = tiny_init(jax.random.key(0))
+    save_model(params, str(tmp_path / "ckpt"))
+    bad = init_empty_weights(tiny_init, jax.random.key(0))
+    bad["head"]["kernel"] = jax.ShapeDtypeStruct((D, V + 1), jnp.float32)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_checkpoint_in_model(bad, str(tmp_path / "ckpt"))
+
+
+def test_torch_bin_import(tmp_path):
+    torch = pytest.importorskip("torch")
+    sd = {"embed.embedding": torch.randn(V, D), "head.kernel": torch.randn(D, V)}
+    path = tmp_path / "pytorch_model.bin"
+    torch.save(sd, str(path))
+    from accelerate_tpu.utils.modeling import load_state_dict
+
+    out = load_state_dict(str(path))
+    assert out["embed.embedding"].shape == (V, D)
+    np.testing.assert_allclose(out["head.kernel"], sd["head.kernel"].numpy())
