@@ -1,0 +1,174 @@
+"""Notebook / debug launchers.
+
+TPU-native analogue of ref src/accelerate/launchers.py:
+
+- `notebook_launcher` (ref launchers.py:38-224): the reference forks one
+  process per TPU core with `xmp.spawn`. Under JAX one process drives every
+  local chip through one GSPMD mesh, so inside a notebook there is nothing to
+  fork — we validate state and run the function in-process. A multi-process
+  CPU world (for teaching/debugging distributed semantics without hardware)
+  is still available via ``num_processes > 1`` on a CPU backend, which
+  delegates to the same machinery as `debug_launcher`.
+- `debug_launcher` (ref launchers.py:225-257): the reference starts an
+  N-process gloo world on localhost. Ours starts N real OS processes that
+  rendezvous through `jax.distributed.initialize` on a localhost coordinator
+  with the CPU backend — genuine multi-process semantics (process_count == N)
+  with no accelerator, the drop-in for testing cross-host code paths.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import traceback
+from typing import Any, Callable
+
+from .state import AcceleratorState, PartialState
+from .utils.constants import (
+    ENV_COORDINATOR,
+    ENV_MIXED_PRECISION,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_worker(rank: int, world: int, port: int, host_devices: int,
+                  function: Callable, args: tuple, error_queue) -> None:
+    """Child entrypoint: force the CPU platform (beating any PJRT plugin the
+    image's sitecustomize registered programmatically), join the localhost
+    world, run the user function."""
+    try:
+        os.environ[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        os.environ[ENV_NUM_PROCESSES] = str(world)
+        os.environ[ENV_PROCESS_ID] = str(rank)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        if host_devices > 1:
+            from .utils.environment import set_virtual_host_devices
+
+            set_virtual_host_devices(host_devices)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        PartialState._reset_state()
+        function(*args)
+    except Exception:
+        error_queue.put((rank, traceback.format_exc()))
+        sys.exit(1)
+
+
+def debug_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: int = 2,
+    devices_per_process: int = 1,
+) -> None:
+    """Launch `function` in an N-process localhost CPU world
+    (ref launchers.py:225-257).
+
+    Each process sees `jax.process_count() == num_processes` and
+    ``devices_per_process`` virtual CPU devices, so both host-collective and
+    mesh-sharding code paths run for real. `function` must be picklable
+    (module-level), the same constraint the reference's spawn imposes.
+    """
+    import multiprocessing
+    import time
+
+    ctx = multiprocessing.get_context("spawn")
+    for attempt in range(3):  # retry: _free_port has an inherent TOCTOU window
+        port = _free_port()
+        error_queue = ctx.SimpleQueue()
+        procs = []
+        for rank in range(num_processes):
+            p = ctx.Process(
+                target=_spawn_worker,
+                args=(rank, num_processes, port, devices_per_process,
+                      function, args, error_queue),
+            )
+            p.start()
+            procs.append(p)
+        # Monitor instead of joining sequentially: a worker crashing out of a
+        # collective leaves its peers blocked in rendezvous forever, so on the
+        # first failure the survivors are terminated (the reference inherits
+        # this from torch's ProcessContext.join).
+        failed = False
+        while any(p.is_alive() for p in procs):
+            if any(p.exitcode not in (0, None) for p in procs):
+                failed = True
+                time.sleep(1.0)  # grace: let peers flush their own tracebacks
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                break
+            time.sleep(0.05)
+        for p in procs:
+            p.join()
+        failed = failed or any(p.exitcode != 0 for p in procs)
+        if not failed:
+            return
+        msgs = []
+        while not error_queue.empty():
+            rank, tb = error_queue.get()
+            msgs.append(f"--- process {rank} ---\n{tb}")
+        joined = "\n".join(msgs)
+        low = joined.lower()
+        # only genuine coordinator bind failures qualify for a retry — a loose
+        # match would re-run a side-effecting user function on unrelated errors
+        port_clash = "address already in use" in low or "failed to bind" in low
+        if port_clash and attempt < 2:
+            continue  # coordinator port was stolen between probe and bind
+        n_failed = sum(1 for p in procs if p.exitcode != 0)
+        raise RuntimeError(
+            f"{n_failed}/{num_processes} launched processes failed:\n{joined}"
+        )
+
+
+def notebook_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: int | None = None,
+    mixed_precision: str | None = None,
+    use_port: str | int | None = None,  # ref API parity; localhost port auto-picked
+    master_addr: str | None = None,     # ref API parity
+    node_rank: int = 0,                 # ref API parity
+    num_nodes: int = 1,                 # ref API parity
+) -> Any:
+    """Run a training function from a notebook (ref launchers.py:38-224).
+
+    On TPU (and any single-host JAX runtime) the function runs in-process —
+    one process already drives all local chips via the mesh, where the
+    reference had to `xmp.spawn` eight child processes. `num_processes > 1`
+    on a CPU-only host spawns a localhost debug world instead (the
+    reference's CPU `start_processes` path).
+    """
+    if AcceleratorState._shared_state and num_processes not in (None, 0, 1):
+        # ref launchers.py:89-97: can't fork after the runtime is initialized.
+        raise RuntimeError(
+            "AcceleratorState is already initialized in this notebook; "
+            "restart the kernel (or avoid creating an Accelerator before "
+            "notebook_launcher) to launch a multi-process world."
+        )
+    if mixed_precision is not None:
+        # explicit arg wins over any stale value from a previous launch;
+        # default None leaves an env-configured precision untouched
+        os.environ[ENV_MIXED_PRECISION] = str(mixed_precision)
+
+    import jax
+
+    platform = None
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        pass
+
+    if num_processes in (None, 0, 1) or platform in ("tpu", "gpu"):
+        # One process drives all chips; just run it.
+        return function(*args)
+    debug_launcher(function, args=args, num_processes=num_processes)
+    return None

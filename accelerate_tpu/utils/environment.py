@@ -49,6 +49,25 @@ def get_int_from_env(keys, default: int | None = None) -> int | None:
     return default
 
 
+def set_virtual_host_devices(n: int, env: dict | None = None) -> None:
+    """Set (substituting any existing count) the XLA flag that fakes ``n``
+    host CPU devices — the no-hardware stand-in for a TPU slice
+    (SURVEY.md §4: replaces the reference's gloo debug_launcher worlds).
+
+    Must run before the process's JAX backend initializes.
+    """
+    import re
+
+    env = os.environ if env is None else env
+    flags = env.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", want, flags)
+    else:
+        flags = f"{flags} {want}".strip()
+    env["XLA_FLAGS"] = flags
+
+
 @contextlib.contextmanager
 def patch_environment(**kwargs: Any) -> Iterator[None]:
     """Temporarily set env vars; restores previous values on exit
