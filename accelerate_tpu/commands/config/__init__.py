@@ -1,0 +1,60 @@
+"""`accelerate-tpu config` — write/inspect the default launch config
+(ref src/accelerate/commands/config/, ~1600 LoC)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .config_args import LaunchConfig, default_config_path, load_config
+from .default import write_basic_config
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "config", help="Create or show the default launch configuration"
+    )
+    parser.add_argument(
+        "--config_file", default=None,
+        help=f"Where to write/read the config (default {default_config_path()})",
+    )
+    parser.add_argument(
+        "--default", action="store_true",
+        help="Write a non-interactive basic config for this host "
+             "(ref commands/config/default.py write_basic_config)",
+    )
+    parser.add_argument(
+        "--show", action="store_true", help="Print the resolved config and exit"
+    )
+    parser.add_argument("--mixed_precision", default=None)
+    parser.add_argument("--mesh_shape", default=None)
+    parser.set_defaults(func=config_command)
+
+
+def config_command(args: argparse.Namespace) -> int:
+    if args.show:
+        config = load_config(args.config_file)
+        print(config.to_yaml() if config else "(no config file found)")
+        return 0
+    if args.default:
+        path = write_basic_config(
+            config_file=args.config_file,
+            mixed_precision=args.mixed_precision,
+            mesh_shape=args.mesh_shape,
+        )
+        print(f"Config written to {path}")
+        return 0
+    from .cluster import interactive_config
+
+    config = interactive_config()
+    path = config.save(args.config_file)
+    print(f"Config written to {path}")
+    return 0
+
+
+__all__ = [
+    "LaunchConfig",
+    "default_config_path",
+    "load_config",
+    "register_subcommand",
+    "write_basic_config",
+]

@@ -1,0 +1,47 @@
+"""Interactive questionnaire (ref commands/config/cluster.py, 717 LoC).
+
+The reference walks ~40 questions across DDP/FSDP/DeepSpeed/Megatron/TPU/
+SageMaker. One GSPMD mesh replaces that plugin zoo, so the questionnaire
+collapses to topology + precision + mesh axes.
+"""
+
+from __future__ import annotations
+
+from .config_args import LaunchConfig
+
+
+def _ask(prompt: str, default: str = "", cast=str):
+    suffix = f" [{default}]" if default != "" else ""
+    raw = input(f"{prompt}{suffix}: ").strip()
+    if not raw:
+        raw = str(default)
+    return cast(raw) if raw != "" else None
+
+
+def _ask_bool(prompt: str, default: bool = False) -> bool:
+    raw = input(f"{prompt} [{'yes' if default else 'no'}]: ").strip().lower()
+    if not raw:
+        return default
+    return raw in ("y", "yes", "true", "1")
+
+
+def interactive_config() -> LaunchConfig:
+    print("accelerate-tpu config — answer a few questions (enter = default)\n")
+    num_machines = _ask("How many hosts (TPU VM workers) will you launch on?", "1", int)
+    config = LaunchConfig(num_machines=num_machines)
+    if num_machines > 1:
+        config.distributed_type = "MULTI_HOST"
+        config.main_process_ip = _ask("Coordinator (host 0) IP", "127.0.0.1")
+        config.main_process_port = _ask("Coordinator port", "29500", int)
+        config.machine_rank = _ask("Rank of this host", "0", int)
+    config.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
+    mesh = _ask(
+        "Mesh shape (e.g. 'data=-1', 'fsdp=8,model=4'; enter for pure data-parallel)",
+        "",
+    )
+    config.mesh_shape = mesh or None
+    config.gradient_accumulation_steps = _ask(
+        "Gradient accumulation steps", "1", int
+    )
+    config.debug = _ask_bool("Enable collective shape-checking debug mode?", False)
+    return config

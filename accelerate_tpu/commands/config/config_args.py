@@ -1,0 +1,85 @@
+"""Launch-config store (ref src/accelerate/commands/config/config_args.py:33-45).
+
+The reference keeps a YAML at ~/.cache/huggingface/accelerate/default_config.yaml
+merged under CLI args by `_validate_launch_command`. Same precedence here:
+explicit CLI args > env > this YAML.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import yaml
+
+CACHE_DIR = Path(
+    os.environ.get("ACCELERATE_TPU_CONFIG_HOME")
+    or Path.home() / ".cache" / "accelerate_tpu"
+)
+DEFAULT_CONFIG_NAME = "default_config.yaml"
+
+
+def default_config_path() -> Path:
+    return CACHE_DIR / DEFAULT_CONFIG_NAME
+
+
+@dataclass
+class LaunchConfig:
+    """Fields mirror the reference's cluster config where they still mean
+    something on a JAX runtime; torchrun/DeepSpeed/SageMaker-only knobs have
+    no equivalent (one process per host, no elastic agent)."""
+
+    compute_environment: str = "LOCAL_MACHINE"
+    distributed_type: str = "TPU"       # TPU | MULTI_HOST | CPU
+    num_machines: int = 1
+    machine_rank: int = 0
+    main_process_ip: str | None = None
+    main_process_port: int | None = None
+    mixed_precision: str | None = "bf16"
+    mesh_shape: str | None = None        # e.g. "data=-1" / "fsdp=8,model=4"
+    gradient_accumulation_steps: int | None = None
+    num_virtual_devices: int | None = None  # CPU-mesh debugging worlds
+    use_cpu: bool = False
+    debug: bool = False
+    tpu_name: str | None = None
+    tpu_zone: str | None = None
+    tpu_project: str | None = None
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {k: v for k, v in out.items() if v is not None}
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    def save(self, config_file: str | os.PathLike | None = None) -> Path:
+        path = Path(config_file) if config_file else default_config_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(self.to_yaml())
+        return path
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "LaunchConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"Unknown config keys {sorted(unknown)}; valid keys: {sorted(known)}"
+            )
+        return cls(**data)
+
+    @classmethod
+    def load(cls, config_file: str | os.PathLike | None = None) -> "LaunchConfig":
+        path = Path(config_file) if config_file else default_config_path()
+        data = yaml.safe_load(path.read_text()) or {}
+        return cls.from_dict(data)
+
+
+def load_config(config_file: str | os.PathLike | None = None) -> LaunchConfig | None:
+    """Load the config if present, else None (launch falls back to pure CLI)."""
+    path = Path(config_file) if config_file else default_config_path()
+    if not path.is_file():
+        return None
+    return LaunchConfig.load(path)

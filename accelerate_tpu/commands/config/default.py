@@ -1,0 +1,30 @@
+"""Non-interactive basic config (ref commands/config/default.py
+write_basic_config)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from .config_args import LaunchConfig
+
+
+def write_basic_config(
+    mixed_precision: str | None = None,
+    mesh_shape: str | None = None,
+    config_file: str | os.PathLike | None = None,
+) -> Path:
+    """Probe this host's JAX runtime and write a sane single-host config."""
+    import jax
+
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        platform = "cpu"
+    config = LaunchConfig(
+        distributed_type="TPU" if platform == "tpu" else "CPU",
+        use_cpu=platform == "cpu",
+        mixed_precision=mixed_precision or ("bf16" if platform == "tpu" else "no"),
+        mesh_shape=mesh_shape,
+    )
+    return config.save(config_file)

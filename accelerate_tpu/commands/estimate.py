@@ -1,0 +1,207 @@
+"""`accelerate-tpu estimate` — model memory estimator
+(ref src/accelerate/commands/estimate.py:34-309).
+
+The reference downloads a hub config and builds the model on the meta device.
+This environment is offline-first, so three sources are supported:
+
+- a built-in family preset (``llama-7b``, ``mixtral-8x7b``, ``bert-base`` ...)
+  whose parameter pytree is shape-evaluated with `jax.eval_shape` (zero FLOPs,
+  zero bytes — the meta-device equivalent);
+- a local checkpoint dir with a safetensors index / files (sizes summed from
+  tensor headers, no weights read);
+- a local HF ``config.json`` of a llama/bert/mixtral-architecture model,
+  mapped onto the matching built-in config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+_DTYPES = {"float32": 4.0, "bfloat16": 2.0, "float16": 2.0, "int8": 1.0, "int4": 0.5}
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "estimate", help="Estimate memory needed to load/train a model"
+    )
+    parser.add_argument(
+        "model_name",
+        help="Built-in preset (e.g. llama-7b, mixtral-8x7b, bert-base) or a "
+             "local checkpoint/config dir",
+    )
+    parser.add_argument(
+        "--dtypes", nargs="+", default=list(_DTYPES),
+        choices=list(_DTYPES),
+    )
+    parser.set_defaults(func=estimate_command)
+
+
+# -- parameter counting -------------------------------------------------------
+
+PRESETS = {
+    "bert-base": ("bert", dict()),
+    "bert-large": ("bert", dict(hidden_size=1024, num_hidden_layers=24,
+                                num_attention_heads=16, intermediate_size=4096)),
+    "llama-1b": ("llama", dict(hidden_size=2048, intermediate_size=5632,
+                               num_hidden_layers=16, num_attention_heads=32,
+                               num_key_value_heads=8)),
+    "llama-7b": ("llama", dict(hidden_size=4096, intermediate_size=11008,
+                               num_hidden_layers=32, num_attention_heads=32,
+                               num_key_value_heads=32)),
+    "llama-8b": ("llama", dict(hidden_size=4096, intermediate_size=14336,
+                               num_hidden_layers=32, num_attention_heads=32,
+                               num_key_value_heads=8, vocab_size=128256)),
+    "llama-70b": ("llama", dict(hidden_size=8192, intermediate_size=28672,
+                                num_hidden_layers=80, num_attention_heads=64,
+                                num_key_value_heads=8)),
+    "mixtral-8x7b": ("mixtral", dict(hidden_size=4096, intermediate_size=14336,
+                                     num_hidden_layers=32, num_attention_heads=32,
+                                     num_key_value_heads=8, num_local_experts=8)),
+}
+
+
+def _family_param_tree(family: str, overrides: dict):
+    """Shape-only parameter pytree (jax.eval_shape ~ meta-device init,
+    ref big_modeling.py:56-166)."""
+    import jax
+
+    if family == "llama":
+        from ..models import llama as mod
+        config = mod.LlamaConfig(**overrides) if overrides else mod.LlamaConfig()
+    elif family == "bert":
+        from ..models import bert as mod
+        config = mod.BertConfig(**overrides) if overrides else mod.BertConfig()
+    elif family == "mixtral":
+        from ..models import mixtral as mod
+        config = mod.MixtralConfig(**overrides) if overrides else mod.MixtralConfig()
+    else:
+        raise ValueError(f"unknown family {family}")
+    return jax.eval_shape(lambda: mod.init_params(config, jax.random.key(0)))
+
+
+def _tree_sizes(tree) -> tuple[int, dict[str, int]]:
+    """(total_param_count, per-top-module param counts)."""
+    import jax
+
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(tree)[0]
+    total = 0
+    per_module: dict[str, int] = {}
+    for path, leaf in leaves_with_path:
+        n = 1
+        for d in leaf.shape:
+            n *= d
+        total += n
+        top = str(path[0].key if hasattr(path[0], "key") else path[0])
+        per_module[top] = per_module.get(top, 0) + n
+    return total, per_module
+
+
+def _from_safetensors_dir(path: Path) -> tuple[int, dict[str, int]] | None:
+    files = sorted(path.glob("*.safetensors"))
+    if not files:
+        return None
+    total = 0
+    per_module: dict[str, int] = {}
+    for f in files:
+        with open(f, "rb") as fh:
+            header_len = int.from_bytes(fh.read(8), "little")
+            header = json.loads(fh.read(header_len))
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            n = 1
+            for d in meta["shape"]:
+                n *= d
+            total += n
+            top = name.split(".")[0]
+            per_module[top] = per_module.get(top, 0) + n
+    return total, per_module
+
+
+_HF_ARCH_FAMILY = {"llama": "llama", "mistral": "llama", "bert": "bert",
+                   "mixtral": "mixtral"}
+
+_HF_CONFIG_KEYS = (
+    "vocab_size", "hidden_size", "intermediate_size", "num_hidden_layers",
+    "num_attention_heads", "num_key_value_heads", "num_local_experts",
+)
+
+
+def _from_hf_config(path: Path) -> tuple[int, dict[str, int]] | None:
+    config_file = path / "config.json"
+    if not config_file.is_file():
+        return None
+    data = json.loads(config_file.read_text())
+    family = _HF_ARCH_FAMILY.get(str(data.get("model_type", "")).lower())
+    if family is None:
+        raise ValueError(
+            f"Unsupported architecture {data.get('model_type')!r}; provide a "
+            "safetensors checkpoint dir instead"
+        )
+    overrides = {k: data[k] for k in _HF_CONFIG_KEYS if k in data}
+    if family != "mixtral":
+        overrides.pop("num_local_experts", None)
+    tree = _family_param_tree(family, overrides)
+    return _tree_sizes(tree)
+
+
+def count_model_params(model_name: str) -> tuple[int, dict[str, int]]:
+    if model_name in PRESETS:
+        family, overrides = PRESETS[model_name]
+        return _tree_sizes(_family_param_tree(family, overrides))
+    path = Path(model_name)
+    if path.is_dir():
+        result = _from_safetensors_dir(path) or _from_hf_config(path)
+        if result is not None:
+            return result
+        raise ValueError(
+            f"{path} contains neither *.safetensors files nor a config.json"
+        )
+    raise ValueError(
+        f"Unknown model {model_name!r}: not a preset "
+        f"({', '.join(PRESETS)}) and not a local directory"
+    )
+
+
+def _human(num_bytes: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(num_bytes) < 1024:
+            return f"{num_bytes:.2f} {unit}"
+        num_bytes /= 1024
+    return f"{num_bytes:.2f} PB"
+
+
+def estimate_table(model_name: str, dtypes: list[str]) -> list[dict]:
+    total, per_module = count_model_params(model_name)
+    largest = max(per_module.values()) if per_module else total
+    rows = []
+    for dtype in dtypes:
+        bytes_per = _DTYPES[dtype]
+        # Adam training: params + grads (same dtype) + fp32 master + 2 fp32
+        # moments (ref estimate.py's "training using Adam" = 4x model size for
+        # fp32; dtype-aware here)
+        train_bytes = total * (2 * bytes_per + 12.0)
+        rows.append({
+            "dtype": dtype,
+            "largest_layer": largest * bytes_per,
+            "total_size": total * bytes_per,
+            "training_with_adam": train_bytes,
+        })
+    return rows
+
+
+def estimate_command(args: argparse.Namespace) -> int:
+    rows = estimate_table(args.model_name, args.dtypes)
+    total_params = count_model_params(args.model_name)[0]
+    print(f"Model: {args.model_name} — {total_params / 1e6:,.1f}M params")
+    header = f"{'dtype':>10} | {'largest layer':>14} | {'total size':>12} | {'training w/ Adam':>17}"
+    print(header)
+    print("-" * len(header))
+    for row in rows:
+        print(
+            f"{row['dtype']:>10} | {_human(row['largest_layer']):>14} | "
+            f"{_human(row['total_size']):>12} | {_human(row['training_with_adam']):>17}"
+        )
+    return 0

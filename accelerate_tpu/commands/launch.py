@@ -1,0 +1,182 @@
+"""`accelerate-tpu launch` (ref src/accelerate/commands/launch.py, 1101 LoC).
+
+The reference dispatches between six launchers (simple/torchrun/deepspeed/
+xmp.spawn/xla_dist-SSH/sagemaker, ref :690-899). Under JAX exactly three
+remain meaningful:
+
+- **simple**: one process drives every local chip through the mesh — the
+  common TPU case (replaces both `simple_launcher` :690 and `tpu_launcher`
+  :790, since there is nothing to fork per core).
+- **local world**: N processes on this host over a localhost coordinator with
+  virtual CPU devices — the debugging world (replaces `multi_gpu_launcher`'s
+  single-node torchrun use).
+- **pod**: SSH fan-out over TPU VM workers via gcloud, each worker re-running
+  the simple launcher; JAX rediscovers topology from the metadata server
+  (replaces `tpu_pod_launcher` :821 / xla_dist).
+
+Precedence: explicit CLI args > env > yaml config (ref
+`_validate_launch_command` :900-1065).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from ..utils.launch import (
+    build_script_cmd,
+    build_tpu_pod_ssh_cmd,
+    merged_child_env,
+    pod_relaunch_command,
+    prepare_launch_env,
+    prepare_multihost_env,
+)
+from .config.config_args import LaunchConfig, load_config
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "launch", help="Launch a training script on this host or a TPU pod"
+    )
+    add_launch_arguments(parser)
+    parser.set_defaults(func=launch_command)
+
+
+def add_launch_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--config_file", default=None,
+                        help="YAML config (default: ~/.cache/accelerate_tpu/)")
+    # topology
+    parser.add_argument("--num_machines", type=int, default=None,
+                        help="Number of host processes in the world")
+    parser.add_argument("--machine_rank", type=int, default=None,
+                        help="Rank of this host (multi-host)")
+    parser.add_argument("--main_process_ip", default=None)
+    parser.add_argument("--main_process_port", type=int, default=None)
+    parser.add_argument("--num_processes", type=int, default=None,
+                        help="Spawn a local N-process world on this host "
+                             "(CPU debugging; TPU runs one process per host)")
+    parser.add_argument("--num_virtual_devices", type=int, default=None,
+                        help="Fake N CPU devices per process (no-hardware mesh)")
+    # behavior
+    parser.add_argument("--mixed_precision", default=None,
+                        choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--mesh_shape", default=None,
+                        help="e.g. 'data=-1' or 'fsdp=8,model=4'")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--cpu", "--use_cpu", dest="cpu", action="store_true",
+                        default=None, help="Force the CPU backend")
+    parser.add_argument("--debug", action="store_true", default=None,
+                        help="Collective shape-checking debug mode")
+    # pod
+    parser.add_argument("--tpu_name", default=None,
+                        help="Cloud TPU name: fan launch out to all pod workers")
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--tpu_project", default=None)
+    # script
+    parser.add_argument("--module", "-m", action="store_true",
+                        help="Treat the script as an importable module")
+    parser.add_argument("--no_python", action="store_true",
+                        help="Script is an executable, not a python file")
+    parser.add_argument("training_script",
+                        help="Script (or module with -m) to launch")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER,
+                        help="Args forwarded to the script")
+
+
+def _merge_config(args: argparse.Namespace) -> argparse.Namespace:
+    """yaml fills any CLI arg the user left unset (ref :900-1065)."""
+    config = load_config(args.config_file)
+    if config is None:
+        return args
+    for field_name in (
+        "num_machines", "machine_rank", "main_process_ip", "main_process_port",
+        "mixed_precision", "mesh_shape", "gradient_accumulation_steps",
+        "num_virtual_devices", "debug", "tpu_name", "tpu_zone", "tpu_project",
+    ):
+        if getattr(args, field_name, None) is None:
+            setattr(args, field_name, getattr(config, field_name, None))
+    if args.cpu is None and config.use_cpu:
+        args.cpu = True
+    return args
+
+
+def simple_launcher(args: argparse.Namespace) -> int:
+    """One child process drives all local chips (ref simple_launcher :690)."""
+    env = prepare_multihost_env(args)
+    cmd = build_script_cmd(args)
+    proc = subprocess.run(cmd, env=merged_child_env(env))
+    return proc.returncode
+
+
+def local_world_launcher(args: argparse.Namespace) -> int:
+    """N host processes on localhost rendezvousing via the JAX coordinator —
+    the reference's single-node torchrun/debug path, minus torchrun."""
+    import socket
+
+    num = args.num_processes
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base_env = prepare_launch_env(args)
+    cmd = build_script_cmd(args)
+    procs = []
+    from ..utils.constants import (
+        ENV_COORDINATOR,
+        ENV_CPU,
+        ENV_NUM_PROCESSES,
+        ENV_PROCESS_ID,
+    )
+
+    for rank in range(num):
+        env = dict(base_env)
+        env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+        env[ENV_NUM_PROCESSES] = str(num)
+        env[ENV_PROCESS_ID] = str(rank)
+        # PartialState in the child forces the CPU platform through the
+        # config API (env alone loses to programmatically-pinned plugins)
+        env[ENV_CPU] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append(subprocess.Popen(cmd, env=merged_child_env(env)))
+    code = 0
+    try:
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return code
+
+
+def tpu_pod_launcher(args: argparse.Namespace, dry_run: bool = False) -> int:
+    """SSH the relaunch command to every pod worker (ref :821-879)."""
+    command = pod_relaunch_command(args)
+    cmd = build_tpu_pod_ssh_cmd(args, command)
+    if dry_run:
+        print(" ".join(cmd))
+        return 0
+    proc = subprocess.run(cmd)
+    return proc.returncode
+
+
+def launch_command(args: argparse.Namespace) -> int:
+    args = _merge_config(args)
+    if args.tpu_name:
+        return tpu_pod_launcher(args)
+    if args.num_processes and args.num_processes > 1:
+        return local_world_launcher(args)
+    return simple_launcher(args)
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser("accelerate-tpu-launch")
+    add_launch_arguments(parser)
+    args = parser.parse_args()
+    return launch_command(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

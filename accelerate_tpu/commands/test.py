@@ -1,0 +1,34 @@
+"""`accelerate-tpu test` (ref src/accelerate/commands/test.py, 65 LoC):
+runs the bundled test script under the launcher."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "test", help="Run the bundled sanity test under the launcher"
+    )
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--num_processes", type=int, default=None,
+                        help="Test an N-process localhost CPU world")
+    parser.set_defaults(func=test_command)
+
+
+def test_command(args: argparse.Namespace) -> int:
+    from ..test_utils import execute_subprocess, launch_command_for, main_test_script_path
+
+    extra = []
+    if args.config_file:
+        extra += ["--config_file", args.config_file]
+    cmd = launch_command_for(
+        main_test_script_path(),
+        num_processes=args.num_processes or 1,
+        extra=extra,
+    )
+    print("Running: " + " ".join(cmd))
+    out = execute_subprocess(cmd)
+    print(out.strip())
+    print("Test is a success! You are ready for your distributed training!")
+    return 0

@@ -1,0 +1,54 @@
+"""`accelerate-tpu tpu-config` (ref src/accelerate/commands/tpu.py:36-157):
+fan a setup command out to every worker of a Cloud TPU pod over gcloud SSH."""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "tpu-config", help="Run setup commands on all TPU pod workers"
+    )
+    parser.add_argument("--tpu_name", required=True)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--tpu_project", default=None)
+    parser.add_argument(
+        "--command", action="append", default=None,
+        help="Command to run on each worker (repeatable)",
+    )
+    parser.add_argument(
+        "--install_accelerate", action="store_true",
+        help="Prepend a pip install of accelerate_tpu",
+    )
+    parser.add_argument("--debug", action="store_true",
+                        help="Print the gcloud command instead of running it")
+    parser.set_defaults(func=tpu_command)
+
+
+def build_tpu_config_cmd(args: argparse.Namespace) -> list[str]:
+    commands = list(args.command or [])
+    if args.install_accelerate:
+        commands.insert(0, "pip install accelerate_tpu -U")
+    if not commands:
+        raise ValueError("Provide at least one --command (or --install_accelerate)")
+    joined = "; ".join(commands)
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+        "--worker=all", "--command", joined,
+    ]
+    if args.tpu_zone:
+        cmd += ["--zone", args.tpu_zone]
+    if args.tpu_project:
+        cmd += ["--project", args.tpu_project]
+    return cmd
+
+
+def tpu_command(args: argparse.Namespace) -> int:
+    cmd = build_tpu_config_cmd(args)
+    if args.debug:
+        print(" ".join(cmd))
+        return 0
+    print(f"Running {' '.join(cmd)}")
+    return subprocess.run(cmd).returncode

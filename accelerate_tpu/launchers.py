@@ -48,14 +48,12 @@ def _spawn_worker(rank: int, world: int, port: int, host_devices: int,
         os.environ[ENV_COORDINATOR] = f"127.0.0.1:{port}"
         os.environ[ENV_NUM_PROCESSES] = str(world)
         os.environ[ENV_PROCESS_ID] = str(rank)
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        if host_devices > 1:
-            from .utils.environment import set_virtual_host_devices
+        from .utils.environment import force_cpu_platform, set_virtual_host_devices
 
-            set_virtual_host_devices(host_devices)
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        # unconditional: an inherited xla_force_host_platform_device_count
+        # (e.g. from a pytest parent) must not leak a different count in
+        set_virtual_host_devices(host_devices)
+        force_cpu_platform()
         PartialState._reset_state()
         function(*args)
     except Exception:
@@ -68,19 +66,22 @@ def debug_launcher(
     args: tuple = (),
     num_processes: int = 2,
     devices_per_process: int = 1,
+    start_method: str = "spawn",
 ) -> None:
     """Launch `function` in an N-process localhost CPU world
     (ref launchers.py:225-257).
 
     Each process sees `jax.process_count() == num_processes` and
     ``devices_per_process`` virtual CPU devices, so both host-collective and
-    mesh-sharding code paths run for real. `function` must be picklable
-    (module-level), the same constraint the reference's spawn imposes.
+    mesh-sharding code paths run for real. With the default ``spawn`` start
+    method `function` must be picklable (module-level); notebook cell
+    functions need ``start_method="fork"`` (what the reference's notebook
+    path uses), which requires that JAX has NOT initialized a backend yet.
     """
     import multiprocessing
     import time
 
-    ctx = multiprocessing.get_context("spawn")
+    ctx = multiprocessing.get_context(start_method)
     for attempt in range(3):  # retry: _free_port has an inherent TOCTOU window
         port = _free_port()
         error_queue = ctx.SimpleQueue()
@@ -113,8 +114,10 @@ def debug_launcher(
         if not failed:
             return
         msgs = []
+        failed_ranks = set()
         while not error_queue.empty():
             rank, tb = error_queue.get()
+            failed_ranks.add(rank)
             msgs.append(f"--- process {rank} ---\n{tb}")
         joined = "\n".join(msgs)
         low = joined.lower()
@@ -123,7 +126,12 @@ def debug_launcher(
         port_clash = "address already in use" in low or "failed to bind" in low
         if port_clash and attempt < 2:
             continue  # coordinator port was stolen between probe and bind
-        n_failed = sum(1 for p in procs if p.exitcode != 0)
+        # peers the launcher itself terminated (exitcode -SIGTERM) are
+        # casualties, not causes — count only ranks that reported a traceback
+        # or exited nonzero on their own
+        n_failed = len(failed_ranks) or sum(
+            1 for p in procs if p.exitcode not in (0, None) and p.exitcode >= 0
+        )
         raise RuntimeError(
             f"{n_failed}/{num_processes} launched processes failed:\n{joined}"
         )
@@ -159,16 +167,34 @@ def notebook_launcher(
         # default None leaves an env-configured precision untouched
         os.environ[ENV_MIXED_PRECISION] = str(mixed_precision)
 
-    import jax
-
+    # Probe the platform WITHOUT initializing a backend (jax.devices() would),
+    # because the multi-process path forks and fork after backend init hangs.
     platform = None
     try:
-        platform = jax.devices()[0].platform
-    except RuntimeError:
-        pass
+        from jax._src import xla_bridge
 
-    if num_processes in (None, 0, 1) or platform in ("tpu", "gpu"):
-        # One process drives all chips; just run it.
+        if xla_bridge.backends_are_initialized():
+            import jax
+
+            platform = jax.devices()[0].platform
+    except Exception:
+        pass
+    if platform is None:
+        ambient = os.environ.get("JAX_PLATFORMS", "")
+        if any(p in ambient for p in ("tpu", "gpu", "cuda", "rocm", "axon")):
+            platform = ambient
+
+    if num_processes in (None, 0, 1) or platform not in (None, "cpu"):
+        # An accelerator is attached (or single-process was asked for): one
+        # process already drives all local chips through the mesh — run here.
         return function(*args)
-    debug_launcher(function, args=args, num_processes=num_processes)
+    # fork so functions defined in notebook cells survive into the children
+    # (the reference's notebook path is fork-based for the same reason,
+    # ref launchers.py:118-126); fork is unsafe after backend init, which the
+    # AcceleratorState guard above rules out.
+    import multiprocessing
+
+    start_method = "fork" if "fork" in multiprocessing.get_all_start_methods() else "spawn"
+    debug_launcher(function, args=args, num_processes=num_processes,
+                   start_method=start_method)
     return None

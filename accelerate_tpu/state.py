@@ -31,7 +31,9 @@ import numpy as np
 
 from .utils.constants import (
     ENV_COORDINATOR,
+    ENV_CPU,
     ENV_DEBUG_MODE,
+    ENV_FORCE_HOST_DEVICES,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     LEGACY_RANK_VARS,
@@ -99,8 +101,19 @@ class PartialState:
             return
         timeout = kwargs.pop("timeout", None)
         timeout_s = int(timeout.total_seconds()) if timeout is not None else None
-        if cpu or parse_flag_from_env("ACCELERATE_TPU_USE_CPU"):
-            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        host_devices = get_int_from_env((ENV_FORCE_HOST_DEVICES,))
+        if host_devices:
+            from .utils.environment import set_virtual_host_devices
+
+            set_virtual_host_devices(host_devices)
+        if cpu or host_devices or parse_flag_from_env(ENV_CPU):
+            from .utils.environment import force_cpu_platform
+
+            if not force_cpu_platform():
+                logger.warning(
+                    "CPU backend requested but a JAX backend is already "
+                    "initialized; keeping the existing platform."
+                )
         self.multi_host = _maybe_init_jax_distributed(timeout_s)
         self.debug = parse_flag_from_env(ENV_DEBUG_MODE)
         self._devices = list(jax.devices())

@@ -1,0 +1,104 @@
+"""Shipped test harness (ref src/accelerate/test_utils/, 3994 LoC).
+
+Shipped inside the package so `accelerate-tpu test` works from any install
+(ref commands/test.py runs the bundled test_script). Capability gating skips
+by hardware, never mocks (ref testing.py:122-392).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import unittest
+
+import numpy as np
+
+
+def device_platform() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        return "none"
+
+
+def require_tpu(test_case):
+    """Skip unless a real TPU backend is attached (ref testing.py:216)."""
+    return unittest.skipUnless(device_platform() == "tpu", "test requires TPU")(
+        test_case
+    )
+
+
+def require_multi_device(test_case):
+    """Skip unless >1 device is visible (real or virtual)
+    (ref testing.py require_multi_device)."""
+    import jax
+
+    return unittest.skipUnless(
+        jax.device_count() > 1, "test requires multiple devices"
+    )(test_case)
+
+
+def require_multi_process(test_case):
+    import jax
+
+    return unittest.skipUnless(
+        jax.process_count() > 1, "test requires a multi-process world"
+    )(test_case)
+
+
+def slow(test_case):
+    """Gate by RUN_SLOW=1 (ref testing.py slow decorator)."""
+    from ..utils.environment import parse_flag_from_env
+
+    return unittest.skipUnless(parse_flag_from_env("RUN_SLOW"), "slow test")(
+        test_case
+    )
+
+
+def are_the_same_tensors(tensor) -> bool:
+    """True iff every process holds an identical copy
+    (ref testing.py:474-483)."""
+    from ..utils.operations import gather
+
+    stacked = np.asarray(gather(tensor[None]))
+    return bool(np.all(stacked == stacked[0:1]))
+
+
+def execute_subprocess(cmd: list[str], env: dict | None = None) -> str:
+    """Run a launch command, raise with captured output on failure
+    (ref testing.py:542-561 execute_subprocess_async)."""
+    import subprocess
+
+    merged = dict(os.environ)
+    if env:
+        merged.update(env)
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=merged)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"command {' '.join(cmd)} failed with code {proc.returncode}\n"
+            f"--- stdout ---\n{proc.stdout}\n--- stderr ---\n{proc.stderr}"
+        )
+    return proc.stdout
+
+
+def launch_command_for(script: str, num_processes: int = 1,
+                       extra: list[str] | None = None) -> list[str]:
+    """Build `accelerate-tpu launch` cmdline (ref get_launch_command
+    testing.py:81-100)."""
+    import sys
+
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch"]
+    if num_processes > 1:
+        cmd += ["--num_processes", str(num_processes)]
+    if extra:
+        cmd += extra
+    cmd.append(script)
+    return cmd
+
+
+def main_test_script_path() -> str:
+    from pathlib import Path
+
+    return str(Path(__file__).parent / "scripts" / "test_script.py")

@@ -1,0 +1,43 @@
+"""Tiny deterministic workloads (ref test_utils/training.py:1-101).
+
+`RegressionDataset` draws y = 2x + 1 (+noise); `regression_params`/
+`regression_forward` are the functional JAX stand-ins for the reference's
+`RegressionModel` nn.Module — one weight, one bias, so convergence and
+cross-process parity are exact and fast to assert.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    def __init__(self, a: float = 2.0, b: float = 1.0, length: int = 64,
+                 seed: int = 42) -> None:
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + rng.normal(scale=0.1, size=(length,))).astype(
+            np.float32
+        )
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, i: int) -> dict:
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_params(a: float = 0.0, b: float = 0.0) -> dict:
+    import jax.numpy as jnp
+
+    return {"a": jnp.asarray(a, jnp.float32), "b": jnp.asarray(b, jnp.float32)}
+
+
+def regression_forward(params: dict, x):
+    return params["a"] * x + params["b"]
+
+
+def regression_loss(params: dict, batch: dict):
+    pred = regression_forward(params, batch["x"])
+    return ((pred - batch["y"]) ** 2).mean()

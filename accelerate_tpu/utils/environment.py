@@ -68,6 +68,21 @@ def set_virtual_host_devices(n: int, env: dict | None = None) -> None:
     env["XLA_FLAGS"] = flags
 
 
+def force_cpu_platform() -> bool:
+    """Force JAX onto the host CPU platform, beating images whose PJRT plugin
+    pins the platform programmatically (jax.config wins over the JAX_PLATFORMS
+    env var). Returns False if a backend is already initialized — at that
+    point the platform can no longer change in this process."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except RuntimeError:
+        return False
+
+
 @contextlib.contextmanager
 def patch_environment(**kwargs: Any) -> Iterator[None]:
     """Temporarily set env vars; restores previous values on exit

@@ -1,0 +1,150 @@
+"""Launch env/cmd assembly.
+
+TPU-native analogue of ref src/accelerate/utils/launch.py (626 LoC). The
+reference serializes CLI+yaml config into `ACCELERATE_*`/`FSDP_*` env consumed
+by torchrun/deepspeed/xmp children (ref utils/launch.py:76-400). Here the
+protocol is the `ACCELERATE_TPU_*` family (utils/constants.py) consumed by
+`PartialState`/`Accelerator`, and process topology is one process per host
+joined via the JAX coordinator — there is no torchrun elastic agent to drive.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Any
+
+from .constants import (
+    ENV_COORDINATOR,
+    ENV_DEBUG_MODE,
+    ENV_FORCE_HOST_DEVICES,
+    ENV_GRAD_ACCUM_STEPS,
+    ENV_MESH_SHAPE,
+    ENV_MIXED_PRECISION,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_CPU,
+)
+
+
+def _flag(args: Any, name: str, default: Any = None) -> Any:
+    value = getattr(args, name, None)
+    return default if value is None else value
+
+
+def prepare_launch_env(args: Any) -> dict[str, str]:
+    """Env block shared by every launched process
+    (ref prepare_simple_launcher_cmd_env utils/launch.py:76-151).
+
+    Only keys the user actually configured are emitted, so child-side env
+    defaults still apply.
+    """
+    env: dict[str, str] = {}
+    mixed_precision = _flag(args, "mixed_precision")
+    if mixed_precision is not None:
+        env[ENV_MIXED_PRECISION] = str(mixed_precision)
+    mesh_shape = _flag(args, "mesh_shape")
+    if mesh_shape:
+        env[ENV_MESH_SHAPE] = str(mesh_shape)
+    grad_accum = _flag(args, "gradient_accumulation_steps")
+    if grad_accum is not None:
+        env[ENV_GRAD_ACCUM_STEPS] = str(grad_accum)
+    if _flag(args, "debug", False):
+        env[ENV_DEBUG_MODE] = "1"
+    if _flag(args, "cpu", False) or _flag(args, "use_cpu", False):
+        env[ENV_CPU] = "1"
+    host_devices = _flag(args, "num_virtual_devices")
+    if host_devices is not None:
+        env[ENV_FORCE_HOST_DEVICES] = str(host_devices)
+        from .environment import set_virtual_host_devices
+
+        set_virtual_host_devices(int(host_devices), env)
+    return env
+
+
+def prepare_multihost_env(args: Any, process_id: int | None = None) -> dict[str, str]:
+    """Add the coordinator rendezvous triple (ref utils/launch.py:152-274
+    MASTER_ADDR/PORT/RANK/WORLD_SIZE assembly for torchrun)."""
+    env = prepare_launch_env(args)
+    num_machines = int(_flag(args, "num_machines", 1))
+    if num_machines <= 1:
+        return env
+    ip = _flag(args, "main_process_ip", "127.0.0.1")
+    port = _flag(args, "main_process_port", 29500)
+    env[ENV_COORDINATOR] = f"{ip}:{port}"
+    env[ENV_NUM_PROCESSES] = str(num_machines)
+    rank = process_id if process_id is not None else int(_flag(args, "machine_rank", 0))
+    env[ENV_PROCESS_ID] = str(rank)
+    return env
+
+
+def build_script_cmd(args: Any, extra_args: list[str] | None = None) -> list[str]:
+    """[python, script, ...] honoring --module/--no-python
+    (ref utils/launch.py:96-120)."""
+    script = args.training_script
+    script_args = list(getattr(args, "training_script_args", []) or [])
+    if extra_args:
+        script_args += extra_args
+    if getattr(args, "module", False):
+        return [sys.executable, "-m", script, *script_args]
+    if getattr(args, "no_python", False):
+        return [script, *script_args]
+    return [sys.executable, script, *script_args]
+
+
+def build_tpu_pod_ssh_cmd(
+    args: Any, command: str, worker: str = "all"
+) -> list[str]:
+    """gcloud SSH fan-out to every TPU pod worker, each re-invoking the
+    launcher with its own machine_rank (ref tpu_pod_launcher
+    commands/launch.py:821-879, which uses xla_dist; on Cloud TPU VMs the
+    native transport is `gcloud compute tpus tpu-vm ssh --worker=all`)."""
+    tpu_name = _flag(args, "tpu_name")
+    if not tpu_name:
+        raise ValueError("--tpu_name is required for TPU pod launches")
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", str(tpu_name),
+        f"--worker={worker}",
+        "--command", command,
+    ]
+    zone = _flag(args, "tpu_zone")
+    if zone:
+        cmd += ["--zone", str(zone)]
+    project = _flag(args, "tpu_project")
+    if project:
+        cmd += ["--project", str(project)]
+    return cmd
+
+
+def pod_relaunch_command(args: Any) -> str:
+    """The per-worker shell command a pod launch fans out: re-invoke
+    `accelerate-tpu launch` with topology inherited from the TPU runtime
+    (JAX auto-discovers coordinator/rank from the metadata server, so no
+    machine_rank needs templating — ref :839-870 had to template per host)."""
+    parts = ["accelerate-tpu", "launch"]
+    mixed_precision = _flag(args, "mixed_precision")
+    if mixed_precision is not None:
+        parts += ["--mixed_precision", str(mixed_precision)]
+    mesh_shape = _flag(args, "mesh_shape")
+    if mesh_shape:
+        parts += ["--mesh_shape", str(mesh_shape)]
+    grad_accum = _flag(args, "gradient_accumulation_steps")
+    if grad_accum is not None:
+        parts += ["--gradient_accumulation_steps", str(grad_accum)]
+    if _flag(args, "debug", False):
+        parts += ["--debug"]
+    if getattr(args, "module", False):
+        parts += ["--module"]
+    if getattr(args, "no_python", False):
+        parts += ["--no_python"]
+    parts.append(args.training_script)
+    parts += list(getattr(args, "training_script_args", []) or [])
+    import shlex
+
+    return " ".join(shlex.quote(p) for p in parts)
+
+
+def merged_child_env(extra: dict[str, str]) -> dict[str, str]:
+    env = dict(os.environ)
+    env.update(extra)
+    return env

@@ -1,0 +1,190 @@
+"""CLI layer: launch env/cmd assembly, config store, estimate — all offline
+(ref tests/test_cli.py, 511 LoC: multinode coverage by inspecting generated
+env/cmd, never by launching nodes)."""
+
+import argparse
+import json
+
+import pytest
+
+from accelerate_tpu.commands.config.config_args import LaunchConfig
+from accelerate_tpu.commands.estimate import count_model_params, estimate_table
+from accelerate_tpu.commands.launch import add_launch_arguments
+from accelerate_tpu.utils.constants import (
+    ENV_COORDINATOR,
+    ENV_MESH_SHAPE,
+    ENV_MIXED_PRECISION,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+from accelerate_tpu.utils.launch import (
+    build_script_cmd,
+    build_tpu_pod_ssh_cmd,
+    pod_relaunch_command,
+    prepare_launch_env,
+    prepare_multihost_env,
+)
+
+
+def parse_launch(argv):
+    parser = argparse.ArgumentParser()
+    add_launch_arguments(parser)
+    return parser.parse_args(argv)
+
+
+def test_prepare_launch_env_basic():
+    args = parse_launch(
+        ["--mixed_precision", "bf16", "--mesh_shape", "fsdp=4,model=2",
+         "--gradient_accumulation_steps", "8", "--debug", "train.py"]
+    )
+    env = prepare_launch_env(args)
+    assert env[ENV_MIXED_PRECISION] == "bf16"
+    assert env[ENV_MESH_SHAPE] == "fsdp=4,model=2"
+    assert env["ACCELERATE_TPU_GRADIENT_ACCUMULATION_STEPS"] == "8"
+    assert env["ACCELERATE_TPU_DEBUG"] == "1"
+
+
+def test_prepare_launch_env_only_set_keys():
+    args = parse_launch(["train.py"])
+    env = prepare_launch_env(args)
+    assert ENV_MIXED_PRECISION not in env
+    assert ENV_MESH_SHAPE not in env
+
+
+def test_multihost_env_synthesized():
+    """Multinode is covered offline by inspecting the generated env
+    (SURVEY.md §4: never simulated)."""
+    args = parse_launch(
+        ["--num_machines", "4", "--machine_rank", "2",
+         "--main_process_ip", "10.0.0.5", "--main_process_port", "1234",
+         "train.py"]
+    )
+    env = prepare_multihost_env(args)
+    assert env[ENV_COORDINATOR] == "10.0.0.5:1234"
+    assert env[ENV_NUM_PROCESSES] == "4"
+    assert env[ENV_PROCESS_ID] == "2"
+
+
+def test_single_machine_has_no_coordinator():
+    args = parse_launch(["train.py"])
+    env = prepare_multihost_env(args)
+    assert ENV_COORDINATOR not in env
+
+
+def test_build_script_cmd_variants():
+    args = parse_launch(["train.py", "--lr", "3"])
+    assert build_script_cmd(args)[1:] == ["train.py", "--lr", "3"]
+    args = parse_launch(["-m", "pkg.train"])
+    assert build_script_cmd(args)[1:3] == ["-m", "pkg.train"]
+    args = parse_launch(["--no_python", "./run.sh"])
+    assert build_script_cmd(args) == ["./run.sh"]
+
+
+def test_pod_ssh_cmd():
+    args = parse_launch(
+        ["--tpu_name", "pod-1", "--tpu_zone", "us-central2-b",
+         "--mixed_precision", "bf16", "train.py", "--epochs", "2"]
+    )
+    relaunch = pod_relaunch_command(args)
+    assert relaunch.startswith("accelerate-tpu launch")
+    assert "--mixed_precision bf16" in relaunch
+    assert "train.py --epochs 2" in relaunch
+    cmd = build_tpu_pod_ssh_cmd(args, relaunch)
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "pod-1"]
+    assert "--worker=all" in cmd
+    assert "--zone" in cmd
+
+
+def test_pod_requires_tpu_name():
+    args = parse_launch(["train.py"])
+    with pytest.raises(ValueError, match="tpu_name"):
+        build_tpu_pod_ssh_cmd(args, "true")
+
+
+def test_launch_config_roundtrip(tmp_path):
+    config = LaunchConfig(num_machines=2, mixed_precision="bf16",
+                          mesh_shape="data=2", main_process_ip="10.0.0.1")
+    path = config.save(tmp_path / "cfg.yaml")
+    loaded = LaunchConfig.load(path)
+    assert loaded == config
+
+
+def test_launch_config_rejects_unknown_keys(tmp_path):
+    p = tmp_path / "bad.yaml"
+    p.write_text("nonsense_key: 1\n")
+    with pytest.raises(ValueError, match="nonsense_key"):
+        LaunchConfig.load(p)
+
+
+def test_config_merge_cli_wins(tmp_path):
+    from accelerate_tpu.commands.launch import _merge_config
+
+    LaunchConfig(mixed_precision="no", mesh_shape="data=4").save(
+        tmp_path / "cfg.yaml"
+    )
+    args = parse_launch(
+        ["--config_file", str(tmp_path / "cfg.yaml"),
+         "--mixed_precision", "bf16", "train.py"]
+    )
+    args = _merge_config(args)
+    assert args.mixed_precision == "bf16"  # CLI wins
+    assert args.mesh_shape == "data=4"     # yaml fills the gap
+
+
+def test_write_basic_config(tmp_path):
+    from accelerate_tpu.commands.config.default import write_basic_config
+
+    path = write_basic_config(config_file=tmp_path / "basic.yaml")
+    config = LaunchConfig.load(path)
+    assert config.distributed_type in ("TPU", "CPU")
+
+
+def test_estimate_presets():
+    total, per_module = count_model_params("llama-7b")
+    assert 6.5e9 < total < 7.5e9, total
+    rows = estimate_table("bert-base", ["float32", "int8"])
+    assert rows[0]["total_size"] == pytest.approx(rows[1]["total_size"] * 4)
+    total_bert, _ = count_model_params("bert-base")
+    assert 0.9e8 < total_bert < 1.3e8, total_bert
+
+
+def test_estimate_local_safetensors(tmp_path):
+    # hand-build a minimal safetensors file: header + zero payload
+    import numpy as np
+
+    header = {
+        "layer1.weight": {"dtype": "F32", "shape": [10, 4],
+                          "data_offsets": [0, 160]},
+        "layer2.weight": {"dtype": "F32", "shape": [5], "data_offsets": [160, 180]},
+    }
+    raw = json.dumps(header).encode()
+    blob = len(raw).to_bytes(8, "little") + raw + b"\x00" * 180
+    (tmp_path / "model.safetensors").write_bytes(blob)
+    total, per_module = count_model_params(str(tmp_path))
+    assert total == 45
+    assert per_module == {"layer1": 40, "layer2": 5}
+
+
+def test_estimate_hf_config(tmp_path):
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "llama", "vocab_size": 1000, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+    }))
+    total, _ = count_model_params(str(tmp_path))
+    assert 0 < total < 1e7
+
+
+def test_estimate_unknown_model():
+    with pytest.raises(ValueError, match="not a preset"):
+        count_model_params("no-such-model")
+
+
+def test_cli_registers_all_subcommands():
+    from accelerate_tpu.commands.accelerate_cli import build_parser
+
+    parser = build_parser()
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    for name in ("env", "config", "launch", "test", "estimate", "tpu-config"):
+        assert name in sub.choices, name
