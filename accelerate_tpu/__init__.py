@@ -42,6 +42,10 @@ _LAZY = {
     "LocalSGD": ".local_sgd",
     "notebook_launcher": ".launchers",
     "debug_launcher": ".launchers",
+    "profile": ".profiler",
+    "annotate": ".profiler",
+    "StepTimer": ".profiler",
+    "device_memory_stats": ".profiler",
 }
 
 

@@ -702,6 +702,21 @@ class Accelerator:
             return True
         return False
 
+    # --------------------------------------------------------- profiling
+    def profile(self, logdir: str = "/tmp/accelerate_tpu_trace", **kwargs):
+        """Trace XLA execution to TensorBoard/Perfetto (first-class here;
+        the reference had no profiler — SURVEY.md §5)."""
+        from .profiler import profile as _profile
+
+        return _profile(logdir, **kwargs)
+
+    def step_timer(self, flops_per_step: float = 0.0, tokens_per_step: int = 0,
+                   **kwargs):
+        from .profiler import StepTimer
+
+        return StepTimer(flops_per_step=flops_per_step,
+                         tokens_per_step=tokens_per_step, **kwargs)
+
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: bool | None = None):
         """ref :1061-1146. GSPMD programs are globally scheduled, so uneven

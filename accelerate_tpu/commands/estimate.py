@@ -173,8 +173,9 @@ def _human(num_bytes: float) -> str:
     return f"{num_bytes:.2f} PB"
 
 
-def estimate_table(model_name: str, dtypes: list[str]) -> list[dict]:
-    total, per_module = count_model_params(model_name)
+def estimate_table(model_name: str, dtypes: list[str],
+                   counts: tuple[int, dict[str, int]] | None = None) -> list[dict]:
+    total, per_module = counts if counts is not None else count_model_params(model_name)
     largest = max(per_module.values()) if per_module else total
     rows = []
     for dtype in dtypes:
@@ -193,9 +194,9 @@ def estimate_table(model_name: str, dtypes: list[str]) -> list[dict]:
 
 
 def estimate_command(args: argparse.Namespace) -> int:
-    rows = estimate_table(args.model_name, args.dtypes)
-    total_params = count_model_params(args.model_name)[0]
-    print(f"Model: {args.model_name} — {total_params / 1e6:,.1f}M params")
+    counts = count_model_params(args.model_name)
+    rows = estimate_table(args.model_name, args.dtypes, counts=counts)
+    print(f"Model: {args.model_name} — {counts[0] / 1e6:,.1f}M params")
     header = f"{'dtype':>10} | {'largest layer':>14} | {'total size':>12} | {'training w/ Adam':>17}"
     print(header)
     print("-" * len(header))

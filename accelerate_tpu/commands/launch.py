@@ -139,8 +139,21 @@ def local_world_launcher(args: argparse.Namespace) -> int:
         env[ENV_CPU] = "1"
         env.setdefault("JAX_PLATFORMS", "cpu")
         procs.append(subprocess.Popen(cmd, env=merged_child_env(env)))
+    # Monitor rather than wait sequentially: one rank dying mid-rendezvous
+    # leaves its peers blocked in a collective forever (same guard as
+    # launchers.debug_launcher).
+    import time
+
     code = 0
     try:
+        while any(p.poll() is None for p in procs):
+            if any(p.returncode not in (0, None) for p in procs):
+                time.sleep(1.0)  # grace for peers to exit on their own
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+                break
+            time.sleep(0.05)
         for p in procs:
             p.wait()
             code = code or p.returncode

@@ -99,12 +99,14 @@ def debug_launcher(
         # first failure the survivors are terminated (the reference inherits
         # this from torch's ProcessContext.join).
         failed = False
+        terminated: set[int] = set()
         while any(p.is_alive() for p in procs):
             if any(p.exitcode not in (0, None) for p in procs):
                 failed = True
                 time.sleep(1.0)  # grace: let peers flush their own tracebacks
-                for p in procs:
+                for rank, p in enumerate(procs):
                     if p.is_alive():
+                        terminated.add(rank)
                         p.terminate()
                 break
             time.sleep(0.05)
@@ -126,12 +128,14 @@ def debug_launcher(
         port_clash = "address already in use" in low or "failed to bind" in low
         if port_clash and attempt < 2:
             continue  # coordinator port was stolen between probe and bind
-        # peers the launcher itself terminated (exitcode -SIGTERM) are
-        # casualties, not causes — count only ranks that reported a traceback
-        # or exited nonzero on their own
-        n_failed = len(failed_ranks) or sum(
-            1 for p in procs if p.exitcode not in (0, None) and p.exitcode >= 0
-        )
+        # peers the launcher itself terminated are casualties, not causes —
+        # count ranks that reported a traceback or died on their own
+        # (incl. signal deaths like an OOM kill, which leave no traceback)
+        own_deaths = {
+            rank for rank, p in enumerate(procs)
+            if p.exitcode not in (0, None) and rank not in terminated
+        }
+        n_failed = len(failed_ranks | own_deaths)
         raise RuntimeError(
             f"{n_failed}/{num_processes} launched processes failed:\n{joined}"
         )
@@ -167,34 +171,59 @@ def notebook_launcher(
         # default None leaves an env-configured precision untouched
         os.environ[ENV_MIXED_PRECISION] = str(mixed_precision)
 
-    # Probe the platform WITHOUT initializing a backend (jax.devices() would),
-    # because the multi-process path forks and fork after backend init hangs.
-    platform = None
+    if num_processes in (None, 0, 1):
+        return function(*args)
+
+    # Multi-process was requested. Fork (needed so notebook-cell functions
+    # survive into the children, ref launchers.py:118-126) is only safe while
+    # no JAX backend exists, so the accelerator probe must NOT initialize one.
+    backend_initialized = False
+    accelerator_attached = False
     try:
         from jax._src import xla_bridge
 
-        if xla_bridge.backends_are_initialized():
+        backend_initialized = xla_bridge.backends_are_initialized()
+        if backend_initialized:
             import jax
 
-            platform = jax.devices()[0].platform
+            accelerator_attached = jax.devices()[0].platform != "cpu"
+        else:
+            ambient = os.environ.get("JAX_PLATFORMS", "")
+            accelerator_attached = any(
+                p in ambient for p in ("tpu", "gpu", "cuda", "rocm", "axon")
+            )
+            if not accelerator_attached:
+                # init-free TPU probe: libtpu-visible chips on this host
+                from jax._src import hardware_utils
+
+                accelerator_attached = (
+                    hardware_utils.num_available_tpu_chips_and_device_id()[0] > 0
+                )
     except Exception:
         pass
-    if platform is None:
-        ambient = os.environ.get("JAX_PLATFORMS", "")
-        if any(p in ambient for p in ("tpu", "gpu", "cuda", "rocm", "axon")):
-            platform = ambient
 
-    if num_processes in (None, 0, 1) or platform not in (None, "cpu"):
-        # An accelerator is attached (or single-process was asked for): one
-        # process already drives all local chips through the mesh — run here.
+    if accelerator_attached:
+        # One process already drives every local chip through the mesh — the
+        # reference forked per TPU core here; under JAX there is nothing to
+        # fork, so num_processes is ignored on accelerator hosts.
         return function(*args)
-    # fork so functions defined in notebook cells survive into the children
-    # (the reference's notebook path is fork-based for the same reason,
-    # ref launchers.py:118-126); fork is unsafe after backend init, which the
-    # AcceleratorState guard above rules out.
+
     import multiprocessing
 
-    start_method = "fork" if "fork" in multiprocessing.get_all_start_methods() else "spawn"
+    if backend_initialized or "fork" not in multiprocessing.get_all_start_methods():
+        import warnings
+
+        warnings.warn(
+            "notebook_launcher is spawning (not forking) worker processes "
+            "because a JAX backend is already initialized in this process; "
+            "the launched function must be importable (module-level), not a "
+            "notebook-cell closure. Restart the kernel and launch before any "
+            "JAX computation to enable fork.",
+            stacklevel=2,
+        )
+        start_method = "spawn"
+    else:
+        start_method = "fork"
     debug_launcher(function, args=args, num_processes=num_processes,
                    start_method=start_method)
     return None

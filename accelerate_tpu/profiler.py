@@ -1,0 +1,170 @@
+"""Profiling / tracing subsystem.
+
+The reference has NO first-class profiler (SURVEY.md §5: only Megatron timers
+and benchmark-side psutil helpers, ref utils/megatron_lm.py:1018-1026,
+benchmarks/measures_util.py). This module makes tracing first-class for TPU:
+
+- `profile(...)`: context manager around `jax.profiler` producing a
+  TensorBoard/Perfetto/XProf trace of XLA execution.
+- `annotate(...)`: named host-side region that shows up on the trace timeline.
+- `StepTimer`: wall-clock per-step timing with warmup skipping; reports
+  steps/sec, tokens/sec and MFU against the chip's peak FLOPs.
+- `device_memory_stats()` / `live_array_bytes()`: HBM introspection
+  (replaces ref utils/memory.py's psutil/torch.cuda views).
+
+MFU math: a causal-LM training step costs ~6 FLOPs per parameter per token
+(fwd 2 + bwd 4), plus attention ~12*L*H*S^2 per sequence when
+`attention=True` — the standard accounting from the scaling literature.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+import jax
+
+from .utils.constants import TPU_PEAK_FLOPS
+
+
+@contextlib.contextmanager
+def profile(logdir: str = "/tmp/accelerate_tpu_trace",
+            host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture an XLA execution trace viewable in TensorBoard/Perfetto."""
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    jax.profiler.start_trace(logdir, profiler_options=options)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region on the trace timeline (and under jit, in the HLO)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats(device=None) -> dict[str, int]:
+    """Per-device memory stats (bytes): HBM in use / limit where the backend
+    reports them; empty dict on backends without stats (CPU)."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats()
+    return dict(stats) if stats else {}
+
+
+def live_array_bytes() -> int:
+    """Total bytes of live jax.Array shards resident on this process's
+    devices (counts every replica — a fully replicated array on 8 local
+    devices costs 8x its logical size in HBM)."""
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            total += sum(s.data.nbytes for s in arr.addressable_shards)
+        except Exception:
+            total += arr.nbytes
+    return total
+
+
+def peak_flops_per_chip(device=None) -> float:
+    """Peak bf16 FLOPs/s for this chip generation (public specs table)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, flops in TPU_PEAK_FLOPS.items():
+        if key in kind:
+            return flops
+    return 0.0
+
+
+def causal_lm_train_flops(n_params: int, tokens: int,
+                          num_layers: int = 0, hidden_size: int = 0,
+                          seq_len: int = 0, attention: bool = True) -> float:
+    """FLOPs for one training step over `tokens` tokens (6ND + attention)."""
+    flops = 6.0 * n_params * tokens
+    if attention and num_layers and seq_len:
+        # 12 * L * h * S per token (fwd+bwd of QK^T and AV)
+        flops += 12.0 * num_layers * hidden_size * seq_len * tokens
+    return flops
+
+
+@dataclass
+class StepTimer:
+    """Per-step timing + throughput/MFU meter.
+
+    Usage::
+
+        timer = StepTimer(flops_per_step=..., tokens_per_step=...)
+        for batch in loader:
+            state, metrics = step(state, batch)
+            timer.tick(state)          # blocks on `state` to time honestly
+        print(timer.summary())
+    """
+
+    flops_per_step: float = 0.0
+    tokens_per_step: int = 0
+    warmup_steps: int = 2          # compile + first dispatch excluded
+    peak_flops: float | None = None
+    num_chips: int | None = None
+    _times: list[float] = field(default_factory=list)
+    _last: float | None = None
+    _seen: int = 0
+
+    def tick(self, block_on: Any = None) -> float | None:
+        """Record one step boundary; returns this step's seconds (or None
+        during warmup). Pass the step's output pytree so timing waits for the
+        device to finish (`jax.block_until_ready`)."""
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        now = time.perf_counter()
+        elapsed = None
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup_steps:
+                elapsed = now - self._last
+                self._times.append(elapsed)
+        self._last = now
+        return elapsed
+
+    @property
+    def steps_recorded(self) -> int:
+        return len(self._times)
+
+    @property
+    def mean_step_time(self) -> float:
+        if not self._times:
+            return float("nan")
+        return sum(self._times) / len(self._times)
+
+    @property
+    def steps_per_sec(self) -> float:
+        mean = self.mean_step_time
+        return 1.0 / mean if mean and mean == mean else float("nan")
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.steps_per_sec * self.tokens_per_step
+
+    def mfu(self) -> float:
+        """Model FLOPs utilization in [0,1] against chip peak * num_chips."""
+        peak = self.peak_flops if self.peak_flops is not None else peak_flops_per_chip()
+        chips = self.num_chips if self.num_chips is not None else jax.device_count()
+        if not peak or not self.flops_per_step or not self._times:
+            return 0.0
+        achieved = self.flops_per_step / self.mean_step_time
+        return achieved / (peak * chips)
+
+    def summary(self) -> dict[str, float]:
+        out = {
+            "steps_recorded": float(self.steps_recorded),
+            "mean_step_time_s": self.mean_step_time,
+            "steps_per_sec": self.steps_per_sec,
+        }
+        if self.tokens_per_step:
+            out["tokens_per_sec"] = self.tokens_per_sec
+            chips = self.num_chips if self.num_chips is not None else jax.device_count()
+            out["tokens_per_sec_per_chip"] = self.tokens_per_sec / max(1, chips)
+        if self.flops_per_step:
+            out["mfu"] = self.mfu()
+        return out

@@ -282,6 +282,8 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
         raise ValueError(f"reduction must be mean|sum|none, got {reduction}")
     if reduction == "none":
         return tensor
+    if PartialStateDebug.enabled():
+        verify_operation(tensor, "reduce")
     return jax.tree_util.tree_map(_reduce, tensor)
 
 
