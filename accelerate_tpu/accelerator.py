@@ -143,6 +143,8 @@ class Accelerator:
         self.state = AcceleratorState(
             mixed_precision=mixed_precision, cpu=cpu, mesh_config=resolved_mesh
         )
+        # visible to parallel.context_attention without an Accelerator handle
+        self.state.context_parallel_plugin = context_parallel_plugin
 
         # --- gradient accumulation (ref :421, dataclasses.py:586) ------------
         if gradient_accumulation_plugin is None:
@@ -701,6 +703,15 @@ class Accelerator:
             self.flag_tensor = None
             return True
         return False
+
+    def context_attention(self, q, k, v, causal: bool = True):
+        """Sequence-parallel attention using the configured
+        `ContextParallelPlugin.mode` (ring | ulysses) over this mesh."""
+        from .parallel import context_attention as _ca
+
+        mode = (self.context_parallel_plugin.mode
+                if self.context_parallel_plugin is not None else None)
+        return _ca(q, k, v, causal=causal, mode=mode, mesh=self.mesh)
 
     # --------------------------------------------------------- profiling
     def profile(self, logdir: str = "/tmp/accelerate_tpu_trace", **kwargs):

@@ -348,3 +348,39 @@ def streamed_forward(
             nxt = _layer_slice(i + 1)  # async H2D while layer i computes
         x = layer_fn(cur, x, i)
     return final_fn(resident, x)
+
+
+# ---------------------------------------------------------------------------
+# quantized load (the bnb replacement, ref utils/bnb.py:44-467)
+# ---------------------------------------------------------------------------
+
+
+def load_and_quantize_params(
+    params_abstract: Any,
+    checkpoint: str,
+    quantization_config=None,
+    dtype=None,
+    device_put: bool = True,
+) -> Any:
+    """Load a checkpoint and block-quantize weight matrices to int8/int4
+    (ref `load_and_quantize_model` utils/bnb.py:44; kernels are ours —
+    ops/quant.py — not bitsandbytes).
+
+    The checkpoint is streamed host-side and quantized with numpy math —
+    HBM only ever sees the compressed tensors (`device_put=True`), which is
+    the point: the quantized model fits where the fp16 one would not. There
+    is deliberately no device_map/offload here — after 4/8-bit compression a
+    single host's HBM+RAM covers the reference's offload use cases; for
+    larger-than-host models use sharded dispatch instead."""
+    from .ops.quant import QuantizedTensor, quantize_params
+
+    loaded, _ = load_checkpoint_in_model(
+        params_abstract, checkpoint, device_map=None, dtype=dtype,
+    )
+    quantized = quantize_params(loaded, quantization_config)
+    if not device_put:
+        return quantized
+    return jax.tree_util.tree_map(
+        jax.device_put, quantized,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+    )

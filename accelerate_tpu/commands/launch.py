@@ -139,29 +139,26 @@ def local_world_launcher(args: argparse.Namespace) -> int:
         env[ENV_CPU] = "1"
         env.setdefault("JAX_PLATFORMS", "cpu")
         procs.append(subprocess.Popen(cmd, env=merged_child_env(env)))
-    # Monitor rather than wait sequentially: one rank dying mid-rendezvous
-    # leaves its peers blocked in a collective forever (same guard as
-    # launchers.debug_launcher).
-    import time
+    from ..utils.launch import monitor_world
 
-    code = 0
     try:
-        while any(p.poll() is None for p in procs):
-            if any(p.returncode not in (0, None) for p in procs):
-                time.sleep(1.0)  # grace for peers to exit on their own
-                for p in procs:
-                    if p.poll() is None:
-                        p.terminate()
-                break
-            time.sleep(0.05)
+        _, terminated = monitor_world(
+            procs,
+            is_alive=lambda p: p.poll() is None,
+            exitcode=lambda p: p.returncode,
+            terminate=lambda p: p.terminate(),
+        )
         for p in procs:
             p.wait()
-            code = code or p.returncode
+        # report the rank that actually failed, not a SIGTERM casualty
+        for rank, p in enumerate(procs):
+            if p.returncode != 0 and rank not in terminated:
+                return p.returncode
+        return next((p.returncode for p in procs if p.returncode != 0), 0)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
-    return code
 
 
 def tpu_pod_launcher(args: argparse.Namespace, dry_run: bool = False) -> int:

@@ -79,7 +79,6 @@ def debug_launcher(
     path uses), which requires that JAX has NOT initialized a backend yet.
     """
     import multiprocessing
-    import time
 
     ctx = multiprocessing.get_context(start_method)
     for attempt in range(3):  # retry: _free_port has an inherent TOCTOU window
@@ -94,22 +93,14 @@ def debug_launcher(
             )
             p.start()
             procs.append(p)
-        # Monitor instead of joining sequentially: a worker crashing out of a
-        # collective leaves its peers blocked in rendezvous forever, so on the
-        # first failure the survivors are terminated (the reference inherits
-        # this from torch's ProcessContext.join).
-        failed = False
-        terminated: set[int] = set()
-        while any(p.is_alive() for p in procs):
-            if any(p.exitcode not in (0, None) for p in procs):
-                failed = True
-                time.sleep(1.0)  # grace: let peers flush their own tracebacks
-                for rank, p in enumerate(procs):
-                    if p.is_alive():
-                        terminated.add(rank)
-                        p.terminate()
-                break
-            time.sleep(0.05)
+        from .utils.launch import monitor_world
+
+        failed, terminated = monitor_world(
+            procs,
+            is_alive=lambda p: p.is_alive(),
+            exitcode=lambda p: p.exitcode,
+            terminate=lambda p: p.terminate(),
+        )
         for p in procs:
             p.join()
         failed = failed or any(p.exitcode != 0 for p in procs)
@@ -189,10 +180,13 @@ def notebook_launcher(
             accelerator_attached = jax.devices()[0].platform != "cpu"
         else:
             ambient = os.environ.get("JAX_PLATFORMS", "")
-            accelerator_attached = any(
-                p in ambient for p in ("tpu", "gpu", "cuda", "rocm", "axon")
-            )
-            if not accelerator_attached:
+            if ambient:
+                # an explicit platform choice is authoritative — in particular
+                # JAX_PLATFORMS=cpu on a TPU VM means "CPU debug world"
+                accelerator_attached = any(
+                    p in ambient for p in ("tpu", "gpu", "cuda", "rocm", "axon")
+                )
+            else:
                 # init-free TPU probe: libtpu-visible chips on this host
                 from jax._src import hardware_utils
 

@@ -50,7 +50,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
-    attention_backend: str = "einsum"  # einsum | flash | ring
+    attention_backend: str = "einsum"  # einsum | flash | ring | ulysses
     remat: bool = False
 
     @property
@@ -146,6 +146,10 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=True)
+    elif config.attention_backend == "ulysses" and kv_cache is None and mask is None:
+        from ..parallel.ulysses import ulysses_attention
+
+        out = ulysses_attention(q, k, v, causal=True)
     else:
         out = dot_product_attention(q, k, v, mask=mask, causal=causal)
     out = out.reshape(b, s, nh * hd)

@@ -1,3 +1,12 @@
 """Custom TPU ops (pallas kernels + XLA fallbacks)."""
 
 from .flash_attention import flash_attention
+from .quant import (
+    QuantizedTensor,
+    dequantize,
+    dequantize_params,
+    quantize,
+    quantize_params,
+    quantized_matmul,
+)
+from .fp8 import Fp8Meta, fp8_dot, init_fp8_state

@@ -1,0 +1,102 @@
+"""Native fp8 training path (transformer-engine replacement).
+
+Replaces ref utils/transformer_engine.py (84 LoC `convert_model` swapping
+nn.Linear for te.Linear). The torch/TE recipe — E4M3 forward / E5M2 backward,
+per-tensor scales from a rolling amax history ("delayed scaling") — is kept,
+but expressed functionally: `Fp8Meta` pytree state threads through the train
+step like optimizer state, and `fp8_dot` casts operands to float8 with the
+current scale, runs the dot (MXU-native on hardware with fp8 support; XLA
+upcasts transparently elsewhere), then updates the history.
+
+Recipe knobs mirror `FP8RecipeKwargs` (utils/dataclasses.py:137, ref
+dataclasses.py:180): margin, amax_history_len, E4M3/HYBRID format.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.dataclasses import FP8RecipeKwargs
+
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+
+
+class Fp8Meta(NamedTuple):
+    """Delayed-scaling state for one tensor role (x / w / grad)."""
+
+    scale: jax.Array         # multiplier applied before the fp8 cast
+    amax_history: jax.Array  # [history_len] rolling raw-amax window
+
+    @classmethod
+    def init(cls, history_len: int = 16) -> "Fp8Meta":
+        return cls(
+            scale=jnp.ones((), jnp.float32),
+            amax_history=jnp.zeros((history_len,), jnp.float32),
+        )
+
+
+def _fmt_max(fmt: str) -> float:
+    return E4M3_MAX if fmt.upper() == "E4M3" else E5M2_MAX
+
+
+def update_meta(meta: Fp8Meta, amax: jax.Array, fmt: str = "E4M3",
+                margin: int = 0) -> Fp8Meta:
+    """Roll the history and derive next step's scale (TE delayed scaling)."""
+    history = jnp.roll(meta.amax_history, 1).at[0].set(amax)
+    amax_max = jnp.max(history)
+    scale = jnp.where(
+        amax_max > 0.0,
+        (_fmt_max(fmt) / (2.0 ** margin)) / amax_max,
+        jnp.ones((), jnp.float32),
+    )
+    return Fp8Meta(scale=scale, amax_history=history)
+
+
+def fp8_cast(x: jax.Array, meta: Fp8Meta, fmt: str = "E4M3") -> jax.Array:
+    dtype = jnp.float8_e4m3fn if fmt.upper() == "E4M3" else jnp.float8_e5m2
+    fmax = _fmt_max(fmt)
+    scaled = jnp.clip(x.astype(jnp.float32) * meta.scale, -fmax, fmax)
+    return scaled.astype(dtype)
+
+
+def fp8_dot(
+    x: jax.Array,
+    w: jax.Array,
+    x_meta: Fp8Meta,
+    w_meta: Fp8Meta,
+    out_dtype=jnp.bfloat16,
+    fmt: str = "E4M3",
+    margin: int = 0,
+) -> tuple[jax.Array, Fp8Meta, Fp8Meta]:
+    """x @ w in fp8 with per-tensor delayed scaling.
+
+    Returns (out, new_x_meta, new_w_meta); thread the metas through the train
+    step as you would optimizer state.
+    """
+    x8 = fp8_cast(x, x_meta, fmt)
+    w8 = fp8_cast(w, w_meta, fmt)
+    out = jnp.dot(x8, w8, preferred_element_type=jnp.float32)
+    out = out / (x_meta.scale * w_meta.scale)
+    x_meta = update_meta(x_meta, jnp.max(jnp.abs(x)), fmt, margin)
+    w_meta = update_meta(w_meta, jnp.max(jnp.abs(w)), fmt, margin)
+    return out.astype(out_dtype), x_meta, w_meta
+
+
+def init_fp8_state(params, recipe: FP8RecipeKwargs | None = None):
+    """One (x, w) meta pair per 2D+ weight leaf, matching the param pytree
+    structure (the functional analogue of TE's per-module buffers)."""
+    recipe = recipe or FP8RecipeKwargs()
+
+    def _leaf(p):
+        if hasattr(p, "ndim") and p.ndim >= 2:
+            return {
+                "x": Fp8Meta.init(recipe.amax_history_len),
+                "w": Fp8Meta.init(recipe.amax_history_len),
+            }
+        return None
+
+    return jax.tree_util.tree_map(_leaf, params)

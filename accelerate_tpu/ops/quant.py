@@ -1,0 +1,177 @@
+"""Native int8/int4 weight-only quantization.
+
+Replaces ref utils/bnb.py:44-467 (`load_and_quantize_model`,
+`replace_with_bnb_layers`), which delegated to bitsandbytes CUDA kernels.
+TPU-native version: symmetric block-wise quantization over the last axis,
+stored as an int8 (or nibble-packed int4) pytree leaf + bf16 scales.
+Dequantization happens inside the consuming jitted matmul — XLA fuses the
+`q * scale` expansion into the dot's operand pipeline, so quantized weights
+cost HBM, not extra FLOP passes.
+
+`QuantizedTensor` is a registered pytree node, so quantized params flow
+through `jax.jit` / sharding / checkpointing like any other leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.dataclasses import QuantizationConfig
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Block-quantized weight: `data` int8 codes (+ nibble packing for int4),
+    `scales` per (row..., block)."""
+
+    def __init__(self, data, scales, bits: int, shape: tuple, dtype):
+        self.data = data
+        self.scales = scales
+        self.bits = bits
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.bits, self.shape, self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scales = children
+        bits, shape, dtype = aux
+        return cls(data, scales, bits, shape, dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return self.data.size * self.data.dtype.itemsize + self.scales.nbytes
+
+    def __repr__(self) -> str:
+        return (
+            f"QuantizedTensor(bits={self.bits}, shape={self.shape}, "
+            f"dtype={self.dtype})"
+        )
+
+
+def _pack_int4(codes: jax.Array) -> jax.Array:
+    """[-8,7] int8 codes -> two nibbles per byte along the last axis
+    (odd widths get a zero nibble of padding; unpack slices it back off)."""
+    u = (codes + 8).astype(jnp.uint8)  # [0,15]
+    if u.shape[-1] % 2:
+        pad = [(0, 0)] * (u.ndim - 1) + [(0, 1)]
+        u = jnp.pad(u, pad)
+    lo, hi = u[..., 0::2], u[..., 1::2]
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def _unpack_int4(packed: jax.Array) -> jax.Array:
+    lo = (packed & 0x0F).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def quantize(w, bits: int = 8, block_size: int = 128) -> QuantizedTensor:
+    """Symmetric block-wise quantization over the last axis.
+
+    jax.Array input stays on device (jit-compatible); numpy input (incl.
+    np.memmap from an offload store) is quantized host-side with numpy math —
+    no HBM is touched, so huge checkpoints quantize within host RAM.
+    """
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    host = not isinstance(w, jax.Array)
+    xp = np if host else jnp
+    *lead, n = w.shape
+    if n % block_size != 0:
+        block_size = n  # degenerate: one block per row
+    nb = n // block_size
+    wf = xp.asarray(w, jnp.float32 if not host else np.float32).reshape(
+        *lead, nb, block_size
+    )
+    absmax = xp.max(xp.abs(wf), axis=-1)
+    qmax = 127.0 if bits == 8 else 7.0
+    scales = (absmax / qmax).astype(jnp.bfloat16)
+    safe = xp.maximum(absmax, 1e-12) / qmax
+    codes = xp.clip(
+        xp.round(wf / safe[..., None]), -qmax - 1, qmax
+    ).astype(xp.int8).reshape(*lead, n)
+    if bits == 4:
+        codes = _pack_int4_np(codes) if host else _pack_int4(codes)
+    return QuantizedTensor(codes, scales, bits, w.shape, w.dtype)
+
+
+def _pack_int4_np(codes: np.ndarray) -> np.ndarray:
+    u = (codes.astype(np.int16) + 8).astype(np.uint8)
+    if u.shape[-1] % 2:
+        pad = [(0, 0)] * (u.ndim - 1) + [(0, 1)]
+        u = np.pad(u, pad)
+    lo, hi = u[..., 0::2], u[..., 1::2]
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
+    codes = qt.data
+    if qt.bits == 4:
+        codes = _unpack_int4(codes)[..., : qt.shape[-1]]  # drop pad nibble
+    *lead, n = qt.shape
+    nb = qt.scales.shape[-1]
+    wf = codes.astype(jnp.float32).reshape(*lead, nb, n // nb)
+    wf = wf * qt.scales[..., None].astype(jnp.float32)
+    return wf.reshape(*qt.shape).astype(dtype or qt.dtype)
+
+
+def quantized_matmul(x: jax.Array, qt: QuantizedTensor) -> jax.Array:
+    """x @ w with w quantized; dequant fuses into the dot under jit."""
+    w = dequantize(qt, dtype=x.dtype)
+    return x @ w
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def quantize_params(params: Any, config: QuantizationConfig | None = None) -> Any:
+    """Walk a param pytree quantizing weight matrices (ndim >= 2); skips
+    `config.skip_modules` substrings (ref bnb.py keeps lm_head fp16 for the
+    same reason: output quality)."""
+    config = config or QuantizationConfig(load_in_8bit=True)
+    bits = config.bits
+    if bits >= 16:
+        return params
+
+    def _maybe_quantize(path, leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+            return leaf
+        name = _path_str(path)
+        if any(skip in name for skip in config.skip_modules):
+            return leaf
+        return quantize(leaf, bits=bits, block_size=config.block_size)
+
+    return jax.tree_util.tree_map_with_path(_maybe_quantize, params)
+
+
+def dequantize_params(params: Any, dtype=None) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: dequantize(leaf, dtype=dtype)
+        if isinstance(leaf, QuantizedTensor) else leaf,
+        params,
+        is_leaf=lambda leaf: isinstance(leaf, QuantizedTensor),
+    )
+
+
+def quantized_nbytes(params: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    ):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+    return total

@@ -4,3 +4,26 @@ pipeline parallelism, expert-parallel MoE dispatch."""
 from .moe import expert_parallel_moe
 from .pipeline import pipeline_apply, stack_layers_into_stages
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
+
+
+def context_attention(q, k, v, causal: bool = True, mode: str | None = None,
+                      mesh=None, axis_name: str = "seq"):
+    """Sequence-parallel attention dispatched by `ContextParallelPlugin.mode`
+    ('ring' rotates K/V chunks; 'ulysses' head-scatters via all-to-all).
+    With no plugin/mode in scope, defaults to ring."""
+    if mode is None:
+        from ..state import AcceleratorState
+
+        if AcceleratorState._shared_state:
+            plugin = getattr(
+                AcceleratorState(), "context_parallel_plugin", None
+            )
+            mode = plugin.mode if plugin is not None else "ring"
+        else:
+            mode = "ring"
+    if mode == "ulysses":
+        return ulysses_attention(q, k, v, causal=causal, mesh=mesh,
+                                 axis_name=axis_name)
+    return ring_attention(q, k, v, causal=causal, mesh=mesh,
+                          axis_name=axis_name)

@@ -1,0 +1,89 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+Second long-context schedule next to ring attention (SURVEY.md §5 —
+the reference has neither). Where ring attention rotates K/V chunks around
+the `seq` axis, Ulysses re-shards: an all-to-all turns [B, S/P, H, D]
+(sequence-sharded) into [B, S, H/P, D] (head-sharded), each device runs
+ordinary full-sequence attention over its head slice, and a second
+all-to-all restores sequence sharding. Two collectives per layer, full
+attention locality in between — the better schedule when H >= ring size and
+ICI all-to-all bandwidth is plentiful; ring wins when S is extreme or head
+count is small (the trade described in the Ulysses/DeepSpeed and ring
+papers, PAPERS.md).
+
+Requires H % axis_size == 0 and S % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import AXIS_SEQ
+
+
+def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
+    """Runs INSIDE shard_map. q,k,v: [B, S_local, H, D] — this device's
+    sequence chunk with ALL heads. all_to_all trades the head dim for the
+    sequence dim so attention sees the full sequence."""
+    from ..models.common import dot_product_attention
+
+    # [B, S/P, H, D] -> [B, S, H/P, D]: split heads (axis 2) across the axis,
+    # concatenate sequence chunks (axis 1).
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q_full = scatter_heads(q)
+    k_full = scatter_heads(k)
+    v_full = scatter_heads(v)
+    out = dot_product_attention(q_full, k_full, v_full, causal=causal)
+    return gather_heads(out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    mesh=None,
+    axis_name: str = AXIS_SEQ,
+) -> jax.Array:
+    """[B, S, H, D] attention with S sharded over the mesh `seq` axis via
+    head-scatter all-to-all. GQA heads must be pre-repeated (same contract as
+    `ring_attention`). Falls back to plain attention when no seq axis exists
+    or shapes don't divide."""
+    if mesh is None:
+        from ..state import PartialState
+
+        if PartialState._shared_state:
+            mesh = PartialState().mesh
+    axis_size = mesh.shape.get(axis_name, 1) if mesh is not None else 1
+    if (
+        mesh is None
+        or axis_size == 1
+        or q.shape[1] % axis_size != 0
+        or k.shape[1] % axis_size != 0
+        or q.shape[2] % axis_size != 0
+        or k.shape[2] % axis_size != 0
+    ):
+        from ..models.common import dot_product_attention
+
+        return dot_product_attention(q, k, v, causal=causal)
+
+    seq_spec = P(None, axis_name, None, None)
+    fn = partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    )(q, k, v)

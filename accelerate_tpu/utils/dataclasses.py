@@ -399,8 +399,15 @@ class ContextParallelPlugin(KwargsHandler):
     (accelerate_tpu/parallel/ring_attention.py)."""
 
     seq_degree: int = -1
-    mode: str = "ring"  # 'ring' | 'allgather' (Ulysses-style a2a is 'ulysses')
+    mode: str = "ring"  # 'ring' | 'ulysses' (head-scatter all-to-all)
     chunk_size: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"ContextParallelPlugin.mode must be 'ring' or 'ulysses', "
+                f"got {self.mode!r}"
+            )
 
     def to_mesh_axes(self) -> dict[str, int]:
         return {AXIS_SEQ: self.seq_degree}

@@ -148,3 +148,32 @@ def merged_child_env(extra: dict[str, str]) -> dict[str, str]:
     env = dict(os.environ)
     env.update(extra)
     return env
+
+
+def monitor_world(procs, *, is_alive, exitcode, terminate,
+                  grace_s: float = 1.0, poll_s: float = 0.05):
+    """Watch a process world; on the first failure, give peers a grace window
+    then terminate survivors (one rank dying mid-rendezvous leaves the others
+    blocked in a collective forever — the reference inherits this guard from
+    torch's ProcessContext.join).
+
+    Process-model agnostic via accessors (multiprocessing.Process and
+    subprocess.Popen spell liveness/exit differently). Returns
+    ``(failed, terminated_ranks)``; ranks in ``terminated_ranks`` are
+    casualties of the cleanup, not causes of the failure.
+    """
+    import time
+
+    failed = False
+    terminated: set[int] = set()
+    while any(is_alive(p) for p in procs):
+        if any(exitcode(p) not in (0, None) for p in procs):
+            failed = True
+            time.sleep(grace_s)
+            for rank, p in enumerate(procs):
+                if is_alive(p):
+                    terminated.add(rank)
+                    terminate(p)
+            break
+        time.sleep(poll_s)
+    return failed, terminated

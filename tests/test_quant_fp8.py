@@ -1,0 +1,228 @@
+"""Native quantization (bnb replacement) + fp8 path (TE replacement) +
+Ulysses attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.ops.fp8 import Fp8Meta, fp8_dot, init_fp8_state, update_meta
+from accelerate_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize,
+    dequantize_params,
+    quantize,
+    quantize_params,
+    quantized_matmul,
+)
+from accelerate_tpu.utils.dataclasses import QuantizationConfig
+
+
+# -- quantization -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantize_roundtrip_error_bounded(bits):
+    w = jax.random.normal(jax.random.key(0), (64, 256), jnp.float32)
+    qt = quantize(w, bits=bits, block_size=64)
+    back = dequantize(qt)
+    rel = float(jnp.abs(back - w).max() / jnp.abs(w).max())
+    assert rel < (0.02 if bits == 8 else 0.2), rel
+
+
+def test_quantize_int4_packs_nibbles():
+    w = jax.random.normal(jax.random.key(1), (8, 128))
+    qt = quantize(w, bits=4, block_size=64)
+    assert qt.data.shape == (8, 64)  # two codes per byte
+    assert qt.nbytes < w.nbytes / 3.5
+
+
+def test_quantized_matmul_close():
+    k = jax.random.key(2)
+    x = jax.random.normal(k, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (64, 32), jnp.float32)
+    qt = quantize(w, bits=8, block_size=32)
+    out = jax.jit(quantized_matmul)(x, qt)
+    ref = x @ w
+    assert float(jnp.abs(out - ref).max() / jnp.abs(ref).max()) < 0.05
+
+
+def test_quantized_tensor_is_pytree():
+    qt = quantize(jnp.ones((4, 8)), bits=8)
+    leaves = jax.tree_util.tree_leaves(qt)
+    assert len(leaves) == 2  # data + scales
+    rebuilt = jax.tree_util.tree_map(lambda x: x, qt)
+    assert isinstance(rebuilt, QuantizedTensor)
+    assert rebuilt.shape == (4, 8)
+
+
+def test_quantize_params_skips_and_selects():
+    params = {
+        "layers": {"mlp": {"kernel": jnp.ones((16, 16)), "bias": jnp.ones((16,))}},
+        "lm_head": {"kernel": jnp.ones((16, 8))},
+    }
+    qp = quantize_params(params, QuantizationConfig(load_in_8bit=True))
+    assert isinstance(qp["layers"]["mlp"]["kernel"], QuantizedTensor)
+    assert not isinstance(qp["layers"]["mlp"]["bias"], QuantizedTensor)  # 1-D kept
+    assert not isinstance(qp["lm_head"]["kernel"], QuantizedTensor)  # skipped
+    dq = dequantize_params(qp)
+    np.testing.assert_allclose(np.asarray(dq["layers"]["mlp"]["kernel"]), 1.0,
+                               rtol=0.01)
+
+
+def test_load_and_quantize_params(tmp_path):
+    from safetensors.numpy import save_file
+
+    from accelerate_tpu.big_modeling import init_empty_weights, load_and_quantize_params
+
+    rng = np.random.default_rng(0)
+    sd = {
+        "block.w": rng.normal(size=(32, 32)).astype(np.float32),
+        "block.b": rng.normal(size=(32,)).astype(np.float32),
+    }
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    abstract = init_empty_weights(
+        lambda: {"block": {"w": jnp.zeros((32, 32)), "b": jnp.zeros((32,))}}
+    )
+    qp = load_and_quantize_params(
+        abstract, str(tmp_path), QuantizationConfig(load_in_8bit=True, skip_modules=()),
+    )
+    assert isinstance(qp["block"]["w"], QuantizedTensor)
+    back = dequantize(qp["block"]["w"])
+    assert float(jnp.abs(back - sd["block.w"]).max()) < 0.05
+
+
+# -- fp8 ----------------------------------------------------------------------
+
+
+def test_fp8_dot_close_to_f32():
+    k1, k2 = jax.random.split(jax.random.key(0))
+    x = jax.random.normal(k1, (8, 64), jnp.float32)
+    w = jax.random.normal(k2, (64, 32), jnp.float32)
+    xm, wm = Fp8Meta.init(), Fp8Meta.init()
+    out, xm2, wm2 = jax.jit(fp8_dot)(x, w, xm, wm)
+    ref = x @ w
+    rel = float(jnp.abs(out.astype(jnp.float32) - ref).max() / jnp.abs(ref).max())
+    assert rel < 0.15, rel
+    # metas rolled: amax recorded, scale updated
+    assert float(xm2.amax_history[0]) == pytest.approx(float(jnp.abs(x).max()), rel=1e-5)
+    assert float(xm2.scale) != 1.0
+
+
+def test_fp8_delayed_scaling_improves_second_step():
+    """After one step the scale adapts to the tensor's range, so small-valued
+    tensors lose less precision than with the initial unit scale."""
+    x = jax.random.normal(jax.random.key(1), (16, 64)) * 1e-3
+    w = jax.random.normal(jax.random.key(2), (64, 16)) * 1e-3
+    ref = x @ w
+    xm, wm = Fp8Meta.init(), Fp8Meta.init()
+    out1, xm, wm = fp8_dot(x, w, xm, wm, out_dtype=jnp.float32)
+    out2, xm, wm = fp8_dot(x, w, xm, wm, out_dtype=jnp.float32)
+    err1 = float(jnp.abs(out1 - ref).max())
+    err2 = float(jnp.abs(out2 - ref).max())
+    assert err2 < err1
+
+
+def test_update_meta_rolls_history():
+    meta = Fp8Meta.init(history_len=4)
+    meta = update_meta(meta, jnp.asarray(2.0))
+    meta = update_meta(meta, jnp.asarray(8.0))
+    assert float(meta.amax_history[0]) == 8.0
+    assert float(meta.amax_history[1]) == 2.0
+    assert float(meta.scale) == pytest.approx(448.0 / 8.0)
+
+
+def test_init_fp8_state_matches_weights():
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    st = init_fp8_state(params)
+    assert st["b"] is None
+    assert isinstance(st["w"]["x"], Fp8Meta)
+
+
+# -- ulysses ------------------------------------------------------------------
+
+
+def test_ulysses_matches_plain_attention():
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.models.common import dot_product_attention
+    from accelerate_tpu.parallel.ulysses import ulysses_attention
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("seq",))
+    b, s, h, d = 2, 32, 4, 16
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(k2, (b, s, h, d), jnp.float32)
+    v = jax.random.normal(k3, (b, s, h, d), jnp.float32)
+    out = ulysses_attention(q, k, v, causal=True, mesh=mesh)
+    ref = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_falls_back_when_heads_dont_divide():
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.parallel.ulysses import ulysses_attention
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("seq",))
+    q = jnp.ones((1, 32, 3, 8))  # 3 heads % 4 != 0
+    out = ulysses_attention(q, q, q, causal=False, mesh=mesh)
+    assert out.shape == q.shape
+
+
+def test_ulysses_grads_flow():
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.parallel.ulysses import ulysses_attention
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("seq",))
+
+    def loss(q):
+        return ulysses_attention(q, q, q, causal=True, mesh=mesh).sum()
+
+    g = jax.grad(loss)(jnp.ones((1, 16, 4, 8)))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_quantize_int4_odd_width_roundtrip():
+    w = jax.random.normal(jax.random.key(5), (4, 7))
+    qt = quantize(w, bits=4)
+    back = dequantize(qt)
+    assert back.shape == w.shape
+    assert float(jnp.abs(back - w).max() / jnp.abs(w).max()) < 0.25
+
+
+def test_quantize_numpy_host_side():
+    """np input (e.g. memmap from an offload store) must quantize without
+    touching a device."""
+    w = np.random.default_rng(0).normal(size=(16, 32)).astype(np.float32)
+    qt = quantize(w, bits=8, block_size=16)
+    assert isinstance(qt.data, np.ndarray)  # stayed host-side
+    back = dequantize(qt)
+    assert float(jnp.abs(back - w).max() / np.abs(w).max()) < 0.02
+
+
+def test_context_attention_mode_dispatch():
+    from jax.sharding import Mesh
+
+    from accelerate_tpu.models.common import dot_product_attention
+    from accelerate_tpu.parallel import context_attention
+
+    devices = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devices, ("seq",))
+    q = jax.random.normal(jax.random.key(0), (1, 32, 4, 8), jnp.float32)
+    ref = dot_product_attention(q, q, q, causal=True)
+    for mode in ("ring", "ulysses"):
+        out = context_attention(q, q, q, causal=True, mode=mode, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_context_parallel_plugin_validates_mode():
+    from accelerate_tpu.utils.dataclasses import ContextParallelPlugin
+
+    with pytest.raises(ValueError, match="mode"):
+        ContextParallelPlugin(mode="allgather")
+    assert ContextParallelPlugin(mode="ulysses").mode == "ulysses"
