@@ -72,6 +72,13 @@ def execute_subprocess(cmd: list[str], env: dict | None = None) -> str:
     import subprocess
 
     merged = dict(os.environ)
+    # Child processes must import accelerate_tpu even when the package is not
+    # pip-installed (running from a source checkout): prepend the package's
+    # parent directory to PYTHONPATH.
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    merged["PYTHONPATH"] = os.pathsep.join(
+        p for p in [pkg_root, merged.get("PYTHONPATH", "")] if p
+    )
     if env:
         merged.update(env)
     proc = subprocess.run(cmd, capture_output=True, text=True, env=merged)
