@@ -37,9 +37,15 @@ _LAZY = {
     "DataLoaderDispatcher": ".data",
     "init_empty_weights": ".big_modeling",
     "infer_auto_device_map": ".big_modeling",
+    "get_balanced_memory": ".big_modeling",
+    "get_max_memory": ".big_modeling",
     "load_checkpoint_and_dispatch": ".big_modeling",
     "dispatch_model": ".big_modeling",
     "LocalSGD": ".local_sgd",
+    "prepare_pipeline": ".inference",
+    "prepare_sharded_inference": ".inference",
+    "PipelinedModel": ".inference",
+    "make_stage_fn": ".inference",
     "notebook_launcher": ".launchers",
     "debug_launcher": ".launchers",
     "profile": ".profiler",

@@ -34,6 +34,7 @@ from .logging import get_logger
 from .utils.modeling import (
     check_device_map,
     find_stacked_modules,
+    get_balanced_memory,
     get_max_memory,
     infer_auto_device_map,
     load_checkpoint_in_model,
@@ -49,6 +50,7 @@ __all__ = [
     "init_empty_weights",
     "init_on_device",
     "infer_auto_device_map",
+    "get_balanced_memory",
     "get_max_memory",
     "dispatch_model",
     "load_checkpoint_and_dispatch",

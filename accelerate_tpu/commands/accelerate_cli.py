@@ -17,6 +17,7 @@ SUBCOMMAND_MODULES = [
     "accelerate_tpu.commands.test",
     "accelerate_tpu.commands.estimate",
     "accelerate_tpu.commands.tpu",
+    "accelerate_tpu.commands.cloud",
 ]
 
 

@@ -1,0 +1,147 @@
+"""`accelerate-tpu cloud` — provision/inspect/launch Cloud TPU capacity.
+
+Parity target: the reference's SageMaker estate — `SageMakerConfig`
+(ref utils/dataclasses.py SageMakerDistributedType + commands/config/
+sagemaker.py, 267 LoC) and `sagemaker_launcher` (ref commands/launch.py:880)
+which convert a local launch request into a managed-cloud job submission.
+On TPU the managed cloud is GCP: the equivalent of "submit an estimator" is
+`gcloud compute tpus tpu-vm create` (+ queued-resources for reservations)
+followed by the pod SSH launch this CLI already does. Everything here builds
+command lines and never shells out unless asked, so the conversion logic is
+offline-testable exactly like ref tests/test_sagemaker.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TPUCloudConfig:
+    """Provisioning request (the SageMakerConfig analogue)."""
+
+    tpu_name: str = "accelerate-tpu"
+    accelerator_type: str = "v5litepod-8"
+    zone: str = "us-central1-a"
+    project: str | None = None
+    runtime_version: str = "tpu-ubuntu2204-base"
+    spot: bool = False
+    reserved: bool = False
+    network: str | None = None
+    tags: list[str] = field(default_factory=list)
+    startup_script: str | None = None
+
+    def scope_flags(self) -> list[str]:
+        flags = ["--zone", self.zone]
+        if self.project:
+            flags += ["--project", self.project]
+        return flags
+
+
+def build_create_cmd(cfg: TPUCloudConfig) -> list[str]:
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "create", cfg.tpu_name,
+        "--accelerator-type", cfg.accelerator_type,
+        "--version", cfg.runtime_version,
+        *cfg.scope_flags(),
+    ]
+    if cfg.spot:
+        cmd.append("--spot")
+    if cfg.reserved:
+        cmd.append("--reserved")
+    if cfg.network:
+        cmd += ["--network", cfg.network]
+    if cfg.tags:
+        cmd += ["--tags", ",".join(cfg.tags)]
+    if cfg.startup_script:
+        cmd += ["--metadata", f"startup-script={cfg.startup_script}"]
+    return cmd
+
+
+def build_delete_cmd(cfg: TPUCloudConfig) -> list[str]:
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "delete", cfg.tpu_name,
+        *cfg.scope_flags(), "--quiet",
+    ]
+
+
+def build_describe_cmd(cfg: TPUCloudConfig) -> list[str]:
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "describe", cfg.tpu_name,
+        *cfg.scope_flags(),
+    ]
+
+
+def build_remote_launch_cmd(
+    cfg: TPUCloudConfig, script: str, script_args: list[str] | None = None
+) -> list[str]:
+    """SSH every pod worker and run `accelerate-tpu launch` there — the
+    job-submission step (ref sagemaker_launcher hands off to the estimator;
+    here the fleet runs our own launcher, ref commands/launch.py:821-879
+    tpu_pod_launcher)."""
+    inner = ["accelerate-tpu", "launch", script, *(script_args or [])]
+    return [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", cfg.tpu_name,
+        *cfg.scope_flags(),
+        "--worker", "all",
+        "--command", shlex.join(inner),
+    ]
+
+
+_VERBS = {
+    "create": build_create_cmd,
+    "delete": build_delete_cmd,
+    "describe": build_describe_cmd,
+}
+
+
+def register_subcommand(subparsers) -> None:
+    p = subparsers.add_parser(
+        "cloud", help="provision / inspect / launch on Cloud TPU capacity"
+    )
+    p.add_argument("verb", choices=["create", "delete", "describe", "launch"])
+    p.add_argument("script", nargs="?", help="training script (verb=launch)")
+    p.add_argument("--name", default="accelerate-tpu", dest="tpu_name")
+    p.add_argument("--accelerator_type", default="v5litepod-8")
+    p.add_argument("--zone", default="us-central1-a")
+    p.add_argument("--project", default=None)
+    p.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--reserved", action="store_true")
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the gcloud command instead of running it")
+    p.add_argument("script_args", nargs="*", default=[],
+                   help="args for the training script; separate with `--`")
+    p.set_defaults(func=cloud_command)
+
+
+def cloud_command(args: argparse.Namespace) -> int:
+    cfg = TPUCloudConfig(
+        tpu_name=args.tpu_name,
+        accelerator_type=args.accelerator_type,
+        zone=args.zone,
+        project=args.project,
+        runtime_version=args.runtime_version,
+        spot=args.spot,
+        reserved=args.reserved,
+    )
+    if args.verb == "launch":
+        if not args.script:
+            raise SystemExit("cloud launch requires a script")
+        cmd = build_remote_launch_cmd(cfg, args.script, args.script_args)
+    else:
+        if args.script or args.script_args:
+            # 'cloud create my-tpu' would otherwise silently provision under
+            # the DEFAULT name with 'my-tpu' bound to the ignored script slot
+            raise SystemExit(
+                f"cloud {args.verb} takes no positional arguments; "
+                f"use --name to address a TPU (got {args.script!r})"
+            )
+        cmd = _VERBS[args.verb](cfg)
+    if args.dry_run:
+        print(shlex.join(cmd))
+        return 0
+    return subprocess.call(cmd)

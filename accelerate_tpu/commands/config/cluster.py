@@ -25,6 +25,23 @@ def _ask_bool(prompt: str, default: bool = False) -> bool:
     return raw in ("y", "yes", "true", "1")
 
 
+def _ask_choice(prompt: str, choices: list[str], default: str) -> str:
+    """Multiple choice via the arrow-key menu on a TTY (ref
+    commands/menu/selection_menu.py), else a plain text prompt."""
+    import sys
+
+    try:
+        is_tty = sys.stdin.isatty()
+    except Exception:
+        is_tty = False
+    if is_tty:
+        from ..menu import BulletMenu
+
+        idx = BulletMenu(prompt, choices, default=choices.index(default)).run()
+        return choices[idx]
+    return _ask(f"{prompt} ({'/'.join(choices)})", default)
+
+
 def interactive_config() -> LaunchConfig:
     print("accelerate-tpu config — answer a few questions (enter = default)\n")
     num_machines = _ask("How many hosts (TPU VM workers) will you launch on?", "1", int)
@@ -34,7 +51,9 @@ def interactive_config() -> LaunchConfig:
         config.main_process_ip = _ask("Coordinator (host 0) IP", "127.0.0.1")
         config.main_process_port = _ask("Coordinator port", "29500", int)
         config.machine_rank = _ask("Rank of this host", "0", int)
-    config.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
+    config.mixed_precision = _ask_choice(
+        "Mixed precision?", ["no", "bf16", "fp16", "fp8"], "bf16"
+    )
     mesh = _ask(
         "Mesh shape (e.g. 'data=-1', 'fsdp=8,model=4'; enter for pure data-parallel)",
         "",

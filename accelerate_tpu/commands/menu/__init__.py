@@ -1,0 +1,12 @@
+"""Interactive terminal menu for `accelerate-tpu config`
+(ref commands/menu/ — cursor.py/helpers.py/input.py/keymap.py/
+selection_menu.py, ~430 LoC).
+
+One module instead of five: `BulletMenu` renders a cursor-driven multiple
+choice; on a dumb/non-TTY stream it degrades to a numbered prompt so the
+questionnaire still works under pipes and CI.
+"""
+
+from .selection import BulletMenu, read_key
+
+__all__ = ["BulletMenu", "read_key"]

@@ -1,0 +1,147 @@
+"""Cursor-driven selection menu (ref commands/menu/selection_menu.py:1-130,
+keymap.py, input.py — rebuilt as one injectable-IO class).
+
+Key handling: raw-mode single chars; ANSI escape sequences for arrows; vim
+j/k; digit jump; enter/space select; q/ctrl-c abort. All IO goes through
+injectable streams so tests drive the menu without a pty.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Sequence
+
+UP = "up"
+DOWN = "down"
+ENTER = "enter"
+ABORT = "abort"
+
+_ESCAPE_SEQS = {
+    "[A": UP,
+    "[B": DOWN,
+    "OA": UP,
+    "OB": DOWN,
+}
+
+
+def read_key(stream=None) -> str:
+    """One decoded keypress: 'up'/'down'/'enter'/'abort'/literal char.
+
+    With a real TTY the terminal is flipped to raw mode for the read
+    (ref menu/keymap.py getch); for any other stream (tests, pipes) chars are
+    consumed directly.
+    """
+    stream = stream if stream is not None else sys.stdin
+    if hasattr(stream, "fileno") and _is_tty(stream):
+        ch = _getch_raw(stream)
+        getc = lambda: _getch_raw(stream)  # noqa: E731
+    else:
+        ch = stream.read(1)
+        getc = lambda: stream.read(1)  # noqa: E731
+    if ch == "":
+        return ABORT
+    if ch == "\x1b":
+        seq = getc() + getc()
+        return _ESCAPE_SEQS.get(seq, ABORT if seq == "" else seq)
+    if ch in ("\r", "\n", " "):
+        return ENTER
+    if ch in ("\x03", "q"):
+        return ABORT
+    if ch == "k":
+        return UP
+    if ch == "j":
+        return DOWN
+    return ch
+
+
+def _is_tty(stream) -> bool:
+    try:
+        return stream.isatty()
+    except Exception:
+        return False
+
+
+def _getch_raw(stream) -> str:
+    import termios
+    import tty
+
+    fd = stream.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        return stream.read(1)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+class BulletMenu:
+    """Arrow-key multiple choice (ref menu/selection_menu.py BulletMenu).
+
+    `run()` returns the selected index, or the default on abort. Pass
+    `in_stream`/`out_stream` to drive programmatically.
+    """
+
+    def __init__(
+        self,
+        prompt: str,
+        choices: Sequence[str],
+        default: int = 0,
+        in_stream=None,
+        out_stream=None,
+    ):
+        if not choices:
+            raise ValueError("BulletMenu needs at least one choice")
+        self.prompt = prompt
+        self.choices = list(choices)
+        self.default = min(max(default, 0), len(choices) - 1)
+        self.in_stream = in_stream if in_stream is not None else sys.stdin
+        self.out_stream = out_stream if out_stream is not None else sys.stdout
+
+    # -- rendering -----------------------------------------------------------
+    def _render(self, pos: int, first: bool) -> None:
+        out = self.out_stream
+        if not first:
+            out.write(f"\x1b[{len(self.choices)}A")  # cursor up N lines
+        for i, choice in enumerate(self.choices):
+            marker = "➔ " if i == pos else "  "
+            out.write(f"\x1b[2K{marker}{choice}\n")
+        out.flush()
+
+    # -- drivers -------------------------------------------------------------
+    def run(self) -> int:
+        if not _is_tty(self.in_stream) and self.in_stream is sys.stdin:
+            return self._run_plain()
+        return self._run_interactive()
+
+    def _run_interactive(self) -> int:
+        out = self.out_stream
+        out.write(f"{self.prompt}\n")
+        pos = self.default
+        self._render(pos, first=True)
+        while True:
+            key = read_key(self.in_stream)
+            if key == UP:
+                pos = (pos - 1) % len(self.choices)
+            elif key == DOWN:
+                pos = (pos + 1) % len(self.choices)
+            elif key == ENTER:
+                return pos
+            elif key == ABORT:
+                return self.default
+            elif key.isdigit() and 0 <= int(key) < len(self.choices):
+                pos = int(key)
+            self._render(pos, first=False)
+
+    def _run_plain(self) -> int:
+        """Numbered fallback for pipes/CI (no reference equivalent — the
+        reference menu requires a pty and breaks under redirection)."""
+        out = self.out_stream
+        out.write(f"{self.prompt}\n")
+        for i, choice in enumerate(self.choices):
+            out.write(f"  [{i}] {choice}\n")
+        out.write(f"Choice [{self.default}]: ")
+        out.flush()
+        raw = self.in_stream.readline().strip()
+        if raw.isdigit() and 0 <= int(raw) < len(self.choices):
+            return int(raw)
+        return self.default

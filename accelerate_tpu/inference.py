@@ -1,0 +1,165 @@
+"""Distributed inference: pipeline-parallel and GSPMD-sharded model serving.
+
+Replaces the reference's PiPPy integration (ref inference.py:78-188):
+`prepare_pippy` traces a torch module into per-rank `PipelineStage`s, rank 0
+feeds input chunks, the last rank emits outputs, optionally broadcast back
+(ref inference.py:101-123). TPU-native design has no tracing step and no
+per-rank processes to choreograph:
+
+- `prepare_pipeline` places layer-stacked params on the mesh `stage` axis and
+  compiles ONE XLA program that runs the GPipe schedule from
+  `parallel/pipeline.py` — micro-batch handoff is `lax.ppermute` over ICI,
+  and the "broadcast the last stage's output" step of PiPPy is a `psum`
+  already fused into the compiled schedule.
+- `prepare_sharded_inference` is the idiomatic-TPU alternative the reference
+  lacks: shard params with the GSPMD planner (model/fsdp axes) and jit the
+  forward; XLA inserts the collectives. On TPU this is almost always faster
+  than inference PP (SURVEY.md §2.2) — it is the default users should reach
+  for; `prepare_pipeline` exists for parity and for models that do not fit a
+  single stage's HBM even when sharded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from .parallel.pipeline import pipeline_apply, stack_layers_into_stages
+from .sharding.planner import plan_sharding, shard_pytree
+from .sharding.rules import ShardingRules
+from .utils.constants import AXIS_STAGE
+
+__all__ = [
+    "make_stage_fn",
+    "prepare_pipeline",
+    "prepare_sharded_inference",
+    "PipelinedModel",
+]
+
+
+def make_stage_fn(layer_fn: Callable[[Any, jax.Array], jax.Array]) -> Callable:
+    """Lift a per-layer body into a per-stage body.
+
+    `layer_fn(layer_params, x) -> x` is one transformer block; the returned
+    stage_fn scans it over the stage's `[L/S, ...]`-stacked slice. This is the
+    moral equivalent of PiPPy's `split_points="auto"` equal-layer split
+    (ref inference.py:130-141) — the split is a reshape, not a graph trace.
+    """
+
+    def stage_fn(stage_params: Any, x: jax.Array) -> jax.Array:
+        def body(h, layer):
+            return layer_fn(layer, h), None
+
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    return stage_fn
+
+
+@dataclass
+class PipelinedModel:
+    """Callable handle returned by `prepare_pipeline`.
+
+    Mirrors the wrapped-module forward the reference builds in
+    `prepare_pippy` (ref inference.py:161-188): call it with a global batch;
+    every process gets the full output (PiPPy's `gather_output=True`
+    behavior is the only one that makes sense under SPMD, where all devices
+    participate in one program).
+    """
+
+    stage_params: Any
+    num_stages: int
+    num_chunks: int
+    _compiled: Callable
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return self._compiled(self.stage_params, x)
+
+
+def prepare_pipeline(
+    layer_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_params: Any,
+    *,
+    num_chunks: int | None = None,
+    mesh=None,
+    axis_name: str = AXIS_STAGE,
+    pre_fn: Callable[[jax.Array], jax.Array] | None = None,
+    post_fn: Callable[[jax.Array], jax.Array] | None = None,
+) -> PipelinedModel:
+    """Pipeline-parallel inference over the mesh `stage` axis
+    (ref inference.py:126-188 `prepare_pippy`).
+
+    Args:
+      layer_fn: one decoder block, `layer_fn(layer_params_slice, x) -> x`.
+      layer_params: pytree whose leaves lead with the layer dim L
+        (the scan-stacked layout all `models/` families use).
+      num_chunks: micro-batches per call; defaults to the number of stages
+        (the reference's default, ref inference.py:150-153).
+      pre_fn / post_fn: embedding / head applied outside the pipelined body
+        (they are replicated, tiny, and would otherwise bubble the schedule).
+
+    The returned `PipelinedModel` is jit-compiled on first call.
+    """
+    if mesh is None:
+        from .state import PartialState
+
+        mesh = PartialState().mesh
+    num_stages = mesh.shape.get(axis_name, 1)
+    if num_stages <= 1:
+        raise ValueError(
+            f"mesh has no '{axis_name}' axis; use prepare_sharded_inference "
+            "for single-stage (GSPMD) serving"
+        )
+    if num_chunks is None:
+        num_chunks = num_stages
+    stage_params = stack_layers_into_stages(layer_params, num_stages)
+    stage_fn = make_stage_fn(layer_fn)
+
+    @partial(jax.jit, static_argnames=())
+    def run(stage_params, x):
+        if pre_fn is not None:
+            x = pre_fn(x)
+        y = pipeline_apply(
+            stage_fn, stage_params, x, num_chunks, mesh=mesh, axis_name=axis_name
+        )
+        if post_fn is not None:
+            y = post_fn(y)
+        return y
+
+    return PipelinedModel(
+        stage_params=stage_params,
+        num_stages=num_stages,
+        num_chunks=num_chunks,
+        _compiled=run,
+    )
+
+
+def prepare_sharded_inference(
+    forward_fn: Callable[..., Any],
+    params: Any,
+    *,
+    mesh=None,
+    rules: ShardingRules | None = None,
+    donate_params: bool = False,
+) -> tuple[Callable[..., Any], Any]:
+    """GSPMD-sharded inference: the TPU-idiomatic replacement for inference
+    PP (SURVEY.md §2.2 row "PP (inference)").
+
+    Shards `params` with the planner's rules (tensor-parallel `model` axis +
+    `fsdp` gather-on-use), jits `forward_fn(params, *inputs)`, and returns
+    `(jitted_fn, sharded_params)`. XLA inserts all_gather/reduce_scatter over
+    ICI — no stage choreography, no micro-batch bubbles.
+    """
+    if mesh is None:
+        from .state import PartialState
+
+        mesh = PartialState().mesh
+    plan = plan_sharding(params, mesh, rules=rules)
+    sharded = shard_pytree(params, plan)
+    donate = (0,) if donate_params else ()
+    jitted = jax.jit(forward_fn, donate_argnums=donate)
+    return jitted, sharded

@@ -24,12 +24,21 @@ from .utils.imports import (
     is_aim_available,
     is_clearml_available,
     is_comet_ml_available,
+    is_dvclive_available,
     is_mlflow_available,
     is_tensorboard_available,
     is_wandb_available,
 )
 
 logger = get_logger(__name__)
+
+
+def _scalarize(v):
+    """Coerce 0-d jax/numpy values to Python scalars so the isinstance
+    filters below accept the metrics a JAX loop actually produces."""
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return v.item()
+    return v
 
 
 def on_main_process(function):
@@ -137,6 +146,7 @@ class TensorBoardTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
         for k, v in values.items():
+            v = _scalarize(v)
             if isinstance(v, (int, float)):
                 self.writer.add_scalar(k, v, global_step=step, **kwargs)
             elif isinstance(v, str):
@@ -208,7 +218,10 @@ class MLflowTracker(GeneralTracker):
 
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
-        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        metrics = {
+            k: v for k, v in ((k, _scalarize(v)) for k, v in values.items())
+            if isinstance(v, (int, float))
+        }
         self._mlflow.log_metrics(metrics, step=step)
 
     @on_main_process
@@ -305,12 +318,49 @@ class ClearMLTracker(GeneralTracker):
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
         logger_obj = self.task.get_logger()
         for k, v in values.items():
+            v = _scalarize(v)
             if isinstance(v, (int, float)):
                 logger_obj.report_scalar(title=k, series=k, value=v, iteration=step or 0)
 
     @on_main_process
     def finish(self) -> None:
         self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    """ref tracking.py:876."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, live=None, **kwargs):
+        super().__init__(run_name)
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict) -> None:
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs) -> None:
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            v = _scalarize(v)
+            if isinstance(v, (int, float)):
+                self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self) -> None:
+        self.live.end()
 
 
 LOGGER_TYPE_TO_CLASS = {
@@ -321,6 +371,7 @@ LOGGER_TYPE_TO_CLASS = {
     "comet_ml": CometMLTracker,
     "aim": AimTracker,
     "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
 }
 
 _AVAILABILITY = {
@@ -331,6 +382,7 @@ _AVAILABILITY = {
     "comet_ml": is_comet_ml_available,
     "aim": is_aim_available,
     "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
 }
 
 

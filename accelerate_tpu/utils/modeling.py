@@ -183,21 +183,10 @@ def infer_auto_device_map(
     """
     if not isinstance(params, dict):
         raise TypeError("params must be a (nested) dict pytree")
-    stacked = {
-        k: v for k, v in find_stacked_modules(params).items() if k not in no_split_modules
-    }
-    sizes = compute_module_sizes(params, dtype=dtype, stacked_modules=stacked)
+    units, sizes = _planning_units(params, no_split_modules, dtype)
     memory = get_max_memory(max_memory)
     devices = [k for k in memory if k not in ("cpu", "disk")] + ["cpu", "disk"]
     free = {d: memory[d] for d in devices}
-
-    # planning units, in traversal order
-    units: list[str] = []
-    for name, sub in params.items():
-        if name in stacked:
-            units.extend(f"{name}.{i}" for i in range(stacked[name]))
-        else:
-            units.append(name)
 
     device_map: "OrderedDict[str, Any]" = OrderedDict()
     cursor = 0
@@ -211,6 +200,63 @@ def infer_auto_device_map(
         if verbose:
             print(f"  {unit:40s} -> {target} ({size / 2**20:.1f} MiB)")
     return device_map  # cursor loop makes 'disk' the unconditional sink
+
+
+def _planning_units(
+    params: Any, no_split_modules: tuple, dtype
+) -> tuple[list[str], dict[str, int]]:
+    """(units-in-traversal-order, sizes) — the atomic placement granularity
+    shared by `infer_auto_device_map` and `get_balanced_memory` so their
+    notion of "un-splittable unit" can never drift apart."""
+    stacked = {
+        k: v for k, v in find_stacked_modules(params).items() if k not in no_split_modules
+    }
+    sizes = compute_module_sizes(params, dtype=dtype, stacked_modules=stacked)
+    units: list[str] = []
+    for name in params:
+        if name in stacked:
+            units.extend(f"{name}.{i}" for i in range(stacked[name]))
+        else:
+            units.append(name)
+    return units, sizes
+
+
+def get_balanced_memory(
+    params: Any,
+    max_memory: dict | None = None,
+    no_split_modules: tuple = (),
+    dtype=None,
+    low_zero: bool = False,
+) -> "OrderedDict[Any, int]":
+    """Per-device memory caps that spread the model EVENLY across devices
+    instead of greedily filling device 0 (ref utils/modeling.py:932-1065).
+
+    Feed the result to `infer_auto_device_map(params, max_memory=...)`.
+    `low_zero=True` halves device 0's allowance, leaving headroom there for
+    generation-time buffers (the reference's use case for `generate()`).
+    The last device keeps its full capacity so it remains the sink before
+    spill to 'cpu'/'disk'.
+    """
+    memory = get_max_memory(max_memory)
+    devices = [k for k in memory if k not in ("cpu", "disk") and memory[k] > 0]
+    if len(devices) <= 1:
+        if low_zero and devices:
+            memory[devices[0]] = memory[devices[0]] // 2
+        return memory
+
+    units, sizes = _planning_units(params, no_split_modules, dtype)
+    total = sizes[""]
+    # the buffer reflects the real atomic granularity: the biggest
+    # un-splittable unit must fit inside each device's slack
+    buffer = max((sizes[u] for u in units), default=0)
+
+    n_balanced = len(devices) - (1 if low_zero else 0)
+    per_device = total // n_balanced + buffer
+    for d in devices[:-1]:
+        memory[d] = min(memory[d], per_device)
+    if low_zero:
+        memory[devices[0]] = min(memory[devices[0]], per_device // 2)
+    return memory
 
 
 def check_device_map(params: Any, device_map: Mapping[str, Any]) -> None:

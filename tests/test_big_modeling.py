@@ -242,3 +242,33 @@ def test_torch_bin_import(tmp_path):
     out = load_state_dict(str(path))
     assert out["embed.embedding"].shape == (V, D)
     np.testing.assert_allclose(out["head.kernel"], sd["head.kernel"].numpy())
+
+
+def test_get_balanced_memory_spreads_evenly():
+    from accelerate_tpu.utils.modeling import get_balanced_memory
+
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+    sizes = compute_module_sizes(abstract)
+    total = sizes[""]
+    big = 100 * total
+    mm = get_balanced_memory(abstract, max_memory={0: big, 1: big, 2: big, 3: big})
+    # clamped devices get ~total/4 + buffer, far below the raw cap
+    assert mm[0] < big and mm[1] < big and mm[2] < big
+    assert mm[3] == big  # last device stays the sink
+    assert mm[0] >= total // 4  # but still fits its fair share
+    # the balanced caps actually spread the map across devices
+    dmap = infer_auto_device_map(abstract, max_memory=mm)
+    used = {v for v in dmap.values()}
+    assert len(used - {"cpu", "disk"}) >= 2
+
+
+def test_get_balanced_memory_low_zero():
+    from accelerate_tpu.utils.modeling import get_balanced_memory
+
+    abstract = init_empty_weights(tiny_init, jax.random.key(0))
+    total = compute_module_sizes(abstract)[""]
+    big = 100 * total
+    mm = get_balanced_memory(
+        abstract, max_memory={0: big, 1: big, 2: big, 3: big}, low_zero=True
+    )
+    assert mm[0] < mm[1]  # device 0 keeps headroom for generation buffers

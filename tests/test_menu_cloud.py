@@ -1,0 +1,143 @@
+"""Interactive-menu and cloud-launcher tests: menu key handling is driven
+through injected streams (no pty), cloud command assembly is verified offline
+(ref tests/test_sagemaker.py pattern — conversion logic only, no cloud)."""
+
+import io
+
+import pytest
+
+from accelerate_tpu.commands.cloud import (
+    TPUCloudConfig,
+    build_create_cmd,
+    build_delete_cmd,
+    build_remote_launch_cmd,
+    cloud_command,
+)
+from accelerate_tpu.commands.menu import BulletMenu, read_key
+
+
+# --- key decoding -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "raw,expected",
+    [
+        ("\x1b[A", "up"),
+        ("\x1b[B", "down"),
+        ("\x1bOA", "up"),
+        ("\r", "enter"),
+        ("\n", "enter"),
+        (" ", "enter"),
+        ("k", "up"),
+        ("j", "down"),
+        ("q", "abort"),
+        ("\x03", "abort"),
+        ("3", "3"),
+    ],
+)
+def test_read_key_decodes(raw, expected):
+    assert read_key(io.StringIO(raw)) == expected
+
+
+def test_read_key_empty_stream_aborts():
+    assert read_key(io.StringIO("")) == "abort"
+
+
+# --- menu -------------------------------------------------------------------
+
+
+def _run_menu(keys: str, choices=("a", "b", "c"), default=0):
+    menu = BulletMenu(
+        "pick", choices, default=default,
+        in_stream=io.StringIO(keys), out_stream=io.StringIO(),
+    )
+    return menu._run_interactive()
+
+
+def test_menu_down_enter():
+    assert _run_menu("j\r") == 1
+
+
+def test_menu_wraps_upward():
+    assert _run_menu("k\r") == 2
+
+
+def test_menu_digit_jump():
+    assert _run_menu("2\r") == 2
+
+
+def test_menu_abort_returns_default():
+    assert _run_menu("q", default=1) == 1
+
+
+def test_menu_arrow_sequences():
+    assert _run_menu("\x1b[B\x1b[B\r") == 2
+
+
+def test_menu_plain_fallback():
+    menu = BulletMenu(
+        "pick", ["x", "y"], default=0,
+        in_stream=io.StringIO("1\n"), out_stream=io.StringIO(),
+    )
+    assert menu._run_plain() == 1
+
+
+def test_menu_plain_fallback_bad_input_uses_default():
+    menu = BulletMenu(
+        "pick", ["x", "y"], default=0,
+        in_stream=io.StringIO("zzz\n"), out_stream=io.StringIO(),
+    )
+    assert menu._run_plain() == 0
+
+
+def test_menu_rejects_empty_choices():
+    with pytest.raises(ValueError):
+        BulletMenu("pick", [])
+
+
+# --- cloud command assembly -------------------------------------------------
+
+
+def test_cloud_rejects_stray_positional_for_non_launch_verbs():
+    from accelerate_tpu.commands.accelerate_cli import build_parser
+
+    args = build_parser().parse_args(["cloud", "create", "my-tpu", "--dry_run"])
+    with pytest.raises(SystemExit, match="my-tpu"):
+        cloud_command(args)
+
+
+def test_build_create_cmd():
+    cfg = TPUCloudConfig(
+        tpu_name="trainer", accelerator_type="v5p-16", zone="us-east5-a",
+        project="proj", spot=True, tags=["ml", "tpu"],
+    )
+    cmd = build_create_cmd(cfg)
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create", "trainer"]
+    assert "--accelerator-type" in cmd and cmd[cmd.index("--accelerator-type") + 1] == "v5p-16"
+    assert "--spot" in cmd and "--project" in cmd
+    assert cmd[cmd.index("--tags") + 1] == "ml,tpu"
+
+
+def test_build_delete_cmd_quiet():
+    cmd = build_delete_cmd(TPUCloudConfig(tpu_name="t"))
+    assert cmd[4:6] == ["delete", "t"] and "--quiet" in cmd
+
+
+def test_build_remote_launch_cmd_all_workers():
+    cfg = TPUCloudConfig(tpu_name="pod")
+    cmd = build_remote_launch_cmd(cfg, "train.py", ["--lr", "1e-3"])
+    assert cmd[cmd.index("--worker") + 1] == "all"
+    inner = cmd[cmd.index("--command") + 1]
+    assert "accelerate-tpu launch train.py --lr 1e-3" == inner
+
+
+def test_cloud_subcommand_registered():
+    from accelerate_tpu.commands.accelerate_cli import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(["cloud", "describe", "--name", "x", "--dry_run"])
+    assert args.tpu_name == "x" and args.verb == "describe" and args.dry_run
+    args = parser.parse_args(
+        ["cloud", "launch", "train.py", "--name", "pod", "--", "--lr", "1e-3"]
+    )
+    assert args.script == "train.py" and args.script_args == ["--lr", "1e-3"]

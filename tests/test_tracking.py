@@ -1,0 +1,97 @@
+"""Tracker tests (ref tests/test_tracking.py): registry completeness, the
+native JSONL backend, filter_trackers selection, and the Accelerator surface."""
+
+import json
+import sys
+import types
+
+import pytest
+
+from accelerate_tpu.tracking import (
+    LOGGER_TYPE_TO_CLASS,
+    DVCLiveTracker,
+    GeneralTracker,
+    JSONLTracker,
+    filter_trackers,
+)
+from accelerate_tpu.utils.dataclasses import LoggerType
+
+
+def test_registry_covers_all_logger_types():
+    # every LoggerType except the "all" sentinel has a concrete class
+    names = {str(t) for t in LoggerType if t != LoggerType.ALL}
+    assert names == set(LOGGER_TYPE_TO_CLASS)
+
+
+def test_jsonl_tracker_roundtrip(tmp_path):
+    t = JSONLTracker("run", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 1e-3, "layers": 2})
+    t.log({"loss": 0.5}, step=1)
+    t.log({"loss": 0.25}, step=2)
+    t.finish()
+    lines = [json.loads(l) for l in open(t.path)]
+    assert lines[0]["event"] == "config" and lines[0]["config"]["lr"] == 1e-3
+    assert lines[2]["loss"] == 0.25 and lines[2]["step"] == 2
+
+
+def test_filter_trackers_selects_available(tmp_path):
+    trackers = filter_trackers(["jsonl"], logging_dir=str(tmp_path))
+    assert len(trackers) == 1 and isinstance(trackers[0], JSONLTracker)
+    # unavailable backends are skipped, not fatal
+    trackers = filter_trackers(["jsonl", "aim"], logging_dir=str(tmp_path))
+    assert all(isinstance(t, GeneralTracker) for t in trackers)
+
+
+def test_filter_trackers_all_includes_jsonl(tmp_path):
+    trackers = filter_trackers(["all"], logging_dir=str(tmp_path))
+    assert any(isinstance(t, JSONLTracker) for t in trackers)
+
+
+def test_filter_trackers_rejects_unknown(tmp_path):
+    with pytest.raises(ValueError):
+        filter_trackers(["not_a_tracker"], logging_dir=str(tmp_path))
+
+
+def test_filter_trackers_passes_instances(tmp_path):
+    inst = JSONLTracker("run", logging_dir=str(tmp_path))
+    assert filter_trackers([inst]) == [inst]
+
+
+class _FakeLive:
+    def __init__(self, **kwargs):
+        self.params = None
+        self.metrics = []
+        self.step = None
+        self.ended = False
+
+    def log_params(self, values):
+        self.params = values
+
+    def log_metric(self, k, v):
+        self.metrics.append((self.step, k, v))
+
+    def next_step(self):
+        pass
+
+    def end(self):
+        self.ended = True
+
+
+def test_dvclive_tracker_with_stub(monkeypatch):
+    monkeypatch.setitem(sys.modules, "dvclive", types.SimpleNamespace(Live=_FakeLive))
+    t = DVCLiveTracker("run")
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 1.5, "note": "skipped-non-scalar"}, step=3)
+    t.finish()
+    assert t.live.params == {"lr": 0.1}
+    assert t.live.metrics == [(3, "loss", 1.5)]
+    assert t.live.ended
+
+
+def test_dvclive_tracker_accepts_array_scalars(monkeypatch):
+    import numpy as np
+
+    monkeypatch.setitem(sys.modules, "dvclive", types.SimpleNamespace(Live=_FakeLive))
+    t = DVCLiveTracker("run")
+    t.log({"loss": np.float32(1.5), "acc": np.asarray(0.5)}, step=1)
+    assert sorted(t.live.metrics) == [(1, "acc", 0.5), (1, "loss", 1.5)]
