@@ -26,20 +26,13 @@ def _ask_bool(prompt: str, default: bool = False) -> bool:
 
 
 def _ask_choice(prompt: str, choices: list[str], default: str) -> str:
-    """Multiple choice via the arrow-key menu on a TTY (ref
-    commands/menu/selection_menu.py), else a plain text prompt."""
-    import sys
+    """Multiple choice via the arrow-key menu (ref
+    commands/menu/selection_menu.py); validated numbered prompt off-TTY."""
+    from ..menu import BulletMenu
 
-    try:
-        is_tty = sys.stdin.isatty()
-    except Exception:
-        is_tty = False
-    if is_tty:
-        from ..menu import BulletMenu
-
-        idx = BulletMenu(prompt, choices, default=choices.index(default)).run()
-        return choices[idx]
-    return _ask(f"{prompt} ({'/'.join(choices)})", default)
+    # BulletMenu handles non-TTY stdin itself (validated numbered prompt)
+    idx = BulletMenu(prompt, choices, default=choices.index(default)).run()
+    return choices[idx]
 
 
 def interactive_config() -> LaunchConfig:

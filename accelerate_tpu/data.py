@@ -196,6 +196,69 @@ class BatchSamplerShard:
         yield batch
 
 
+class ShardedBatchIterable:
+    """Stride a sized stream of pre-assembled batches across hosts — the
+    plain-iterable analogue of `BatchSamplerShard` (ref data_loader.py:100).
+
+    `even_batches=True` recycles initial batches (and pads any short tail
+    batch with wraparound rows) so every host yields the same number of
+    equally-shaped batches and SPMD steps stay in lockstep. The duplicated
+    filler rows are NOT tracked as a remainder — like the reference's
+    sampler-level wraparound, eval paths that must see each sample exactly
+    once should dedupe or use the dispatcher.
+    """
+
+    def __init__(self, batches, num_processes: int, process_index: int,
+                 even_batches: bool = True):
+        self.batches = batches
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.even_batches = even_batches
+        self.batch_size = getattr(batches, "batch_size", None)
+
+    def __len__(self) -> int:
+        n = len(self.batches)  # type: ignore[arg-type]
+        q, r = divmod(n, self.num_processes)
+        if r == 0:
+            return q
+        if self.even_batches:
+            return q + 1
+        return q + (1 if self.process_index < r else 0)
+
+    def __iter__(self):
+        P, rank = self.num_processes, self.process_index
+        n = len(self.batches)  # type: ignore[arg-type]
+        tail = n % P
+        # which batch (if any) this host recycles to complete the final round
+        recycle_idx = None
+        if tail and self.even_batches and rank >= tail:
+            recycle_idx = (rank - tail) % min(P, n)
+        recycled = None
+        full_size = None
+        for cursor, batch in enumerate(self.batches):
+            if full_size is None:
+                full_size = find_batch_size(batch)
+            if cursor == recycle_idx:
+                recycled = batch
+            if cursor % P == rank:
+                if self.even_batches and tail and cursor >= n - tail:
+                    batch = self._pad_to_full(batch, full_size)
+                yield batch
+        if recycled is not None:
+            yield self._pad_to_full(recycled, full_size)
+
+    @staticmethod
+    def _pad_to_full(batch, full_size):
+        """Keep per-host shapes identical in the wraparound round: a short
+        tail batch is padded up to the size of a full batch."""
+        if full_size is None:
+            return batch
+        size = find_batch_size(batch)
+        if size is not None and size < full_size:
+            return pad_batch_to(batch, full_size)
+        return batch
+
+
 class IterableDatasetShard:
     """Shard an *iterable* source of samples across hosts
     (ref data_loader.py:256-390): buffer `batch_size * num_processes`
@@ -651,6 +714,11 @@ def prepare_data_loader(
             num_processes=num_processes,
             process_index=process_index,
             split_batches=split_batches,
+        )
+    elif num_processes > 1:
+        # sized stream of ready-made batches: stride batches across hosts
+        loader = ShardedBatchIterable(
+            dataloader, num_processes, process_index, even_batches=even_batches
         )
 
     return DataLoaderShard(

@@ -144,7 +144,6 @@ def prepare_sharded_inference(
     *,
     mesh=None,
     rules: ShardingRules | None = None,
-    donate_params: bool = False,
 ) -> tuple[Callable[..., Any], Any]:
     """GSPMD-sharded inference: the TPU-idiomatic replacement for inference
     PP (SURVEY.md §2.2 row "PP (inference)").
@@ -160,6 +159,7 @@ def prepare_sharded_inference(
         mesh = PartialState().mesh
     plan = plan_sharding(params, mesh, rules=rules)
     sharded = shard_pytree(params, plan)
-    donate = (0,) if donate_params else ()
-    jitted = jax.jit(forward_fn, donate_argnums=donate)
+    # params are NOT donated: the forward returns activations, so donation
+    # would invalidate the sharded params after the first call
+    jitted = jax.jit(forward_fn)
     return jitted, sharded

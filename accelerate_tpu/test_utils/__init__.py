@@ -106,6 +106,11 @@ def launch_command_for(script: str, num_processes: int = 1,
 
 
 def main_test_script_path() -> str:
+    return bundled_script_path("test_script.py")
+
+
+def bundled_script_path(name: str) -> str:
+    """Path to a bundled launch-and-assert script under scripts/."""
     from pathlib import Path
 
-    return str(Path(__file__).parent / "scripts" / "test_script.py")
+    return str(Path(__file__).parent / "scripts" / name)

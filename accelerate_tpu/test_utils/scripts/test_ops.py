@@ -1,0 +1,111 @@
+"""Launch-and-assert: pytree collectives
+(ref test_utils/scripts/test_ops.py, 179 LoC; SURVEY.md §4).
+
+Every rank asserts gather/reduce/broadcast/pad_across_processes on nested
+pytrees, rank-uneven shapes, and host-object collectives.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_gather_pytree(state):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.operations import gather
+
+    rank, world = state.process_index, state.num_processes
+    tree = {
+        "a": jnp.full((2, 3), float(rank)),
+        "nested": [jnp.arange(4, dtype=jnp.float32) + rank],
+    }
+    out = gather(tree)
+    a = np.asarray(out["a"])
+    assert a.shape == (2 * world, 3), a.shape
+    assert set(np.unique(a).tolist()) == set(float(r) for r in range(world))
+    n = np.asarray(out["nested"][0])
+    assert n.shape == (4 * world,), n.shape
+
+
+def check_reduce(state):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.operations import reduce
+
+    rank, world = state.process_index, state.num_processes
+    tree = {"x": jnp.asarray([float(rank + 1)])}
+    total = np.asarray(reduce(tree, reduction="sum")["x"])
+    np.testing.assert_allclose(total, [world * (world + 1) / 2])
+    mean = np.asarray(reduce(tree, reduction="mean")["x"])
+    np.testing.assert_allclose(mean, [(world + 1) / 2])
+
+
+def check_broadcast(state):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.operations import broadcast, broadcast_object_list
+
+    rank = state.process_index
+    tree = {"w": jnp.full((3,), float(rank)), "b": jnp.asarray([float(rank) * 2])}
+    out = broadcast(tree, from_process=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.zeros(3))
+    np.testing.assert_allclose(np.asarray(out["b"]), [0.0])
+
+    objs = broadcast_object_list([{"rank": rank}, rank * 10])
+    assert objs == [{"rank": 0}, 0], objs
+
+
+def check_pad_across_processes(state):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.operations import gather, pad_across_processes
+
+    rank, world = state.process_index, state.num_processes
+    # rank-dependent length: rank r holds r+1 rows
+    local = jnp.full((rank + 1, 2), float(rank))
+    padded = pad_across_processes(local, dim=0, pad_index=-1.0)
+    assert padded.shape[0] == world, padded.shape
+    gathered = np.asarray(gather(padded))
+    assert gathered.shape == (world * world, 2), gathered.shape
+    # each rank's block: r+1 real rows then pads
+    blocks = gathered.reshape(world, world, 2)
+    for r in range(world):
+        np.testing.assert_allclose(blocks[r, : r + 1], float(r))
+        if r + 1 < world:
+            np.testing.assert_allclose(blocks[r, r + 1 :], -1.0)
+
+    # pad_first puts padding before the data
+    padded_first = np.asarray(
+        pad_across_processes(local, dim=0, pad_index=-1.0, pad_first=True)
+    )
+    np.testing.assert_allclose(padded_first[: world - (rank + 1)], -1.0)
+    np.testing.assert_allclose(padded_first[world - (rank + 1) :], float(rank))
+
+
+def check_gather_object(state):
+    from accelerate_tpu.utils.operations import gather_object
+
+    rank, world = state.process_index, state.num_processes
+    # arbitrary (non-tensor) payloads — the reference's TPU path raised
+    # NotImplementedError here (ref utils/operations.py:462-463); ours works
+    objs = gather_object({"rank": rank, "msg": f"hello-{rank}"})
+    assert len(objs) == world
+    assert sorted(o["rank"] for o in objs) == list(range(world))
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    check_gather_pytree(state)
+    check_reduce(state)
+    check_broadcast(state)
+    check_pad_across_processes(state)
+    check_gather_object(state)
+    if state.is_main_process:
+        print(f"test_ops: ALL CHECKS PASSED ({state.num_processes} process(es))")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,143 @@
+"""Launch-and-assert: gradient-sync / accumulation semantics
+(ref test_utils/scripts/test_sync.py, 392 LoC; SURVEY.md §4).
+
+Every rank asserts:
+- `accumulate()` flips `sync_gradients` exactly at accumulation boundaries,
+  `no_sync()` forces it off, `sync_each_batch` forces it on;
+- k accumulated micro-batches produce the same update as one k-times-larger
+  batch (the functional analogue of the reference's DDP no_sync grad-equality
+  check);
+- after a sync step every process holds bitwise-identical parameters.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_sync_flag_schedule():
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils import GradientAccumulationPlugin
+
+    PartialState._reset_state()
+    acc = Accelerator(gradient_accumulation_steps=3)
+    flags = []
+    for _ in range(6):
+        with acc.accumulate():
+            flags.append(acc.sync_gradients)
+    assert flags == [False, False, True, False, False, True], flags
+
+    # no_sync forces accumulation regardless of the schedule
+    with acc.no_sync():
+        assert not acc.sync_gradients
+    # flag restored afterwards (was True at the last boundary)
+    assert acc.sync_gradients
+
+    # sync_each_batch syncs on EVERY micro-step (ref dataclasses.py:586)
+    PartialState._reset_state()
+    acc2 = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=4, sync_each_batch=True
+        )
+    )
+    flags2 = []
+    for _ in range(4):
+        with acc2.accumulate():
+            flags2.append(acc2.sync_gradients)
+    assert flags2 == [True] * 4, flags2
+
+
+def check_accumulation_equivalence():
+    """k micro-batches through the accum buffer == one big batch, one step."""
+    import jax
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+
+    k, bs = 4, 8
+    ds = RegressionDataset(length=k * bs, seed=11)
+
+    def run(steps_cfg):
+        PartialState._reset_state()
+        acc = Accelerator(gradient_accumulation_steps=steps_cfg)
+        ts = TrainState.create(
+            apply_fn=None,
+            params=regression_params(),
+            tx=optax.sgd(0.1),
+            use_grad_accum_buffer=steps_cfg > 1,
+        )
+        step = acc.train_step(regression_loss)
+        if steps_cfg > 1:
+            for i in range(k):
+                sl = slice(i * bs, (i + 1) * bs)
+                ts, _ = step(ts, {"x": ds.x[sl], "y": ds.y[sl]})
+        else:
+            ts, _ = step(ts, {"x": ds.x, "y": ds.y})
+        return jax.device_get(ts.params)
+
+    accum = run(k)
+    big = run(1)
+    np.testing.assert_allclose(accum["a"], big["a"], rtol=1e-5)
+    np.testing.assert_allclose(accum["b"], big["b"], rtol=1e-5)
+
+
+def check_params_identical_across_ranks():
+    import jax
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+    from accelerate_tpu.utils.operations import gather_object
+
+    PartialState._reset_state()
+    acc = Accelerator(gradient_accumulation_steps=2)
+    ds = RegressionDataset(length=32, seed=3)
+    loader = acc.prepare(
+        [{"x": ds.x[i : i + 4], "y": ds.y[i : i + 4]} for i in range(0, 32, 4)]
+    )
+    ts = acc.prepare(
+        TrainState.create(
+            apply_fn=None,
+            params=regression_params(),
+            tx=optax.sgd(0.05),
+            use_grad_accum_buffer=True,
+        )
+    )
+    step = acc.train_step(regression_loss)
+    for batch in loader:
+        ts, _ = step(ts, batch)
+    a = float(jax.device_get(ts.params["a"]))
+    b = float(jax.device_get(ts.params["b"]))
+    everyone = gather_object((a, b))
+    assert len(set(everyone)) == 1, f"params diverged across ranks: {everyone}"
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    world = state.num_processes
+    check_sync_flag_schedule()
+    check_accumulation_equivalence()
+    check_params_identical_across_ranks()
+    state = PartialState()
+    if state.is_main_process:
+        print(f"test_sync: ALL CHECKS PASSED ({world} process(es))")
+
+
+if __name__ == "__main__":
+    main()

@@ -240,8 +240,8 @@ def get_balanced_memory(
     memory = get_max_memory(max_memory)
     devices = [k for k in memory if k not in ("cpu", "disk") and memory[k] > 0]
     if len(devices) <= 1:
-        if low_zero and devices:
-            memory[devices[0]] = memory[devices[0]] // 2
+        # low_zero needs a second device to absorb displaced layers; with one
+        # device halving its cap would just spill a fitting model to cpu/disk
         return memory
 
     units, sizes = _planning_units(params, no_split_modules, dtype)

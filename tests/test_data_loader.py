@@ -245,3 +245,39 @@ def test_prepare_torch_loader_resharded():
     assert len(batches) == 4  # 8 batches strided over 2 hosts
     np.testing.assert_array_equal(np.asarray(batches[0]["x"]), [0.0, 1.0])
     np.testing.assert_array_equal(np.asarray(batches[1]["x"]), [4.0, 5.0])
+
+
+def test_sharded_batch_iterable_lockstep_shapes():
+    """Uneven tail across hosts: every host yields the same number of
+    batches, all padded to the full batch size (SPMD lockstep invariant)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [
+        {"x": np.arange(8, dtype=np.float32)},
+        {"x": np.arange(8, 16, dtype=np.float32)},
+        {"x": np.arange(16, 22, dtype=np.float32)},  # short tail (6 rows)
+    ]
+    per_host = [
+        list(ShardedBatchIterable(batches, 2, rank, even_batches=True))
+        for rank in range(2)
+    ]
+    counts = [len(b) for b in per_host]
+    assert counts == [2, 2], counts
+    for host in per_host:
+        for b in host:
+            assert np.asarray(b["x"]).shape == (8,), b
+    # host0's tail round holds the real short batch padded; host1 recycled
+    real = np.asarray(per_host[0][1]["x"])
+    np.testing.assert_array_equal(real[:6], np.arange(16, 22, dtype=np.float32))
+
+
+def test_sharded_batch_iterable_uneven_no_even_batches():
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.full((4,), i, np.float32)} for i in range(5)]
+    got = [
+        [int(np.asarray(b["x"])[0]) for b in
+         ShardedBatchIterable(batches, 2, rank, even_batches=False)]
+        for rank in range(2)
+    ]
+    assert got == [[0, 2, 4], [1, 3]], got

@@ -1,0 +1,39 @@
+"""The bundled launch-and-assert scripts (ref tests/test_multigpu.py pattern,
+SURVEY.md §4): each script carries rank-level asserts; here they run in the
+pytest 8-device CPU world, and (slow) under `accelerate-tpu launch` with a
+real 2-process jax.distributed world.
+"""
+
+import importlib.util
+
+import pytest
+
+from accelerate_tpu.test_utils import (
+    execute_subprocess,
+    launch_command_for,
+    bundled_script_path,
+)
+
+SCRIPTS = ["test_sync.py", "test_ops.py", "test_distributed_data_loop.py"]
+
+
+def _run_in_process(name: str) -> None:
+    spec = importlib.util.spec_from_file_location(
+        name.removesuffix(".py"), bundled_script_path(name)
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+
+
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_script_in_process(script):
+    _run_in_process(script)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", SCRIPTS)
+def test_script_two_process_world(script):
+    cmd = launch_command_for(bundled_script_path(script), num_processes=2)
+    out = execute_subprocess(cmd)
+    assert "ALL CHECKS PASSED" in out
