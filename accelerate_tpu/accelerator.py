@@ -560,7 +560,13 @@ class Accelerator:
                 )
 
             def compute_loss(params):
-                out = loss_fn(cast_floating(params, dtype), *batch)
+                # bf16 policy casts float inputs too (lax convs/dots require
+                # matching dtypes). fp16 keeps inputs fp32: targets can
+                # overflow fp16's range, and jnp promotion handles the mix.
+                cast_batch = batch
+                if dtype == jnp.bfloat16:
+                    cast_batch = tuple(cast_floating(b, dtype) for b in batch)
+                out = loss_fn(cast_floating(params, dtype), *cast_batch)
                 loss = out[0] if has_aux else out
                 aux = out[1] if has_aux else None
                 scaled = loss * state.loss_scale.scale if use_scale else loss
@@ -629,7 +635,10 @@ class Accelerator:
         dtype = self.compute_dtype
 
         def step_fn(params, *batch):
-            return eval_fn(cast_floating(params, dtype), *batch)
+            cast_batch = batch
+            if dtype == jnp.bfloat16:
+                cast_batch = tuple(cast_floating(b, dtype) for b in batch)
+            return eval_fn(cast_floating(params, dtype), *cast_batch)
 
         return jax.jit(step_fn)
 

@@ -72,7 +72,21 @@ def _abstract_like(tree: Any) -> Any:
 
 def _restore_pytree(path: str, like: Any) -> Any:
     ckptr = _checkpointer()
-    return ckptr.restore(_abspath(path), _abstract_like(like))
+    restored = ckptr.restore(_abspath(path), _abstract_like(like))
+
+    def _replace(r, l):
+        # Orbax restores every leaf with a COMMITTED sharding. Leaves whose
+        # reference was explicitly sharded keep that placement; leaves whose
+        # reference was an uncommitted scalar/default-device array (e.g. a
+        # fresh TrainState.step) must come back as host arrays, or the next
+        # jit over (sharded params, device-0 step) raises incompatible-devices.
+        if isinstance(l, jax.Array) and getattr(l, "_committed", False):
+            return jax.device_put(r, l.sharding)
+        if isinstance(r, jax.Array):
+            return np.asarray(r)
+        return r
+
+    return jax.tree_util.tree_map(_replace, restored, like)
 
 
 def _train_state_payload(ts) -> dict:

@@ -197,27 +197,38 @@ class BatchSamplerShard:
 
 
 class ShardedBatchIterable:
-    """Stride a sized stream of pre-assembled batches across hosts — the
+    """Shard a sized stream of pre-assembled batches across hosts — the
     plain-iterable analogue of `BatchSamplerShard` (ref data_loader.py:100).
 
-    `even_batches=True` recycles initial batches (and pads any short tail
-    batch with wraparound rows) so every host yields the same number of
-    equally-shaped batches and SPMD steps stay in lockstep. The duplicated
-    filler rows are NOT tracked as a remainder — like the reference's
-    sampler-level wraparound, eval paths that must see each sample exactly
-    once should dedupe or use the dispatcher.
+    Two modes (matching the reference's split_batches switch):
+    - stride (default): batch i goes to host i % P. `even_batches=True`
+      recycles initial batches and pads ANY short batch up to the size of the
+      first batch, so every host yields the same number of equally-shaped
+      batches and SPMD steps stay in lockstep.
+    - split (`split_batches=True`): every host takes its contiguous slice of
+      EVERY batch, so the global batch size equals the source batch size.
+
+    Unlike the reference's sampler-level wraparound, the duplicated/padded
+    rows of the final round ARE tracked: after full iteration, `remainder`
+    holds the number of REAL rows in the final global round (-1 if none were
+    duplicated) so `gather_for_metrics` can drop the filler tail.
     """
 
     def __init__(self, batches, num_processes: int, process_index: int,
-                 even_batches: bool = True):
+                 even_batches: bool = True, split_batches: bool = False):
         self.batches = batches
         self.num_processes = num_processes
         self.process_index = process_index
         self.even_batches = even_batches
+        self.split_batches = split_batches
         self.batch_size = getattr(batches, "batch_size", None)
+        self.remainder = -1
+        self.tail_layout = None
 
     def __len__(self) -> int:
         n = len(self.batches)  # type: ignore[arg-type]
+        if self.split_batches:
+            return n
         q, r = divmod(n, self.num_processes)
         if r == 0:
             return q
@@ -226,8 +237,48 @@ class ShardedBatchIterable:
         return q + (1 if self.process_index < r else 0)
 
     def __iter__(self):
+        if self.split_batches:
+            yield from self._iter_split_mode()
+        else:
+            yield from self._iter_stride_mode()
+
+    def _iter_split_mode(self):
+        """Each host slices rows [rank*B/P, (rank+1)*B/P) of every batch."""
         P, rank = self.num_processes, self.process_index
         n = len(self.batches)  # type: ignore[arg-type]
+        self.remainder = -1
+        self.tail_layout = None
+        full_size = None
+        for cursor, batch in enumerate(self.batches):
+            size = find_batch_size(batch)
+            if full_size is None:
+                if size is None or size % P != 0:
+                    raise ValueError(
+                        f"split_batches=True needs batch size divisible by "
+                        f"{P} processes, got {size}"
+                    )
+                full_size = size
+            if size < full_size:  # short tail: pad, record true rows
+                if cursor != n - 1:
+                    raise ValueError(
+                        "only the final batch may be short with split_batches"
+                    )
+                if self.even_batches:
+                    batch = pad_batch_to(batch, full_size)
+                    self.remainder = size
+            per = full_size // P
+            yield jax.tree_util.tree_map(
+                lambda x: x[rank * per : (rank + 1) * per]
+                if isinstance(x, np.ndarray) or hasattr(x, "__getitem__")
+                else x,
+                batch_to_numpy(batch),
+            )
+
+    def _iter_stride_mode(self):
+        P, rank = self.num_processes, self.process_index
+        n = len(self.batches)  # type: ignore[arg-type]
+        self.remainder = -1
+        self.tail_layout = None
         tail = n % P
         # which batch (if any) this host recycles to complete the final round
         recycle_idx = None
@@ -235,22 +286,35 @@ class ShardedBatchIterable:
             recycle_idx = (rank - tail) % min(P, n)
         recycled = None
         full_size = None
+        last_size = None
         for cursor, batch in enumerate(self.batches):
+            size = find_batch_size(batch)
             if full_size is None:
-                full_size = find_batch_size(batch)
+                full_size = size
+            if cursor == n - 1:
+                last_size = size
             if cursor == recycle_idx:
                 recycled = batch
             if cursor % P == rank:
-                if self.even_batches and tail and cursor >= n - tail:
+                if self.even_batches:
                     batch = self._pad_to_full(batch, full_size)
                 yield batch
         if recycled is not None:
             yield self._pad_to_full(recycled, full_size)
+        # real rows of the final global round (ranks in order: the batches
+        # n-t..n-1 land on ranks 0..t-1, recycled duplicates after), so
+        # `[:remainder]` truncation of a gathered final round keeps exactly
+        # the real samples
+        if self.even_batches and full_size is not None and last_size is not None:
+            t = tail if tail else P
+            if tail or last_size < full_size:
+                if n >= P or tail:
+                    self.remainder = (min(t, n) - 1) * full_size + last_size
 
     @staticmethod
     def _pad_to_full(batch, full_size):
-        """Keep per-host shapes identical in the wraparound round: a short
-        tail batch is padded up to the size of a full batch."""
+        """Keep per-host shapes identical: a short batch is padded up to the
+        size of a full batch."""
         if full_size is None:
             return batch
         size = find_batch_size(batch)
@@ -509,6 +573,11 @@ class DataLoaderShard(DataLoaderStateMixin):
                 batch, remainder, tail_layout = current
                 if nxt is _SENTINEL:
                     self.end_of_dataloader = True
+                    if remainder == -1:
+                        # a sharding iterable below may have padded/duplicated
+                        # the final round itself (ShardedBatchIterable)
+                        remainder = getattr(self.loader, "remainder", -1)
+                        tail_layout = getattr(self.loader, "tail_layout", None)
                     if remainder != -1:
                         self.remainder = remainder
                         self.tail_layout = tail_layout
@@ -716,9 +785,11 @@ def prepare_data_loader(
             split_batches=split_batches,
         )
     elif num_processes > 1:
-        # sized stream of ready-made batches: stride batches across hosts
+        # sized stream of ready-made batches: stride whole batches across
+        # hosts, or slice each batch when split_batches is requested
         loader = ShardedBatchIterable(
-            dataloader, num_processes, process_index, even_batches=even_batches
+            dataloader, num_processes, process_index, even_batches=even_batches,
+            split_batches=split_batches,
         )
 
     return DataLoaderShard(

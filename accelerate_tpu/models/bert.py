@@ -46,11 +46,13 @@ class BertConfig:
 
     @classmethod
     def tiny(cls, **overrides) -> "BertConfig":
-        return cls(
+        defaults = dict(
             vocab_size=512, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4,
-            max_position_embeddings=128, **overrides,
+            max_position_embeddings=128,
         )
+        defaults.update(overrides)
+        return cls(**defaults)
 
 
 def init_params(config: BertConfig, key: jax.Array, dtype=jnp.float32) -> dict:

@@ -68,11 +68,13 @@ class LlamaConfig:
     @classmethod
     def tiny(cls, **overrides) -> "LlamaConfig":
         """Test/debug size."""
-        return cls(
+        defaults = dict(
             vocab_size=256, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-            max_position_embeddings=128, **overrides,
+            max_position_embeddings=128,
         )
+        defaults.update(overrides)
+        return cls(**defaults)
 
 
 def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:

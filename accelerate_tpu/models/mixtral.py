@@ -57,12 +57,14 @@ class MixtralConfig:
 
     @classmethod
     def tiny(cls, **overrides) -> "MixtralConfig":
-        return cls(
+        defaults = dict(
             vocab_size=256, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             num_local_experts=4, num_experts_per_tok=2,
-            max_position_embeddings=128, **overrides,
+            max_position_embeddings=128,
         )
+        defaults.update(overrides)
+        return cls(**defaults)
 
     def _as_llama(self) -> LlamaConfig:
         return LlamaConfig(

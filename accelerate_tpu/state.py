@@ -477,9 +477,14 @@ class GradientState:
         self.dataloader_references.append(dataloader)
 
     def _remove_dataloader(self, dataloader) -> None:
-        if dataloader in self.dataloader_references:
-            self.dataloader_references.remove(dataloader)
-        self.active_dataloader = self.dataloader_references[-1]
+        # a loader generator may be finalized after _reset_state cleared the
+        # shared dict — nothing to unregister then
+        refs = self.__dict__.get("dataloader_references")
+        if refs is None:
+            return
+        if dataloader in refs:
+            refs.remove(dataloader)
+        self.active_dataloader = refs[-1] if refs else None
 
     @classmethod
     def _reset_state(cls) -> None:

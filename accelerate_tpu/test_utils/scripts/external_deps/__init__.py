@@ -1,0 +1,6 @@
+"""Heavier launch-and-assert scripts (ref test_utils/scripts/external_deps/):
+checkpoint round-trips, metric-gather exactness, training-quality and
+peak-memory regression gates, pipeline inference, full-shard (ZeRO-3
+analogue) integration. Each script's `main()` asserts on every rank and
+prints "ALL CHECKS PASSED" from the main process.
+"""

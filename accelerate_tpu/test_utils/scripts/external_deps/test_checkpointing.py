@@ -1,0 +1,118 @@
+"""Launch-and-assert: checkpoint save/resume equivalence
+(ref test_utils/scripts/external_deps/test_checkpointing.py; SURVEY.md §3.6).
+
+Every rank asserts:
+- train k steps, `save_state`, train k more → params P_direct;
+- fresh run, `load_state`, train the same k more → params P_resumed == P_direct
+  bitwise (optimizer moments, scheduler step and RNG all round-trip);
+- mid-epoch resume via `skip_first_batches` replays exactly the un-seen tail.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+def _make_world(tmpdir: str, total_limit: int | None = None):
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    PartialState._reset_state()
+    acc = Accelerator(
+        project_dir=tmpdir,
+        project_config=ProjectConfiguration(
+            project_dir=tmpdir,
+            automatic_checkpoint_naming=True,
+            total_limit=total_limit,
+        ),
+    )
+    ds = RegressionDataset(length=64, seed=7)
+    batches = [
+        {"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 64, 8)
+    ]
+    loader = acc.prepare(batches)
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=regression_params(), tx=optax.adam(0.05))
+    )
+    step = acc.train_step(regression_loss)
+    return acc, loader, ts, step
+
+
+def check_save_resume_equivalence(tmpdir: str):
+    import jax
+
+    acc, loader, ts, step = _make_world(tmpdir)
+    it = iter(loader)
+    for _ in range(4):
+        ts, _ = step(ts, next(it))
+    ckpt = acc.save_state(state=ts)
+    assert os.path.isdir(ckpt), ckpt
+    for _ in range(4):
+        ts, _ = step(ts, next(it))
+    direct = jax.device_get(ts.params)
+
+    # fresh world resumes from the checkpoint and replays the same tail
+    acc2, loader2, ts2, step2 = _make_world(tmpdir)
+    restored = acc2.load_state(ckpt, state=ts2)
+    ts2 = restored.get("train_states", [ts2])[0]
+    it2 = iter(loader2)
+    for _ in range(4):  # skip the batches the first run consumed pre-save
+        next(it2)
+    for _ in range(4):
+        ts2, _ = step2(ts2, next(it2))
+    resumed = jax.device_get(ts2.params)
+    np.testing.assert_array_equal(direct["a"], resumed["a"])
+    np.testing.assert_array_equal(direct["b"], resumed["b"])
+
+
+def check_skip_first_batches(tmpdir: str):
+    acc, loader, _, _ = _make_world(tmpdir)
+    all_batches = [np.asarray(b["x"]) for b in loader]
+    tail = [np.asarray(b["x"]) for b in acc.skip_first_batches(loader, 3)]
+    assert len(tail) == len(all_batches) - 3
+    for got, want in zip(tail, all_batches[3:]):
+        np.testing.assert_array_equal(got, want)
+
+
+def check_total_limit(tmpdir: str):
+    from accelerate_tpu.utils.constants import CHECKPOINT_DIR_PREFIX
+
+    acc, loader, ts, step = _make_world(tmpdir, total_limit=2)
+    it = iter(loader)
+    for _ in range(3):
+        ts, _ = step(ts, next(it))
+        acc.save_state(state=ts)
+    base = os.path.join(tmpdir, "checkpoints")
+    kept = sorted(d for d in os.listdir(base) if d.startswith(CHECKPOINT_DIR_PREFIX))
+    assert len(kept) == 2, kept  # oldest pruned (ref ProjectConfiguration.total_limit)
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    with tempfile.TemporaryDirectory() as tmp_a, \
+         tempfile.TemporaryDirectory() as tmp_b, \
+         tempfile.TemporaryDirectory() as tmp_c:
+        check_save_resume_equivalence(tmp_a)
+        check_skip_first_batches(tmp_b)
+        check_total_limit(tmp_c)
+    state = PartialState()
+    if state.is_main_process:
+        print(f"test_checkpointing: ALL CHECKS PASSED ({state.num_processes} process(es))")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Launch-and-assert: peak-memory regression gate
+(ref test_utils/scripts/external_deps/test_peak_memory_usage.py:226-229 —
+asserts peak memory stays under an upper bound; TorchTracemalloc :39-80).
+
+Every rank trains a tiny model and asserts the device-memory footprint —
+live `jax.Array` bytes (exact on every backend) plus allocator peak stats
+where the backend reports them — stays under a fixed budget, and that
+`free_memory` actually releases the arrays it is handed.
+"""
+
+from __future__ import annotations
+
+
+def _run_tiny_training():
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+
+    PartialState._reset_state()
+    acc = Accelerator()
+    ds = RegressionDataset(length=64, seed=2)
+    loader = acc.prepare(
+        [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 64, 8)]
+    )
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=regression_params(), tx=optax.sgd(0.1))
+    )
+    step = acc.train_step(regression_loss)
+    for batch in loader:
+        ts, _ = step(ts, batch)
+    return acc, ts
+
+
+def check_peak_memory_bound():
+    from accelerate_tpu.profiler import device_memory_stats, live_array_bytes
+
+    acc, ts = _run_tiny_training()
+    live = live_array_bytes()
+    # regression params + adam-free sgd state + a handful of batches: a few
+    # KB of payload. 64 MB is the generous ceiling that still catches a leak
+    # of retained per-step arrays (the failure mode this gate exists for).
+    budget = 64 * 1024 * 1024
+    assert live < budget, f"live array bytes {live} exceed budget {budget}"
+    stats = device_memory_stats()
+    peak = stats.get("peak_bytes_in_use", 0)
+    if peak:  # backends without allocator stats report {}
+        assert peak < 4 * budget, f"allocator peak {peak} exceeds bound"
+
+
+def check_free_memory_releases():
+    import numpy as np
+    import jax
+
+    from accelerate_tpu.profiler import live_array_bytes
+
+    base = live_array_bytes()
+    big = jax.device_put(np.zeros((1024, 1024), np.float32))  # 4 MB
+    big.block_until_ready()
+    held = live_array_bytes()
+    assert held >= base + 4 * 1024 * 1024 - 4096
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator()
+    (big,) = acc.free_memory(big)
+    assert big is None
+    after = live_array_bytes()
+    assert after < held, (base, held, after)
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    check_peak_memory_bound()
+    check_free_memory_releases()
+    state = PartialState()
+    if state.is_main_process:
+        print(
+            f"test_peak_memory_usage: ALL CHECKS PASSED ({state.num_processes} process(es))"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Launch-and-assert: distributed training quality gate
+(ref test_utils/scripts/external_deps/test_performance.py:195-203 — asserts
+distributed metric >= single-process baseline minus a threshold).
+
+Every rank trains (a) the regression workload and (b) a tiny BERT classifier
+on a deterministic synthetic task, then asserts convergence quality beats a
+fixed baseline threshold — the functional analogue of the reference's
+accuracy/F1-vs-baseline regression gate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_regression_convergence():
+    import jax
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="no", gradient_clipping=1.0)
+    ds = RegressionDataset(length=96, seed=1)
+    batches = [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 96, 8)]
+    loader = acc.prepare(batches)
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=regression_params(), tx=optax.adam(0.1))
+    )
+    step = acc.train_step(regression_loss)
+    for _ in range(12):  # epochs
+        for batch in loader:
+            ts, _ = step(ts, batch)
+    a = float(jax.device_get(ts.params["a"]))
+    b = float(jax.device_get(ts.params["b"]))
+    # ground truth y = 2x + 1 (+0.1 noise): the quality gate
+    assert abs(a - 2.0) < 0.15, f"slope {a} off baseline 2.0"
+    assert abs(b - 1.0) < 0.15, f"intercept {b} off baseline 1.0"
+
+
+def _synthetic_cls_batches(vocab: int, seq: int, n: int, bs: int, seed: int):
+    """Token-counting task: label = (count of token 1 in the sequence) % 2 —
+    learnable by a 2-layer transformer, deterministic across ranks."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(n, seq)).astype(np.int32)
+    labels = (np.sum(ids == 1, axis=1) % 2).astype(np.int32)
+    return [
+        {"input_ids": ids[i : i + bs], "labels": labels[i : i + bs]}
+        for i in range(0, n, bs)
+    ]
+
+
+def check_bert_classifier_learns():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import bert
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="no", gradient_clipping=1.0)
+    cfg = bert.BertConfig.tiny(
+        vocab_size=32, max_position_embeddings=16, num_labels=2
+    )
+    params = bert.init_params(cfg, jax.random.key(0))
+
+    def loss_fn(p, batch):
+        logits = bert.forward(cfg, p, batch["input_ids"])
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.take_along_axis(logp, labels[:, None], axis=1).mean()
+        acc_metric = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, {"accuracy": acc_metric}
+
+    batches = _synthetic_cls_batches(vocab=32, seq=16, n=256, bs=16, seed=5)
+    loader = acc.prepare(batches)
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=params, tx=optax.adamw(3e-3))
+    )
+    step = acc.train_step(loss_fn, has_aux=True)
+    first_loss = last_metrics = None
+    for epoch in range(6):
+        for batch in loader:
+            ts, metrics = step(ts, batch)
+            if first_loss is None:
+                first_loss = float(metrics["loss"])
+            last_metrics = (float(metrics["loss"]), float(metrics["aux"]["accuracy"]))
+    final_loss, final_acc = last_metrics
+    # the regression gate: training must actually learn the task
+    assert final_loss < first_loss * 0.8, (first_loss, final_loss)
+    assert final_acc > 0.65, f"final train accuracy {final_acc} below baseline gate"
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    check_regression_convergence()
+    check_bert_classifier_learns()
+    state = PartialState()
+    if state.is_main_process:
+        print(f"test_performance: ALL CHECKS PASSED ({state.num_processes} process(es))")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Launch-and-assert: full-parameter sharding (ZeRO-3 / FSDP analogue)
+(ref test_utils/scripts/external_deps/test_zero3_integration.py; SURVEY §2.2 —
+ZeRO-3 ≙ params on the `fsdp` mesh axis under GSPMD).
+
+Every rank asserts:
+- preparing a TrainState under `FullyShardedDataParallelPlugin(FULL_SHARD)`
+  actually shards large params over the `fsdp` axis (per-device bytes drop);
+- a sharded train step produces the SAME parameters as the unsharded
+  data-parallel run on identical data — numerics are sharding-invariant;
+- `get_state_dict` regathers full (unsharded) host arrays for export.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _mlp_params(key, width: int = 256, depth: int = 3):
+    import jax
+    import jax.numpy as jnp
+
+    keys = jax.random.split(key, depth)
+    return {
+        f"layer_{i}": {
+            "kernel": jax.random.normal(keys[i], (width, width)) * 0.05,
+            "bias": jnp.zeros((width,)),
+        }
+        for i in range(depth)
+    }
+
+
+def _mlp_loss(params, batch):
+    import jax
+
+    x = batch["x"]
+    for i in range(len(params)):
+        layer = params[f"layer_{i}"]
+        x = jax.nn.tanh(x @ layer["kernel"] + layer["bias"])
+    return ((x - batch["y"]) ** 2).mean()
+
+
+def _train(plugin, batches, steps: int):
+    import jax
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(fsdp_plugin=plugin, gradient_clipping=1.0)
+    params = _mlp_params(jax.random.key(0))
+    ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=optax.adam(1e-2)))
+    step = acc.train_step(_mlp_loss)
+    loader = acc.prepare(batches)
+    it = iter(loader)
+    for _ in range(steps):
+        ts, _ = step(ts, next(it))
+    return acc, ts
+
+
+def check_params_are_sharded():
+    import jax
+
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+    from accelerate_tpu.utils.constants import AXIS_FSDP
+
+    rng = np.random.default_rng(0)
+    batches = [
+        {"x": rng.normal(size=(8, 256)).astype(np.float32),
+         "y": rng.normal(size=(8, 256)).astype(np.float32)}
+        for _ in range(4)
+    ]
+    acc, ts = _train(FullyShardedDataParallelPlugin(), batches, steps=2)
+    n_shards = acc.mesh.shape.get(AXIS_FSDP, 1)
+    if n_shards > 1:
+        kernel = ts.params["layer_0"]["kernel"]
+        spec = kernel.sharding.spec
+        assert AXIS_FSDP in jax.tree_util.tree_leaves(tuple(spec)), (
+            f"FULL_SHARD left layer_0/kernel replicated: {spec}"
+        )
+        shard_elems = int(np.prod(kernel.addressable_shards[0].data.shape))
+        assert shard_elems == int(np.prod(kernel.shape)) // n_shards, (
+            shard_elems, kernel.shape, n_shards
+        )
+
+
+def check_sharded_matches_replicated():
+    import jax
+
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    rng = np.random.default_rng(1)
+    batches = [
+        {"x": rng.normal(size=(8, 256)).astype(np.float32),
+         "y": rng.normal(size=(8, 256)).astype(np.float32)}
+        for _ in range(6)
+    ]
+    _, ts_full = _train(FullyShardedDataParallelPlugin("FULL_SHARD"), batches, 6)
+    _, ts_none = _train(FullyShardedDataParallelPlugin("NO_SHARD"), batches, 6)
+    full = jax.device_get(ts_full.params["layer_2"]["kernel"])
+    none = jax.device_get(ts_none.params["layer_2"]["kernel"])
+    # sharded vs replicated matmuls reduce in different orders; after 6 adam
+    # steps a few ULPs of drift is expected (ref test asserts metric parity,
+    # not bitwise equality)
+    np.testing.assert_allclose(full, none, rtol=5e-4, atol=1e-5)
+
+
+def check_state_dict_regathers():
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+
+    rng = np.random.default_rng(2)
+    batches = [
+        {"x": rng.normal(size=(8, 256)).astype(np.float32),
+         "y": rng.normal(size=(8, 256)).astype(np.float32)}
+    ]
+    acc, ts = _train(FullyShardedDataParallelPlugin(), batches, 1)
+    sd = acc.get_state_dict(ts)
+    kernel = sd["layer_0"]["kernel"]
+    assert isinstance(kernel, np.ndarray) and kernel.shape == (256, 256)
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    check_params_are_sharded()
+    check_sharded_matches_replicated()
+    check_state_dict_regathers()
+    state = PartialState()
+    if state.is_main_process:
+        print(
+            f"test_zero3_integration: ALL CHECKS PASSED ({state.num_processes} process(es))"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -130,7 +130,9 @@ def check_gather_for_metrics_exact_count(state):
     PartialState._reset_state()
     acc = Accelerator()
     world = acc.num_processes
-    n, bs = 5 * world + 1, 2
+    # 2*world+1 with bs=2 leaves a short tail batch at EVERY world size
+    # (world=1 in-process included), so the pad-dedup path always runs
+    n, bs = 2 * world + 1, 2
     data = [
         {"idx": np.arange(i, min(i + bs, n), dtype=np.int32)}
         for i in range(0, n, bs)

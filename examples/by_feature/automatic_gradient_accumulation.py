@@ -1,0 +1,69 @@
+"""Feature: automatic gradient accumulation
+(ref by_feature/automatic_gradient_accumulation.py).
+
+Combines `find_executable_batch_size` with gradient accumulation: when the
+per-step batch must shrink to fit memory, the accumulation step count grows
+so the EFFECTIVE batch (observed_batch_size) stays constant.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    observed_batch_size = args.batch_size  # the effective batch we promise
+    ds = RegressionDataset(length=256, seed=args.seed)
+
+    @find_executable_batch_size(starting_batch_size=observed_batch_size)
+    def inner_training_loop(batch_size):
+        # keep the effective batch: accumulate over the shrink factor
+        accum = observed_batch_size // batch_size
+        accelerator.gradient_accumulation_steps = accum
+        accelerator.print(f"batch_size={batch_size} accumulation={accum}")
+        accelerator.free_memory()
+        loader = accelerator.prepare(
+            [{"x": ds.x[i : i + batch_size], "y": ds.y[i : i + batch_size]}
+             for i in range(0, 256, batch_size)]
+        )
+        ts = accelerator.prepare(TrainState.create(
+            apply_fn=None, params=regression_params(), tx=optax.adam(args.lr),
+            use_grad_accum_buffer=accum > 1,
+        ))
+        step = accelerator.train_step(regression_loss)
+        for _ in range(args.num_epochs):
+            for batch in loader:
+                ts, m = step(ts, batch)
+        return {"loss": float(m["loss"]), "batch_size": batch_size,
+                "accumulation": accum}
+
+    metrics = inner_training_loop()
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64,
+                        help="effective batch size to maintain")
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

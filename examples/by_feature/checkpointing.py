@@ -1,0 +1,76 @@
+"""Feature: versioned checkpointing + resume (ref by_feature/checkpointing.py).
+
+`save_state` writes `checkpoints/checkpoint_{n}` (model/optimizer/scheduler/
+sampler/RNG) under `ProjectConfiguration(automatic_checkpoint_naming=True)`;
+`load_state` restores the latest; `skip_first_batches` resumes mid-epoch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(project_config=ProjectConfiguration(
+        project_dir=args.project_dir, automatic_checkpoint_naming=True,
+        total_limit=3,
+    ))
+    set_seed(args.seed)
+    ds = RegressionDataset(length=128, seed=args.seed)
+    bs = args.batch_size
+    loader = accelerator.prepare(
+        [{"x": ds.x[i : i + bs], "y": ds.y[i : i + bs]} for i in range(0, 128, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+    ))
+    step = accelerator.train_step(regression_loss)
+
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, m = step(ts, batch)
+        accelerator.save_state(state=ts)  # one versioned dir per epoch
+
+    # resume from the latest checkpoint and continue one epoch
+    restored = accelerator.load_state(state=ts)
+    ts = restored.get("train_states", [ts])[0]
+    done = int(ts.step)
+    resume_batch = done % len(loader)
+    for batch in accelerator.skip_first_batches(loader, resume_batch):
+        ts, m = step(ts, batch)
+
+    metrics = {"loss": float(m["loss"]), "resumed_at_step": done}
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+    if args.project_dir is None:
+        with tempfile.TemporaryDirectory() as tmp:
+            args.project_dir = tmp
+            training_function(args)
+    else:
+        training_function(args)
+
+
+if __name__ == "__main__":
+    main()

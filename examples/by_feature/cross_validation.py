@@ -1,0 +1,81 @@
+"""Feature: k-fold cross validation (ref by_feature/cross_validation.py).
+
+Each fold trains on k-1 splits and evaluates on the held-out one; fold
+predictions are gathered with `gather_for_metrics` and the final ensembled
+metric is computed over the out-of-fold predictions.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_forward,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    ds = RegressionDataset(length=240, seed=args.seed)
+    k, bs = args.num_folds, args.batch_size
+    fold_size = len(ds) // k
+    fold_mse = []
+
+    for fold in range(k):
+        lo, hi = fold * fold_size, (fold + 1) * fold_size
+        train_idx = np.concatenate([np.arange(0, lo), np.arange(hi, len(ds))])
+        x_tr, y_tr = ds.x[train_idx], ds.y[train_idx]
+        loader = accelerator.prepare(
+            [{"x": x_tr[i : i + bs], "y": y_tr[i : i + bs]}
+             for i in range(0, len(x_tr), bs)]
+        )
+        eval_loader = accelerator.prepare(
+            [{"x": ds.x[i : i + bs], "y": ds.y[i : i + bs]}
+             for i in range(lo, hi, bs)]
+        )
+        ts = accelerator.prepare(TrainState.create(
+            apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+        ))
+        step = accelerator.train_step(regression_loss)
+        eval_step = accelerator.eval_step(lambda p, b: regression_forward(p, b["x"]))
+        for _ in range(args.num_epochs):
+            for batch in loader:
+                ts, _ = step(ts, batch)
+        preds, targets = [], []
+        for batch in eval_loader:
+            out = eval_step(ts.params, batch)
+            out, y = accelerator.gather_for_metrics((out, batch["y"]))
+            preds.append(np.asarray(out).reshape(-1))
+            targets.append(np.asarray(y).reshape(-1))
+        mse = float(np.mean((np.concatenate(preds) - np.concatenate(targets)) ** 2))
+        fold_mse.append(mse)
+        accelerator.print(f"fold {fold}: eval_mse={mse:.4f}")
+        accelerator.free_memory()
+
+    metrics = {"mean_mse": float(np.mean(fold_mse)), "folds": fold_mse}
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Feature: cross-host early stopping (ref by_feature/early_stopping.py).
+
+Any host that meets the stop condition calls `set_trigger()`; every host
+polls `check_trigger()` (a flag all-reduce) so ALL ranks break on the same
+step — no rank ever waits on a collective the others skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    ds = RegressionDataset(length=512, seed=args.seed)
+    bs = args.batch_size
+    loader = accelerator.prepare(
+        [{"x": ds.x[i : i + bs], "y": ds.y[i : i + bs]} for i in range(0, 512, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+    ))
+    step = accelerator.train_step(regression_loss)
+
+    stopped_at = None
+    steps = 0
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, m = step(ts, batch)
+            steps += 1
+            if float(m["loss"]) < args.loss_threshold:
+                accelerator.set_trigger()
+            # flag all-reduce: True if ANY process triggered
+            if accelerator.check_trigger():
+                stopped_at = steps
+                break
+        if stopped_at is not None:
+            break
+
+    metrics = {"loss": float(m["loss"]), "stopped_at_step": stopped_at}
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--loss_threshold", type=float, default=0.05)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

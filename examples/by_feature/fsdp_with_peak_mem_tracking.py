@@ -1,0 +1,81 @@
+"""Feature: FSDP (full param sharding) + peak-memory tracking
+(ref by_feature/fsdp_with_peak_mem_tracking.py).
+
+`FullyShardedDataParallelPlugin` lowers to parameter sharding on the mesh
+`fsdp` axis (ZeRO-3 under GSPMD); `device_memory_stats`/`live_array_bytes`
+replace the reference's TorchTracemalloc context.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.profiler import device_memory_stats, live_array_bytes
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, set_seed
+
+import numpy as np
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy=args.sharding_strategy,
+            activation_checkpointing=args.activation_checkpointing,
+        ),
+        gradient_clipping=1.0,
+    )
+    set_seed(args.seed)
+    cfg = bert.BertConfig.tiny(remat=args.activation_checkpointing) \
+        if args.tiny else bert.BertConfig.base(remat=args.activation_checkpointing)
+
+    rng = np.random.default_rng(args.seed)
+    n, seq, bs = 128, 64, args.batch_size
+    ids = rng.integers(4, cfg.vocab_size, (n, seq)).astype(np.int32)
+    labels = rng.integers(0, 2, (n,)).astype(np.int32)
+    loader = accelerator.prepare(
+        [{"input_ids": ids[i : i + bs], "labels": labels[i : i + bs]}
+         for i in range(0, n, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=bert.init_params(cfg, jax.random.key(args.seed)),
+        tx=optax.adamw(args.lr),
+    ))
+    step = accelerator.train_step(lambda p, b: bert.classification_loss(cfg, p, b))
+
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, m = step(ts, batch)
+        stats = device_memory_stats()
+        metrics = {
+            "epoch": epoch,
+            "loss": float(m["loss"]),
+            "live_array_mb": live_array_bytes() / 2**20,
+            "peak_mb": stats.get("peak_bytes_in_use", 0) / 2**20,
+        }
+        accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--sharding_strategy", default="FULL_SHARD")
+    parser.add_argument("--activation_checkpointing", action="store_true")
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--tiny", action="store_true")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,62 @@
+"""Feature: OOM-retry with `find_executable_batch_size`
+(ref by_feature/memory.py).
+
+The decorated inner function receives the current batch size; on an XLA
+RESOURCE_EXHAUSTED (or other OOM-classified) error it is re-invoked with the
+batch size halved, after clearing compiled-program and buffer caches.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    ds = RegressionDataset(length=256, seed=args.seed)
+
+    @find_executable_batch_size(starting_batch_size=args.batch_size)
+    def inner_training_loop(batch_size):
+        accelerator.print(f"trying batch_size={batch_size}")
+        accelerator.free_memory()
+        loader = accelerator.prepare(
+            [{"x": ds.x[i : i + batch_size], "y": ds.y[i : i + batch_size]}
+             for i in range(0, 256, batch_size)]
+        )
+        ts = accelerator.prepare(TrainState.create(
+            apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+        ))
+        step = accelerator.train_step(regression_loss)
+        for _ in range(args.num_epochs):
+            for batch in loader:
+                ts, m = step(ts, batch)
+        return {"loss": float(m["loss"]), "batch_size": batch_size}
+
+    metrics = inner_training_loop()
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Feature: exact distributed eval metrics
+(ref by_feature/multi_process_metrics.py).
+
+The sharded eval loader pads the last uneven batch so SPMD steps stay in
+lockstep; `gather_for_metrics` drops those duplicated tail samples again, so
+the metric sees each example EXACTLY once regardless of world size.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_forward,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    # 100 eval samples: NOT divisible by typical world sizes on purpose
+    train_ds = RegressionDataset(length=256, seed=args.seed)
+    eval_ds = RegressionDataset(length=100, seed=args.seed + 1)
+    bs = args.batch_size
+    train_loader = accelerator.prepare(
+        [{"x": train_ds.x[i : i + bs], "y": train_ds.y[i : i + bs]}
+         for i in range(0, 256, bs)]
+    )
+    eval_loader = accelerator.prepare(
+        [{"x": eval_ds.x[i : i + bs], "y": eval_ds.y[i : i + bs]}
+         for i in range(0, 100, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+    ))
+    step = accelerator.train_step(regression_loss)
+    eval_step = accelerator.eval_step(
+        lambda p, b: regression_forward(p, b["x"])
+    )
+
+    for epoch in range(args.num_epochs):
+        for batch in train_loader:
+            ts, _ = step(ts, batch)
+
+    preds, targets = [], []
+    for batch in eval_loader:
+        out = eval_step(ts.params, batch)
+        out, y = accelerator.gather_for_metrics((out, batch["y"]))
+        preds.append(np.asarray(out).reshape(-1))
+        targets.append(np.asarray(y).reshape(-1))
+    preds = np.concatenate(preds)
+    targets = np.concatenate(targets)
+    assert preds.shape[0] == len(eval_ds), (
+        f"metric saw {preds.shape[0]} samples, dataset has {len(eval_ds)}"
+    )
+    metrics = {"eval_mse": float(((preds - targets) ** 2).mean()),
+               "samples_seen": int(preds.shape[0])}
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Feature: schedule-free optimization (ref by_feature/schedule_free.py).
+
+The reference wraps torch's `schedulefree.AdamWScheduleFree`; the JAX-native
+equivalent is `optax.contrib.schedule_free` over any base optimizer — no LR
+schedule, no `scheduler.step()` bookkeeping. Eval uses the schedule-free
+EVAL parameters (`schedule_free_eval_params`), mirroring the reference's
+`optimizer.eval()` mode switch.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_forward,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator()
+    set_seed(args.seed)
+    ds = RegressionDataset(length=256, seed=args.seed)
+    bs = args.batch_size
+    loader = accelerator.prepare(
+        [{"x": ds.x[i : i + bs], "y": ds.y[i : i + bs]} for i in range(0, 256, bs)]
+    )
+    tx = optax.contrib.schedule_free(
+        optax.adam(args.lr, b1=0.0), learning_rate=args.lr, b1=0.9
+    )
+    ts = accelerator.prepare(
+        TrainState.create(apply_fn=None, params=regression_params(), tx=tx)
+    )
+    step = accelerator.train_step(regression_loss)
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, m = step(ts, batch)
+
+    # the reference flips optimizer.eval(); here the eval params are derived
+    eval_params = optax.contrib.schedule_free_eval_params(ts.opt_state, ts.params)
+    preds = regression_forward(eval_params, ds.x)
+    metrics = {
+        "train_loss": float(m["loss"]),
+        "eval_mse": float(np.mean((np.asarray(preds) - ds.y) ** 2)),
+    }
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=4)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Feature: experiment tracking (ref by_feature/tracking.py).
+
+`log_with` accepts any of {jsonl, tensorboard, wandb, mlflow, comet_ml, aim,
+clearml, dvclive} or "all" for every backend importable in the environment;
+`init_trackers` stores the run config, `log` fans metrics out, and
+`end_training` closes every backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import tempfile
+
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_loss,
+    regression_params,
+)
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        log_with=args.log_with,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir, logging_dir=args.project_dir
+        ),
+    )
+    set_seed(args.seed)
+    accelerator.init_trackers("tracking_example", config=vars(args))
+
+    ds = RegressionDataset(length=128, seed=args.seed)
+    bs = args.batch_size
+    loader = accelerator.prepare(
+        [{"x": ds.x[i : i + bs], "y": ds.y[i : i + bs]} for i in range(0, 128, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=regression_params(), tx=optax.adam(args.lr)
+    ))
+    step = accelerator.train_step(regression_loss)
+
+    overall_step = 0
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for batch in loader:
+            ts, m = step(ts, batch)
+            total += float(m["loss"])
+            overall_step += 1
+        accelerator.log(
+            {"train_loss": total / len(loader), "epoch": epoch}, step=overall_step
+        )
+    accelerator.end_training()
+    metrics = {"train_loss": total / len(loader)}
+    accelerator.print(metrics)
+    return metrics
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--log_with", default="jsonl")
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+    if args.project_dir is None:
+        with tempfile.TemporaryDirectory() as tmp:
+            args.project_dir = tmp
+            training_function(args)
+    else:
+        training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Feature: ZeRO-stage configuration via DeepSpeedPlugin
+(ref by_feature/deepspeed_with_config_support.py — ds_config.json driving
+deepspeed.initialize; here the plugin lowers to GSPMD axis assignments).
+
+stage 0 → pure data parallel; stage 1/2 → optimizer-state (+grad) sharding;
+stage 3 → full parameter sharding on the `fsdp` axis. The same training loop
+runs under every stage — only the sharding plan changes.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import DeepSpeedPlugin, set_seed
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        deepspeed_plugin=DeepSpeedPlugin(
+            zero_stage=args.zero_stage,
+            gradient_clipping=1.0,
+            offload_param_device=args.offload_param_device,
+        ),
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    accelerator.print(
+        f"zero_stage={args.zero_stage} mesh={dict(accelerator.mesh.shape)}"
+    )
+    set_seed(args.seed)
+    cfg = bert.BertConfig.tiny() if args.tiny else bert.BertConfig.base()
+    rng = np.random.default_rng(args.seed)
+    n, seq, bs = 128, 64, args.batch_size
+    ids = rng.integers(4, cfg.vocab_size, (n, seq)).astype(np.int32)
+    labels = rng.integers(0, 2, (n,)).astype(np.int32)
+    loader = accelerator.prepare(
+        [{"input_ids": ids[i : i + bs], "labels": labels[i : i + bs]}
+         for i in range(0, n, bs)]
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=bert.init_params(cfg, jax.random.key(args.seed)),
+        tx=optax.adamw(args.lr),
+        use_grad_accum_buffer=args.gradient_accumulation_steps > 1,
+    ))
+    step = accelerator.train_step(lambda p, b: bert.classification_loss(cfg, p, b))
+
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, m = step(ts, batch)
+        accelerator.print({"epoch": epoch, "loss": float(m["loss"])})
+    return {"loss": float(m["loss"])}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--zero_stage", type=int, default=2, choices=[0, 1, 2, 3])
+    parser.add_argument("--offload_param_device", default=None)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--tiny", action="store_true")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

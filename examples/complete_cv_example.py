@@ -1,0 +1,121 @@
+"""Complete CV example: convnet classification + tracking + checkpointing +
+resume (ref examples/complete_cv_example.py).
+
+Same loop as cv_example.py with --with_tracking, --checkpointing_steps and
+--resume_from_checkpoint layered on, mirroring the reference's complete
+variant feature-for-feature.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cv_example import (  # noqa: E402
+    convnet_forward,
+    get_dataloaders,
+    init_convnet,
+    loss_fn,
+)
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_clipping=1.0,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir or ".",
+            automatic_checkpoint_naming=True,
+        ),
+    )
+    set_seed(args.seed)
+    train_loader, eval_loader = get_dataloaders(accelerator, args.batch_size)
+    params = init_convnet(jax.random.key(args.seed), width=args.width)
+    ts = accelerator.prepare(
+        TrainState.create(apply_fn=None, params=params, tx=optax.adamw(args.lr))
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+
+    starting_epoch = resume_step = 0
+    if args.resume_from_checkpoint:
+        restored = accelerator.load_state(
+            None if args.resume_from_checkpoint == "latest"
+            else args.resume_from_checkpoint, state=ts,
+        )
+        ts = restored.get("train_states", [ts])[0]
+        done = int(ts.step)
+        starting_epoch, resume_step = divmod(done, len(train_loader))
+        accelerator.print(f"resumed at epoch {starting_epoch}, batch {resume_step}")
+
+    step = accelerator.train_step(loss_fn)
+    eval_step = accelerator.eval_step(
+        lambda p, b: jnp.argmax(convnet_forward(p, b["pixels"]), -1)
+    )
+
+    overall_step = int(ts.step)
+    metrics = {}
+    for epoch in range(starting_epoch, args.num_epochs):
+        loader = train_loader
+        if epoch == starting_epoch and resume_step > 0:
+            loader = accelerator.skip_first_batches(train_loader, resume_step)
+        total = 0.0
+        for batch in loader:
+            ts, m = step(ts, batch)
+            total += float(m["loss"])
+            overall_step += 1
+            if isinstance(args.checkpointing_steps, int) and (
+                overall_step % args.checkpointing_steps == 0
+            ):
+                accelerator.save_state(state=ts)
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(state=ts)
+        correct = tot = 0
+        for batch in eval_loader:
+            preds = eval_step(ts.params, batch)
+            preds, labels = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            tot += int(np.asarray(labels).shape[0])
+        metrics = {"epoch": epoch, "train_loss": total / max(1, len(train_loader)),
+                   "accuracy": correct / tot}
+        accelerator.print(f"epoch {epoch}: {metrics}")
+        if args.with_tracking:
+            accelerator.log(metrics, step=overall_step)
+    if args.with_tracking:
+        accelerator.end_training()
+    return metrics
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--width", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--checkpointing_steps", default=None)
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    args = parser.parse_args(argv)
+    if args.checkpointing_steps and args.checkpointing_steps != "epoch":
+        args.checkpointing_steps = int(args.checkpointing_steps)
+    return args
+
+
+if __name__ == "__main__":
+    training_function(parse_args())

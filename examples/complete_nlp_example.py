@@ -1,0 +1,145 @@
+"""Complete NLP example: everything the simple one has, plus experiment
+tracking, versioned checkpointing, and mid-epoch resume.
+
+Mirror of ref examples/complete_nlp_example.py: adds --with_tracking,
+--checkpointing_steps {N|"epoch"}, --resume_from_checkpoint on top of the
+BERT classification loop. The user still owns the loop.
+
+Run: python examples/complete_nlp_example.py --checkpointing_steps epoch \
+         --with_tracking --project_dir /tmp/nlp_out
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from nlp_example import get_dataloaders, synthetic_mrpc  # noqa: E402,F401
+
+
+def training_function(args) -> dict:
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        gradient_clipping=1.0,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir or ".",
+            automatic_checkpoint_naming=True,
+            total_limit=args.checkpoint_total_limit,
+        ),
+    )
+    set_seed(args.seed)
+    cfg = bert.BertConfig.tiny() if args.tiny else bert.BertConfig.base()
+    train_loader, eval_loader = get_dataloaders(accelerator, args.batch_size, cfg)
+
+    params = bert.init_params(cfg, jax.random.key(args.seed))
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, 10, args.num_epochs * len(train_loader)
+    )
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(schedule),
+        use_grad_accum_buffer=args.gradient_accumulation_steps > 1,
+    ))
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config=vars(args))
+
+    starting_epoch, resume_step = 0, 0
+    if args.resume_from_checkpoint:
+        restored = accelerator.load_state(
+            None if args.resume_from_checkpoint == "latest"
+            else args.resume_from_checkpoint,
+            state=ts,
+        )
+        ts = restored.get("train_states", [ts])[0]
+        # dirs are named checkpoint_{n}; map n back to epoch/step position
+        done_steps = int(ts.step)
+        starting_epoch = done_steps // len(train_loader)
+        resume_step = done_steps % len(train_loader)
+        accelerator.print(
+            f"resumed at epoch {starting_epoch}, batch {resume_step}"
+        )
+
+    step = accelerator.train_step(lambda p, b: bert.classification_loss(cfg, p, b))
+    eval_step = accelerator.eval_step(
+        lambda p, b: jnp.argmax(
+            bert.forward(cfg, p, b["input_ids"], b["attention_mask"],
+                         b["token_type_ids"]), axis=-1)
+    )
+
+    overall_step = int(ts.step)
+    metrics = {}
+    for epoch in range(starting_epoch, args.num_epochs):
+        total_loss = 0.0
+        loader = train_loader
+        if epoch == starting_epoch and resume_step > 0:
+            loader = accelerator.skip_first_batches(train_loader, resume_step)
+        for batch in loader:
+            ts, m = step(ts, batch)
+            total_loss += float(m["loss"])
+            overall_step += 1
+            if isinstance(args.checkpointing_steps, int) and (
+                overall_step % args.checkpointing_steps == 0
+            ):
+                accelerator.save_state(state=ts)
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(state=ts)
+
+        correct = total = 0
+        for batch in eval_loader:
+            preds = eval_step(ts.params, batch)
+            preds, labels = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(labels)).sum())
+            total += int(np.asarray(labels).shape[0])
+        metrics = {
+            "epoch": epoch,
+            "train_loss": total_loss / max(1, len(train_loader)),
+            "accuracy": correct / total,
+        }
+        accelerator.print(f"epoch {epoch}: {metrics}")
+        if args.with_tracking:
+            accelerator.log(metrics, step=overall_step)
+
+    if args.with_tracking:
+        accelerator.end_training()
+    return metrics
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mixed_precision", default="bf16",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=2e-4)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--tiny", action="store_true", help="tiny model (CI)")
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--checkpointing_steps", default=None,
+                        help='save every N steps, or "epoch"')
+    parser.add_argument("--checkpoint_total_limit", type=int, default=None)
+    parser.add_argument("--resume_from_checkpoint", default=None,
+                        help='checkpoint dir, or "latest"')
+    args = parser.parse_args(argv)
+    if args.checkpointing_steps and args.checkpointing_steps != "epoch":
+        args.checkpointing_steps = int(args.checkpointing_steps)
+    return args
+
+
+if __name__ == "__main__":
+    training_function(parse_args())

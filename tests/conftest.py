@@ -25,6 +25,17 @@ jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 
 
+def pytest_collection_modifyitems(config, items):
+    """Gate @pytest.mark.slow behind RUN_SLOW=1 (ref testing.py slow
+    decorator semantics)."""
+    if os.environ.get("RUN_SLOW", "0").lower() in ("1", "true", "yes"):
+        return
+    skip_slow = pytest.mark.skip(reason="slow test; set RUN_SLOW=1 to run")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip_slow)
+
+
 @pytest.fixture(autouse=True)
 def reset_state():
     """Clear the shared-state singletons between tests

@@ -281,3 +281,71 @@ def test_sharded_batch_iterable_uneven_no_even_batches():
         for rank in range(2)
     ]
     assert got == [[0, 2, 4], [1, 3]], got
+
+
+def test_sharded_batch_iterable_short_tail_divisible_count():
+    """Batch count divides P but the LAST batch is short: it must still be
+    padded so hosts stay shape-lockstepped, and the duplicated rows tracked."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [
+        {"x": np.arange(4, dtype=np.float32)},
+        {"x": np.arange(4, 8, dtype=np.float32)},
+        {"x": np.arange(8, 12, dtype=np.float32)},
+        {"x": np.arange(12, 14, dtype=np.float32)},  # short (2 rows), 4 % 2 == 0
+    ]
+    iters = [ShardedBatchIterable(batches, 2, rank) for rank in range(2)]
+    per_host = [list(it) for it in iters]
+    for host in per_host:
+        assert [np.asarray(b["x"]).shape for b in host] == [(4,), (4,)]
+    # final round: rank0 holds batch 2 (full), rank1 batch 3 (2 real rows):
+    # real rows in the gathered final round = 1*4 + 2
+    assert iters[0].remainder == 6 and iters[1].remainder == 6
+
+
+def test_sharded_batch_iterable_full_final_round_no_remainder():
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.full((4,), i, np.float32)} for i in range(4)]
+    it = ShardedBatchIterable(batches, 2, 0)
+    list(it)
+    assert it.remainder == -1
+
+
+def test_sharded_batch_iterable_split_mode():
+    """split_batches: each host slices every batch; global batch == source
+    batch size (ref data_loader split_batches semantics)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [
+        {"x": np.arange(8, dtype=np.float32)},
+        {"x": np.arange(8, 16, dtype=np.float32)},
+        {"x": np.arange(16, 22, dtype=np.float32)},  # short tail
+    ]
+    iters = [
+        ShardedBatchIterable(batches, 2, rank, split_batches=True)
+        for rank in range(2)
+    ]
+    per_host = [list(it) for it in iters]
+    assert [len(h) for h in per_host] == [3, 3]
+    np.testing.assert_array_equal(np.asarray(per_host[0][0]["x"]), np.arange(4))
+    np.testing.assert_array_equal(np.asarray(per_host[1][0]["x"]), np.arange(4, 8))
+    # padded tail tracked: 6 real rows in the final global batch
+    assert iters[0].remainder == 6 and iters[1].remainder == 6
+    # hosts' slices of the padded tail reassemble to the real rows first
+    tail = np.concatenate([np.asarray(per_host[0][2]["x"]),
+                           np.asarray(per_host[1][2]["x"])])
+    np.testing.assert_array_equal(tail[:6], np.arange(16, 22, dtype=np.float32))
+
+
+def test_prepare_data_loader_split_batches_plain_iterable():
+    """prepare_data_loader honors split_batches for plain batch lists."""
+    from accelerate_tpu.data import prepare_data_loader
+
+    batches = [{"x": np.arange(8, dtype=np.float32)}]
+    loader = prepare_data_loader(
+        batches, num_processes=2, process_index=1, split_batches=True,
+        put_on_device=False,
+    )
+    (got,) = list(loader)
+    np.testing.assert_array_equal(np.asarray(got["x"]), np.arange(4, 8))

@@ -1,0 +1,133 @@
+"""Example-script smoke tests (ref tests/test_examples.py — runs every
+example with tiny settings; the reference also diffs by_feature scripts
+against the complete_* canon, which has no analogue here since our examples
+share helpers by import instead of by copy).
+
+Fast in-process runs with tiny args; anything needing a fresh world or >30 s
+of compile is marked slow (RUN_SLOW=1).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import tempfile
+
+import pytest
+
+EXAMPLES_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def _load(relpath: str):
+    path = os.path.join(EXAMPLES_DIR, relpath)
+    name = relpath.removesuffix(".py").replace("/", "_")
+    sys.path.insert(0, EXAMPLES_DIR)
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    finally:
+        sys.path.remove(EXAMPLES_DIR)
+
+
+class _Args:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_nlp_example():
+    mod = _load("nlp_example.py")
+    metrics = mod.training_function(_Args(
+        mixed_precision="no", batch_size=16, num_epochs=1, lr=2e-4, seed=0,
+        gradient_accumulation_steps=1, fsdp=False, tiny=True, project_dir=None,
+    ))
+    assert 0.0 <= metrics["accuracy"] <= 1.0
+
+
+def test_cv_example():
+    mod = _load("cv_example.py")
+    metrics = mod.training_function(_Args(
+        mixed_precision="no", batch_size=16, num_epochs=1, lr=3e-3, width=8,
+        seed=0,
+    ))
+    assert "accuracy" in metrics
+
+
+def test_gradient_accumulation_example():
+    mod = _load("by_feature/gradient_accumulation.py")
+    metrics = mod.training_function(_Args(
+        gradient_accumulation_steps=4, batch_size=8, num_epochs=2, lr=0.05,
+        seed=0,
+    ))
+    assert metrics["loss"] < 10
+
+
+def test_early_stopping_example():
+    mod = _load("by_feature/early_stopping.py")
+    metrics = mod.training_function(_Args(
+        loss_threshold=0.5, batch_size=8, num_epochs=10, lr=0.05, seed=0,
+    ))
+    assert metrics["stopped_at_step"] is not None
+
+
+def test_multi_process_metrics_example():
+    mod = _load("by_feature/multi_process_metrics.py")
+    metrics = mod.training_function(_Args(
+        batch_size=8, num_epochs=1, lr=0.05, seed=0,
+    ))
+    assert metrics["samples_seen"] == 100
+
+
+def test_schedule_free_example():
+    mod = _load("by_feature/schedule_free.py")
+    metrics = mod.training_function(_Args(
+        batch_size=8, num_epochs=2, lr=0.05, seed=0,
+    ))
+    assert metrics["eval_mse"] < 5.0
+
+
+def test_checkpointing_example():
+    mod = _load("by_feature/checkpointing.py")
+    with tempfile.TemporaryDirectory() as tmp:
+        metrics = mod.training_function(_Args(
+            project_dir=tmp, batch_size=8, num_epochs=1, lr=0.05, seed=0,
+        ))
+    assert metrics["resumed_at_step"] == 16
+
+
+def test_tracking_example():
+    mod = _load("by_feature/tracking.py")
+    with tempfile.TemporaryDirectory() as tmp:
+        mod.training_function(_Args(
+            log_with="jsonl", project_dir=tmp, batch_size=8, num_epochs=1,
+            lr=0.05, seed=0,
+        ))
+        logged = []
+        for root, _, files in os.walk(tmp):
+            logged += [f for f in files if f.endswith(".jsonl")]
+        assert logged, "jsonl tracker wrote nothing"
+
+
+@pytest.mark.slow
+def test_zero_stage_config_example():
+    mod = _load("by_feature/zero_stage_config.py")
+    for stage in (0, 3):
+        metrics = mod.training_function(_Args(
+            zero_stage=stage, offload_param_device=None,
+            gradient_accumulation_steps=1, mixed_precision="no",
+            batch_size=16, num_epochs=1, lr=2e-4, seed=0, tiny=True,
+        ))
+        assert metrics["loss"] < 10
+
+
+@pytest.mark.slow
+def test_gspmd_gpt_pretraining_example():
+    mod = _load("by_feature/gspmd_gpt_pretraining.py")
+    metrics = mod.training_function(_Args(
+        tp=2, fsdp=2, dp=2, mixed_precision="no",
+        activation_checkpointing=False, seq_len=64, batch_size=8,
+        num_epochs=1, lr=3e-4, seed=0, tiny=True,
+    ))
+    assert metrics["lm_loss"] < 20

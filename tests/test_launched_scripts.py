@@ -14,12 +14,22 @@ from accelerate_tpu.test_utils import (
     bundled_script_path,
 )
 
-SCRIPTS = ["test_sync.py", "test_ops.py", "test_distributed_data_loop.py"]
+SCRIPTS = [
+    "test_sync.py",
+    "test_ops.py",
+    "test_distributed_data_loop.py",
+    "external_deps/test_checkpointing.py",
+    "external_deps/test_metrics.py",
+    "external_deps/test_performance.py",
+    "external_deps/test_peak_memory_usage.py",
+    "external_deps/test_pipeline_inference.py",
+    "external_deps/test_zero3_integration.py",
+]
 
 
 def _run_in_process(name: str) -> None:
     spec = importlib.util.spec_from_file_location(
-        name.removesuffix(".py"), bundled_script_path(name)
+        name.removesuffix(".py").replace("/", "."), bundled_script_path(name)
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
