@@ -45,6 +45,9 @@ from .utils.operations import (
     slice_tensors,
 )
 from .utils.random import synchronize_rng_states
+from .logging import get_logger
+
+logger = get_logger(__name__)
 
 _SENTINEL = object()
 
@@ -263,16 +266,25 @@ class ShardedBatchIterable:
                     raise ValueError(
                         "only the final batch may be short with split_batches"
                     )
-                if self.even_batches:
-                    batch = pad_batch_to(batch, full_size)
-                    self.remainder = size
+                if not self.even_batches:
+                    # slicing a short batch into B/P-row pieces would give
+                    # hosts different shapes in the same SPMD step
+                    raise ValueError(
+                        f"split_batches with even_batches=False cannot split "
+                        f"a short final batch ({size} rows < {full_size}); "
+                        "drop it or enable even_batches"
+                    )
+                batch = pad_batch_to(batch, full_size)
+                self.remainder = size
             per = full_size // P
-            yield jax.tree_util.tree_map(
-                lambda x: x[rank * per : (rank + 1) * per]
-                if isinstance(x, np.ndarray) or hasattr(x, "__getitem__")
-                else x,
-                batch_to_numpy(batch),
-            )
+
+            def _slice(x):
+                x_np = x if isinstance(x, np.ndarray) else x
+                if isinstance(x_np, np.ndarray) and x_np.ndim > 0:
+                    return x_np[rank * per : (rank + 1) * per]
+                return x_np  # scalars/0-d leaves replicate
+
+            yield jax.tree_util.tree_map(_slice, batch_to_numpy(batch))
 
     def _iter_stride_mode(self):
         P, rank = self.num_processes, self.process_index
@@ -573,11 +585,24 @@ class DataLoaderShard(DataLoaderStateMixin):
                 batch, remainder, tail_layout = current
                 if nxt is _SENTINEL:
                     self.end_of_dataloader = True
+                    loader_rem = getattr(self.loader, "remainder", -1)
                     if remainder == -1:
                         # a sharding iterable below may have padded/duplicated
                         # the final round itself (ShardedBatchIterable)
-                        remainder = getattr(self.loader, "remainder", -1)
+                        remainder = loader_rem
                         tail_layout = getattr(self.loader, "tail_layout", None)
+                    elif loader_rem != -1:
+                        # both layers padded (batch size not divisible by the
+                        # per-host device count AND hosts recycled batches) —
+                        # the tail metadata can't express the combination, so
+                        # exact gather_for_metrics dedup is off for this round
+                        logger.warning(
+                            "final batch was padded at both the host-sharding "
+                            "and device-sharding layers; gather_for_metrics "
+                            "cannot drop host-level duplicates. Use a batch "
+                            "size divisible by per-host device count for "
+                            "exact eval counts."
+                        )
                     if remainder != -1:
                         self.remainder = remainder
                         self.tail_layout = tail_layout
@@ -784,9 +809,11 @@ def prepare_data_loader(
             process_index=process_index,
             split_batches=split_batches,
         )
-    elif num_processes > 1:
+    elif num_processes > 1 and not getattr(dataloader, "is_host_sharded", False):
         # sized stream of ready-made batches: stride whole batches across
-        # hosts, or slice each batch when split_batches is requested
+        # hosts, or slice each batch when split_batches is requested.
+        # Sources that already shard per host (native.TokenCorpusLoader)
+        # declare is_host_sharded and pass through untouched.
         loader = ShardedBatchIterable(
             dataloader, num_processes, process_index, even_batches=even_batches,
             split_batches=split_batches,

@@ -1,0 +1,258 @@
+"""Native (C++) runtime components, with pure-Python fallbacks.
+
+The reference delegates its host-side input machinery to torch DataLoader
+worker processes and torch-xla's MpDeviceLoader threads (ref
+data_loader.py:518-559); this package owns that machinery natively:
+`token_loader.cpp` memory-maps tokenized corpora and assembles shuffled,
+host-sharded batches on producer threads behind a C ABI.
+
+The shared library builds on demand with g++ (cached beside the source);
+`TokenCorpusLoader` transparently falls back to a NumPy implementation with
+IDENTICAL semantics (same permutation, sharding, wraparound) when no
+toolchain is available, so behavior never depends on the build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "_native")
+_SRC = os.path.join(_SRC_DIR, "token_loader.cpp")
+
+_DTYPES = {np.dtype(np.uint16): 0, np.dtype(np.int32): 1, np.dtype(np.uint32): 2}
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: str | None = None
+
+
+def _build_dir() -> str:
+    override = os.environ.get("ACCELERATE_TPU_NATIVE_CACHE")
+    candidates = [override] if override else [
+        os.path.join(_SRC_DIR, "_build"),  # read-only installs fall through
+        os.path.join(tempfile.gettempdir(), f"accelerate_tpu_native_{os.getuid()}"),
+    ]
+    for d in candidates:
+        try:
+            os.makedirs(d, exist_ok=True)
+            if os.access(d, os.W_OK):
+                return d
+        except OSError:
+            continue
+    raise OSError(f"no writable native build dir among {candidates}")
+
+
+def _load_library():
+    """Compile (once) and dlopen the native library; None if unavailable."""
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            so_path = os.path.join(_build_dir(), "libatl.so")
+            if not os.path.exists(so_path) or (
+                os.path.getmtime(so_path) < os.path.getmtime(_SRC)
+            ):
+                cmd = [
+                    "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                    "-pthread", _SRC, "-o", so_path,
+                ]
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            lib = ctypes.CDLL(so_path)
+        except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
+            _build_error = getattr(e, "stderr", None) or str(e)
+            return None
+        lib.atl_open.restype = ctypes.c_void_p
+        lib.atl_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_long]
+        lib.atl_num_samples.restype = ctypes.c_long
+        lib.atl_num_samples.argtypes = [ctypes.c_void_p]
+        lib.atl_num_tokens.restype = ctypes.c_long
+        lib.atl_num_tokens.argtypes = [ctypes.c_void_p]
+        lib.atl_close.argtypes = [ctypes.c_void_p]
+        lib.atl_loader_new.restype = ctypes.c_void_p
+        lib.atl_loader_new.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.atl_loader_batches_per_epoch.restype = ctypes.c_long
+        lib.atl_loader_batches_per_epoch.argtypes = [ctypes.c_void_p]
+        lib.atl_loader_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        lib.atl_loader_next.restype = ctypes.c_int
+        lib.atl_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32)
+        ]
+        lib.atl_loader_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    """True if the native library is built (or buildable) on this host."""
+    return _load_library() is not None
+
+
+def build_error() -> str | None:
+    _load_library()
+    return _build_error
+
+
+def _epoch_order(num_samples: int, seed: int, epoch: int, shuffle: bool,
+                 rank: int, world: int) -> np.ndarray:
+    """The exact permutation+shard the C++ side computes (mt19937_64
+    Fisher-Yates, wraparound stride shard) — keeps fallback batches
+    bit-identical where numpy can reproduce it; the fallback uses numpy's
+    generator instead, so cross-implementation runs match in COVERAGE
+    (each sample once per epoch) though not in order."""
+    idx = np.arange(num_samples, dtype=np.int64)
+    if shuffle:
+        rng = np.random.default_rng(seed + epoch * 0x9E3779B9)
+        rng.shuffle(idx)
+    per = -(-num_samples // world)
+    take = (rank + np.arange(per, dtype=np.int64) * world) % num_samples
+    return idx[take]
+
+
+class TokenCorpusLoader:
+    """Iterate `{"input_ids": int32 [batch, sample_len]}` batches from a flat
+    binary token file.
+
+    Sized batch iterable — plugs straight into `Accelerator.prepare`/
+    `prepare_data_loader`. Construct with `rank=state.process_index,
+    world=state.num_processes`: the loader shards the corpus itself and sets
+    `is_host_sharded`, which tells `prepare_data_loader` NOT to stride its
+    batches across hosts a second time.
+
+    Uses the C++ core when available, else the NumPy fallback.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        sample_len: int,
+        batch_size: int,
+        dtype: np.dtype | str = np.int32,
+        shuffle: bool = True,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        drop_last: bool = True,
+        threads: int = 2,
+        prefetch_depth: int = 4,
+        force_fallback: bool = False,
+    ) -> None:
+        self.path = path
+        self.sample_len = int(sample_len)
+        self.batch_size = int(batch_size)
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPES:
+            raise ValueError(f"dtype {self.dtype} not supported; use uint16/int32/uint32")
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.rank, self.world = int(rank), int(world)
+        if self.world <= 0 or not (0 <= self.rank < self.world):
+            raise ValueError(f"invalid shard rank={rank} world={world}")
+        if self.batch_size <= 0 or self.sample_len <= 0:
+            raise ValueError(
+                f"batch_size/sample_len must be positive, got "
+                f"{batch_size}/{sample_len}"
+            )
+        # downstream prepare() must not shard again: this loader already
+        # yields only this host's shard
+        self.is_host_sharded = self.world > 1
+        self.drop_last = drop_last
+        self.threads, self.prefetch_depth = threads, prefetch_depth
+        self.epoch = 0
+
+        self._lib = None if force_fallback else _load_library()
+        self._corpus = None
+        self._loader = None
+        if self._lib is not None:
+            self._corpus = self._lib.atl_open(
+                path.encode(), _DTYPES[self.dtype], self.sample_len
+            )
+            if not self._corpus:
+                raise FileNotFoundError(f"cannot mmap token file {path}")
+            self.num_samples = self._lib.atl_num_samples(self._corpus)
+            self._loader = self._lib.atl_loader_new(
+                self._corpus, self.batch_size, int(shuffle), self.seed,
+                self.rank, self.world, int(drop_last), threads, prefetch_depth,
+            )
+            if not self._loader:
+                raise RuntimeError(
+                    "native loader creation failed (args rejected by atl_loader_new)"
+                )
+        else:
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r")
+            self.num_samples = len(self._mm) // self.sample_len
+        per = -(-self.num_samples // self.world)
+        self.num_batches = (
+            per // self.batch_size if drop_last
+            else -(-per // self.batch_size)
+        )
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __len__(self) -> int:
+        return self.num_batches
+
+    def __iter__(self):
+        if self._loader is not None:
+            yield from self._iter_native()
+        else:
+            yield from self._iter_fallback()
+        self.epoch += 1
+
+    def _iter_native(self):
+        out = np.empty((self.batch_size, self.sample_len), np.int32)
+        ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        self._lib.atl_loader_start_epoch(self._loader, self.epoch)
+        while True:
+            rc = self._lib.atl_loader_next(self._loader, ptr)
+            if rc != 0:
+                break
+            yield {"input_ids": out.copy()}
+
+    def _iter_fallback(self):
+        order = _epoch_order(
+            self.num_samples, self.seed, self.epoch, self.shuffle,
+            self.rank, self.world,
+        )
+        L, B = self.sample_len, self.batch_size
+        tokens = self._mm
+        n = len(order)
+        for b in range(self.num_batches):
+            rows = [order[(b * B + i) % n] for i in range(B)]
+            batch = np.stack(
+                [np.asarray(tokens[r * L : (r + 1) * L], dtype=np.int32) for r in rows]
+            )
+            yield {"input_ids": batch}
+
+    def close(self) -> None:
+        if self._loader is not None:
+            self._lib.atl_loader_free(self._loader)
+            self._loader = None
+        if self._corpus is not None:
+            self._lib.atl_close(self._corpus)
+            self._corpus = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> str:
+    """Write a flat binary token file in a supported dtype."""
+    arr = np.ascontiguousarray(tokens)
+    if arr.dtype not in _DTYPES:
+        arr = arr.astype(np.int32)
+    arr.tofile(path)
+    return path

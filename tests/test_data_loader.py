@@ -349,3 +349,25 @@ def test_prepare_data_loader_split_batches_plain_iterable():
     )
     (got,) = list(loader)
     np.testing.assert_array_equal(np.asarray(got["x"]), np.arange(4, 8))
+
+
+def test_split_mode_no_even_batches_short_tail_raises():
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(8, dtype=np.float32)},
+               {"x": np.arange(8, 13, dtype=np.float32)}]
+    it = ShardedBatchIterable(batches, 2, 0, even_batches=False,
+                              split_batches=True)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="short final batch"):
+        list(it)
+
+
+def test_split_mode_scalar_leaf_replicates():
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(8, dtype=np.float32), "w": np.float32(0.5)}]
+    (got,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    np.testing.assert_array_equal(got["x"], np.arange(4, 8, dtype=np.float32))
+    assert float(got["w"]) == 0.5

@@ -1,0 +1,163 @@
+"""Native C++ token loader: build, correctness vs fallback, sharding,
+determinism, epoch reshuffle, prefetch ordering under threads."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import native
+
+NATIVE = native.is_available()
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 1000, size=10_000, dtype=np.int32)
+    path = str(tmp_path / "corpus.bin")
+    native.write_token_file(path, tokens)
+    return path, tokens
+
+
+def _collect(loader):
+    return [b["input_ids"] for b in loader]
+
+
+@pytest.mark.skipif(not NATIVE, reason=f"native build unavailable: {native.build_error()}")
+def test_native_builds_and_iterates(token_file):
+    path, tokens = token_file
+    loader = native.TokenCorpusLoader(path, sample_len=128, batch_size=4, seed=3)
+    batches = _collect(loader)
+    assert len(batches) == len(loader) == (10_000 // 128) // 4
+    for b in batches:
+        assert b.shape == (4, 128) and b.dtype == np.int32
+    loader.close()
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_covers_each_sample_once(token_file):
+    path, tokens = token_file
+    n_samples = 10_000 // 128
+    loader = native.TokenCorpusLoader(
+        path, sample_len=128, batch_size=1, seed=7, drop_last=False
+    )
+    rows = np.concatenate(_collect(loader))
+    # every sample window appears exactly once per epoch
+    assert len(rows) == n_samples
+    seen = {r.tobytes() for r in rows}
+    want = {tokens[i * 128 : (i + 1) * 128].tobytes() for i in range(n_samples)}
+    assert seen == want
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_deterministic_and_reshuffles(token_file):
+    path, _ = token_file
+    a = _collect(native.TokenCorpusLoader(path, 128, 4, seed=11))
+    b = _collect(native.TokenCorpusLoader(path, 128, 4, seed=11))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    l2 = native.TokenCorpusLoader(path, 128, 4, seed=11)
+    first = _collect(l2)
+    second = _collect(l2)  # epoch advanced -> different order
+    assert any(
+        not np.array_equal(x, y) for x, y in zip(first, second)
+    ), "epoch 1 produced the same order as epoch 0"
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_sharding_partitions(token_file):
+    path, tokens = token_file
+    n_samples = 10_000 // 128
+    shards = [
+        np.concatenate(_collect(native.TokenCorpusLoader(
+            path, 128, 2, seed=5, rank=r, world=2, drop_last=False
+        )))
+        for r in range(2)
+    ]
+    # equal batch counts on every rank (SPMD lockstep)
+    assert shards[0].shape == shards[1].shape
+    union = {r.tobytes() for s in shards for r in s}
+    want = {tokens[i * 128 : (i + 1) * 128].tobytes() for i in range(n_samples)}
+    assert union == want
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_threads_keep_batch_order(token_file):
+    path, _ = token_file
+    a = _collect(native.TokenCorpusLoader(path, 64, 4, seed=2, threads=1))
+    b = _collect(native.TokenCorpusLoader(path, 64, 4, seed=2, threads=4,
+                                          prefetch_depth=8))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_uint16_widens(tmp_path):
+    tokens = np.arange(4096, dtype=np.uint16)
+    path = str(tmp_path / "u16.bin")
+    native.write_token_file(path, tokens)
+    loader = native.TokenCorpusLoader(path, 64, 2, dtype=np.uint16,
+                                      shuffle=False, seed=0)
+    first = next(iter(loader))["input_ids"]
+    assert first.dtype == np.int32
+
+
+def test_fallback_same_coverage(token_file):
+    """The pure-Python fallback yields the same shapes/counts and covers the
+    same sample set (order may differ — different RNG)."""
+    path, tokens = token_file
+    fb = native.TokenCorpusLoader(path, 128, 4, seed=3, force_fallback=True,
+                                  drop_last=False)
+    batches = _collect(fb)
+    assert len(batches) == len(fb)
+    rows = np.concatenate(batches)
+    n_samples = 10_000 // 128
+    # wraparound may duplicate a few rows in the final batch; the REAL set
+    # of distinct windows must be exactly the corpus windows
+    seen = {r.tobytes() for r in rows}
+    want = {tokens[i * 128 : (i + 1) * 128].tobytes() for i in range(n_samples)}
+    assert seen == want
+
+
+def test_feeds_accelerator_loader(token_file):
+    """TokenCorpusLoader is a sized batch iterable: plugs into prepare()."""
+    from accelerate_tpu.accelerator import Accelerator
+
+    path, _ = token_file
+    acc = Accelerator()
+    src = native.TokenCorpusLoader(path, sample_len=64, batch_size=8, seed=1,
+                                   force_fallback=not NATIVE)
+    loader = acc.prepare(src)
+    batch = next(iter(loader))
+    import jax
+
+    assert isinstance(batch["input_ids"], jax.Array)
+    assert batch["input_ids"].shape == (8, 64)
+
+
+def test_invalid_shard_raises(token_file):
+    path, _ = token_file
+    with pytest.raises(ValueError):
+        native.TokenCorpusLoader(path, 128, 8, rank=2, world=2)
+    with pytest.raises(ValueError):
+        native.TokenCorpusLoader(path, 128, 0)
+
+
+def test_host_sharded_source_not_resharded(token_file):
+    """prepare_data_loader must not stride a source that already sharded
+    itself per host (is_host_sharded)."""
+    from accelerate_tpu.data import prepare_data_loader
+
+    path, _ = token_file
+    src = native.TokenCorpusLoader(path, 128, 4, seed=1, rank=0, world=2,
+                                   force_fallback=not NATIVE)
+    assert src.is_host_sharded
+    loader = prepare_data_loader(
+        src, num_processes=2, process_index=0, put_on_device=False
+    )
+    # all of the source's batches come through — not every other one
+    assert len(list(loader)) == len(src)
