@@ -128,6 +128,16 @@ struct Loader {
     }
   }
 
+  // SplitMix64: trivially portable, reproduced bit-for-bit by the Python
+  // fallback (native/__init__.py) so mixed native/fallback fleets compute
+  // IDENTICAL permutations — host shards stay disjoint either way.
+  static uint64_t splitmix64(uint64_t& s) {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ull);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+    return z ^ (z >> 31);
+  }
+
   void start_epoch(long epoch) {
     stop();
     const Corpus& c = *corpus;
@@ -135,9 +145,9 @@ struct Loader {
     std::vector<long> all(c.num_samples);
     for (long i = 0; i < c.num_samples; ++i) all[i] = i;
     if (shuffle) {
-      std::mt19937_64 rng(seed + (uint64_t)epoch * 0x9E3779B97F4A7C15ull);
+      uint64_t s = seed ^ ((uint64_t)epoch * 0xD1B54A32D192ED03ull);
       for (long i = c.num_samples - 1; i > 0; --i) {
-        const long j = (long)(rng() % (uint64_t)(i + 1));
+        const long j = (long)(splitmix64(s) % (uint64_t)(i + 1));
         std::swap(all[i], all[j]);
       }
     }

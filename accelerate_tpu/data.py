@@ -279,10 +279,12 @@ class ShardedBatchIterable:
             per = full_size // P
 
             def _slice(x):
-                x_np = x if isinstance(x, np.ndarray) else x
-                if isinstance(x_np, np.ndarray) and x_np.ndim > 0:
-                    return x_np[rank * per : (rank + 1) * per]
-                return x_np  # scalars/0-d leaves replicate
+                if isinstance(x, np.ndarray):
+                    # 0-d leaves replicate; batched arrays slice
+                    return x if x.ndim == 0 else x[rank * per : (rank + 1) * per]
+                if hasattr(x, "__getitem__"):  # e.g. a list of strings
+                    return x[rank * per : (rank + 1) * per]
+                return x
 
             yield jax.tree_util.tree_map(_slice, batch_to_numpy(batch))
 

@@ -52,6 +52,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     attention_backend: str = "einsum"  # einsum | flash | ring | ulysses
     remat: bool = False
+    remat_policy: str = "full"  # full | dots (save MXU outputs, recompute rest)
 
     @property
     def head_dim(self) -> int:
@@ -217,7 +218,18 @@ def forward(
         return y, None
 
     if config.remat:
-        scan_body = jax.checkpoint(scan_body, prevent_cse=False)
+        # "dots" keeps MXU outputs resident and recomputes only cheap
+        # elementwise ops — much less recompute than full remat for a modest
+        # memory bump (the scaling-book selective-checkpoint recipe)
+        if config.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {config.remat_policy!r}; use 'full' or 'dots'"
+            )
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if config.remat_policy == "dots" else None
+        )
+        scan_body = jax.checkpoint(scan_body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
     return _project_out(config, params, x)

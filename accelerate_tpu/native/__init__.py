@@ -40,12 +40,18 @@ def _build_dir() -> str:
     ]
     for d in candidates:
         try:
-            os.makedirs(d, exist_ok=True)
+            os.makedirs(d, mode=0o700, exist_ok=True)
+            st = os.stat(d)
+            # refuse dirs we don't own or that others can write: a planted
+            # .so in a predictable shared path would be dlopened into the
+            # training process
+            if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+                continue
             if os.access(d, os.W_OK):
                 return d
         except OSError:
             continue
-    raise OSError(f"no writable native build dir among {candidates}")
+    raise OSError(f"no safe writable native build dir among {candidates}")
 
 
 def _load_library():
@@ -59,11 +65,15 @@ def _load_library():
             if not os.path.exists(so_path) or (
                 os.path.getmtime(so_path) < os.path.getmtime(_SRC)
             ):
+                # unique temp output + atomic rename: N launcher workers can
+                # race this build without anyone dlopening a half-written .so
+                tmp_out = f"{so_path}.{os.getpid()}.tmp"
                 cmd = [
                     "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                    "-pthread", _SRC, "-o", so_path,
+                    "-pthread", _SRC, "-o", tmp_out,
                 ]
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
+                os.replace(tmp_out, so_path)
             lib = ctypes.CDLL(so_path)
         except (OSError, subprocess.CalledProcessError, FileNotFoundError) as e:
             _build_error = getattr(e, "stderr", None) or str(e)
@@ -102,17 +112,32 @@ def build_error() -> str | None:
     return _build_error
 
 
+_MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _splitmix64_draws(seed: int, epoch: int, n: int) -> np.ndarray:
+    """The SplitMix64 stream token_loader.cpp uses, vectorized: draw k is
+    mix(seed_epoch + (k+1)*GAMMA)."""
+    gamma = np.uint64(0x9E3779B97F4A7C15)
+    s0 = np.uint64((seed ^ (epoch * 0xD1B54A32D192ED03)) & 0xFFFFFFFFFFFFFFFF)
+    with np.errstate(over="ignore"):
+        z = (s0 + (np.arange(1, n + 1, dtype=np.uint64)) * gamma) & _MASK64
+        z = ((z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)) & _MASK64
+        z = ((z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)) & _MASK64
+        return z ^ (z >> np.uint64(31))
+
+
 def _epoch_order(num_samples: int, seed: int, epoch: int, shuffle: bool,
                  rank: int, world: int) -> np.ndarray:
-    """The exact permutation+shard the C++ side computes (mt19937_64
-    Fisher-Yates, wraparound stride shard) — keeps fallback batches
-    bit-identical where numpy can reproduce it; the fallback uses numpy's
-    generator instead, so cross-implementation runs match in COVERAGE
-    (each sample once per epoch) though not in order."""
+    """The EXACT permutation+shard the C++ side computes (SplitMix64
+    Fisher-Yates, wraparound stride shard): mixed native/fallback fleets
+    therefore see bit-identical epoch orders and disjoint host shards."""
     idx = np.arange(num_samples, dtype=np.int64)
-    if shuffle:
-        rng = np.random.default_rng(seed + epoch * 0x9E3779B9)
-        rng.shuffle(idx)
+    if shuffle and num_samples > 1:
+        draws = _splitmix64_draws(seed, epoch, num_samples - 1)
+        for k, i in enumerate(range(num_samples - 1, 0, -1)):
+            j = int(draws[k] % np.uint64(i + 1))
+            idx[i], idx[j] = idx[j], idx[i]
     per = -(-num_samples // world)
     take = (rank + np.arange(per, dtype=np.int64) * world) % num_samples
     return idx[take]
@@ -195,6 +220,17 @@ class TokenCorpusLoader:
             per // self.batch_size if drop_last
             else -(-per // self.batch_size)
         )
+        # drop_last=False wraps the final batch with recycled rows; report
+        # them like every other loader so gather_for_metrics can drop them
+        # (DataLoaderShard reads these at end of epoch). Every host has the
+        # same `per`, so the layout is uniform (hosts, batch, real).
+        real_tail = per - self.batch_size * (self.num_batches - 1)
+        if not drop_last and 0 < real_tail < self.batch_size:
+            self.remainder = real_tail * self.world
+            self.tail_layout = (self.world, self.batch_size, real_tail)
+        else:
+            self.remainder = -1
+            self.tail_layout = None
 
     def set_epoch(self, epoch: int) -> None:
         self.epoch = int(epoch)

@@ -1,0 +1,21 @@
+"""Launch-and-assert: minimal CLI smoke script
+(ref test_utils/scripts/test_cli.py — prints the device count so
+`accelerate-tpu launch` wiring can be asserted from the outside)."""
+
+from __future__ import annotations
+
+
+def main() -> None:
+    import jax
+
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    print(
+        f"Successfully ran on {jax.device_count()} device(s) "
+        f"across {state.num_processes} process(es)"
+    )
+
+
+if __name__ == "__main__":
+    main()

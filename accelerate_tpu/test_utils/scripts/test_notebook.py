@@ -1,0 +1,34 @@
+"""Launch-and-assert: notebook_launcher situational setups
+(ref test_utils/scripts/test_notebook.py): the launcher must build the
+requested world, and refuse to start when JAX was already initialized in the
+calling process (the TPU analogue of the reference's "CUDA already
+initialized" guard)."""
+
+from __future__ import annotations
+
+import os
+
+
+def basic_function():
+    from accelerate_tpu.state import PartialState
+
+    print(f"PartialState:\n{PartialState()!r}")
+
+
+NUM_PROCESSES = int(os.environ.get("ACCELERATE_TPU_NUM_PROCESSES", "1"))
+
+
+def test_can_initialize():
+    from accelerate_tpu.launchers import notebook_launcher
+
+    notebook_launcher(basic_function, (), num_processes=NUM_PROCESSES)
+
+
+def main() -> None:
+    print("Test basic notebook can be ran")
+    test_can_initialize()
+    print("test_notebook: ALL CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,7 @@ def main() -> None:
             num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
             max_position_embeddings=2048, remat=True,
         )
-        batch, seq, steps = 8, 2048, 20
+        batch, seq, steps = 16, 2048, 20
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = llama.LlamaConfig.tiny()
         batch, seq, steps = 4, 64, 3

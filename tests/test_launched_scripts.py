@@ -18,6 +18,8 @@ SCRIPTS = [
     "test_sync.py",
     "test_ops.py",
     "test_distributed_data_loop.py",
+    "test_cli.py",
+    "test_notebook.py",
     "external_deps/test_checkpointing.py",
     "external_deps/test_metrics.py",
     "external_deps/test_performance.py",

@@ -161,3 +161,31 @@ def test_host_sharded_source_not_resharded(token_file):
     )
     # all of the source's batches come through — not every other one
     assert len(list(loader)) == len(src)
+
+
+@pytest.mark.skipif(not NATIVE, reason="native build unavailable")
+def test_native_and_fallback_identical_order(token_file):
+    """SplitMix64 shuffle is reproduced bit-for-bit by the fallback, so a
+    mixed native/fallback fleet computes identical permutations (disjoint
+    host shards either way)."""
+    path, _ = token_file
+    for epoch in range(2):
+        a = native.TokenCorpusLoader(path, 128, 4, seed=9, rank=1, world=2)
+        b = native.TokenCorpusLoader(path, 128, 4, seed=9, rank=1, world=2,
+                                     force_fallback=True)
+        a.set_epoch(epoch)
+        b.set_epoch(epoch)
+        for x, y in zip(_collect(a), _collect(b)):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_drop_last_false_reports_remainder(token_file):
+    path, _ = token_file
+    # 78 samples of 128 tokens; batch 5 -> final batch holds 3 real rows
+    src = native.TokenCorpusLoader(path, 128, 5, seed=1, drop_last=False,
+                                   force_fallback=not NATIVE)
+    assert src.remainder == 78 - 15 * 5
+    assert src.tail_layout == (1, 5, 3)
+    src2 = native.TokenCorpusLoader(path, 128, 6, seed=1, drop_last=True,
+                                    force_fallback=not NATIVE)
+    assert src2.remainder == -1
