@@ -282,11 +282,16 @@ class ShardedBatchIterable:
                 if isinstance(x, np.ndarray):
                     # 0-d leaves replicate; batched arrays slice
                     return x if x.ndim == 0 else x[rank * per : (rank + 1) * per]
-                if hasattr(x, "__getitem__"):  # e.g. a list of strings
+                if isinstance(x, (list, tuple)):  # e.g. a list of strings
                     return x[rank * per : (rank + 1) * per]
-                return x
+                return x  # strings/scalars replicate
 
-            yield jax.tree_util.tree_map(_slice, batch_to_numpy(batch))
+            # lists are row containers here, not pytree structure: keep them
+            # whole so a list of strings slices by row, never by character
+            yield jax.tree_util.tree_map(
+                _slice, batch_to_numpy(batch),
+                is_leaf=lambda x: isinstance(x, (list, tuple)),
+            )
 
     def _iter_stride_mode(self):
         P, rank = self.num_processes, self.process_index

@@ -150,12 +150,16 @@ def notebook_launcher(
     on a CPU-only host spawns a localhost debug world instead (the
     reference's CPU `start_processes` path).
     """
-    if AcceleratorState._shared_state and num_processes not in (None, 0, 1):
-        # ref launchers.py:89-97: can't fork after the runtime is initialized.
+    if (
+        (AcceleratorState._shared_state or PartialState._shared_state)
+        and num_processes not in (None, 0, 1)
+    ):
+        # ref launchers.py:89-97: can't fork after the runtime is initialized
+        # (PartialState alone already pinned the JAX backend in this process).
         raise RuntimeError(
-            "AcceleratorState is already initialized in this notebook; "
-            "restart the kernel (or avoid creating an Accelerator before "
-            "notebook_launcher) to launch a multi-process world."
+            "The accelerator state is already initialized in this notebook; "
+            "restart the kernel (or avoid creating an Accelerator/PartialState "
+            "before notebook_launcher) to launch a multi-process world."
         )
     if mixed_precision is not None:
         # explicit arg wins over any stale value from a previous launch;

@@ -1,6 +1,9 @@
-"""Runnable model families (flagship workloads for benchmarks/examples)."""
+"""Runnable model families (flagship workloads for benchmarks/examples),
+plus HF-checkpoint import (`hf_import`) validated logit-exact against
+transformers."""
 
-from . import bert, common, llama, mixtral
+from . import bert, common, hf_import, llama, mixtral
 from .bert import BertConfig
+from .hf_import import config_from_hf, load_hf_checkpoint, params_from_hf
 from .llama import LlamaConfig
 from .mixtral import MixtralConfig

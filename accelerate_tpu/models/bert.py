@@ -101,7 +101,10 @@ def _layer_body(config: BertConfig, x, layer, mask):
     x = layer_norm(x + attn, layer["attention_layernorm"]["scale"],
                    layer["attention_layernorm"]["bias"], config.layer_norm_eps)
     m = layer["mlp"]
-    hmid = jax.nn.gelu(dense(x, m["up_proj"]["kernel"], m["up_proj"]["bias"]))
+    # exact (erf) GELU — what BERT checkpoints were trained with; the tanh
+    # approximation diverges enough to break logit parity with HF weights
+    hmid = jax.nn.gelu(dense(x, m["up_proj"]["kernel"], m["up_proj"]["bias"]),
+                       approximate=False)
     out = dense(hmid, m["down_proj"]["kernel"], m["down_proj"]["bias"])
     return layer_norm(x + out, layer["output_layernorm"]["scale"],
                       layer["output_layernorm"]["bias"], config.layer_norm_eps)

@@ -1,0 +1,302 @@
+"""HuggingFace checkpoint import: torch state dicts -> stacked JAX pytrees.
+
+Closes SURVEY.md §7 hard-part 6 (torch .bin/safetensors -> jax pytrees for HF
+model import; the reference gets this for free by BEING torch —
+ref utils/modeling.py:1413-1504 `load_state_dict` + :1554 `load_checkpoint_in_model`).
+
+Three transforms per weight:
+- name map: `model.layers.{i}.self_attn.q_proj.weight` -> `layers/attn/q_proj`
+- layout: torch `nn.Linear` stores `[out, in]`; our `dense` kernels are
+  `[in, out]` -> transpose
+- stacking: per-layer tensors stack into the scan layout `[L, ...]` every
+  model family here uses (so `lax.scan` runs the layer loop on-device)
+
+Use `transformers` models as the source of truth in tests: converted params
+must reproduce HF logits to float tolerance.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import numpy as np
+
+from .bert import BertConfig
+from .llama import LlamaConfig
+from .mixtral import MixtralConfig
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / numpy array -> numpy (no torch import required)."""
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "detach"):  # torch tensor
+        t = t.detach()
+        if hasattr(t, "to") and str(getattr(t, "dtype", "")) == "torch.bfloat16":
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def _stack(sd: Mapping[str, Any], template: str, n: int, transpose: bool) -> np.ndarray:
+    """Stack per-layer tensors `template.format(i)` into [n, ...]."""
+    rows = []
+    for i in range(n):
+        t = _np(sd[template.format(i)])
+        rows.append(t.T if transpose else t)
+    return np.stack(rows)
+
+
+# ---------------------------------------------------------------------------
+# Llama
+# ---------------------------------------------------------------------------
+
+
+def llama_config_from_hf(hf_config) -> LlamaConfig:
+    """Build our config from a transformers LlamaConfig (object or dict)."""
+    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
+        hf_config, dict
+    ) else (lambda k, d=None: hf_config.get(k, d))
+    return LlamaConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        rope_theta=get("rope_theta", 10000.0),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(config: LlamaConfig, sd: Mapping[str, Any]) -> dict:
+    """Convert a `LlamaForCausalLM` state dict (HF names) to our pytree."""
+    L = config.num_hidden_layers
+    p = "model."
+    if f"{p}embed_tokens.weight" not in sd and "embed_tokens.weight" in sd:
+        p = ""  # bare LlamaModel export
+    params = {
+        "embed_tokens": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
+        "layers": {
+            "input_layernorm": {"scale": _stack(
+                sd, p + "layers.{}.input_layernorm.weight", L, transpose=False)},
+            "attn": {
+                name: {"kernel": _stack(
+                    sd, p + "layers.{}.self_attn." + name + ".weight", L,
+                    transpose=True)}
+                for name in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "post_attention_layernorm": {"scale": _stack(
+                sd, p + "layers.{}.post_attention_layernorm.weight", L,
+                transpose=False)},
+            "mlp": {
+                name: {"kernel": _stack(
+                    sd, p + "layers.{}.mlp." + name + ".weight", L,
+                    transpose=True)}
+                for name in ("gate_proj", "up_proj", "down_proj")
+            },
+        },
+        "norm": {"scale": _np(sd[f"{p}norm.weight"])},
+    }
+    if not config.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+        else:  # checkpoint tied even though config says untied
+            params["lm_head"] = {"kernel": params["embed_tokens"]["embedding"].T}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Mixtral
+# ---------------------------------------------------------------------------
+
+
+def mixtral_config_from_hf(hf_config) -> MixtralConfig:
+    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
+        hf_config, dict
+    ) else (lambda k, d=None: hf_config.get(k, d))
+    return MixtralConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        num_local_experts=get("num_local_experts", 8),
+        num_experts_per_tok=get("num_experts_per_tok", 2),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        rope_theta=get("rope_theta", 10000.0),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+    )
+
+
+def mixtral_params_from_hf(config: MixtralConfig, sd: Mapping[str, Any]) -> dict:
+    """Convert a `MixtralForCausalLM` state dict. HF expert weights are
+    `block_sparse_moe.experts.{e}.w1/w3/w2` (gate/up/down)."""
+    L, E = config.num_hidden_layers, config.num_local_experts
+    p = "model."
+
+    def estack(w_name: str) -> np.ndarray:
+        return np.stack([
+            np.stack([
+                _np(sd[f"{p}layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"]).T
+                for e in range(E)
+            ])
+            for i in range(L)
+        ])  # [L, E, in, out]
+
+    return {
+        "embed_tokens": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
+        "layers": {
+            "input_layernorm": {"scale": _stack(
+                sd, p + "layers.{}.input_layernorm.weight", L, transpose=False)},
+            "attn": {
+                name: {"kernel": _stack(
+                    sd, p + "layers.{}.self_attn." + name + ".weight", L,
+                    transpose=True)}
+                for name in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "post_attention_layernorm": {"scale": _stack(
+                sd, p + "layers.{}.post_attention_layernorm.weight", L,
+                transpose=False)},
+            "moe": {
+                "router": {"kernel": _stack(
+                    sd, p + "layers.{}.block_sparse_moe.gate.weight", L,
+                    transpose=True)},
+                "experts": {
+                    "gate_proj": {"kernel": estack("w1")},
+                    "up_proj": {"kernel": estack("w3")},
+                    "down_proj": {"kernel": estack("w2")},
+                },
+            },
+        },
+        "norm": {"scale": _np(sd[f"{p}norm.weight"])},
+        "lm_head": {"kernel": _np(sd["lm_head.weight"]).T},
+    }
+
+
+# ---------------------------------------------------------------------------
+# BERT
+# ---------------------------------------------------------------------------
+
+
+def bert_config_from_hf(hf_config, num_labels: int | None = None) -> BertConfig:
+    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
+        hf_config, dict
+    ) else (lambda k, d=None: hf_config.get(k, d))
+    return BertConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 512),
+        type_vocab_size=get("type_vocab_size", 2),
+        layer_norm_eps=get("layer_norm_eps", 1e-12),
+        num_labels=num_labels or len(get("id2label", None) or {0: 0, 1: 1}),
+    )
+
+
+def bert_params_from_hf(config: BertConfig, sd: Mapping[str, Any]) -> dict:
+    """Convert a `BertForSequenceClassification` (or bare `BertModel`)
+    state dict."""
+    L = config.num_hidden_layers
+    p = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    emb = f"{p}embeddings."
+    enc = p + "encoder.layer.{}."
+
+    def lin(template: str) -> dict:
+        return {
+            "kernel": _stack(sd, template + ".weight", L, transpose=True),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    def ln(template: str) -> dict:
+        return {
+            "scale": _stack(sd, template + ".weight", L, transpose=False),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    params = {
+        "embed_tokens": {"embedding": _np(sd[emb + "word_embeddings.weight"])},
+        "position_embeddings": {"embedding": _np(sd[emb + "position_embeddings.weight"])},
+        "token_type_embeddings": {"embedding": _np(sd[emb + "token_type_embeddings.weight"])},
+        "embeddings_layernorm": {
+            "scale": _np(sd[emb + "LayerNorm.weight"]),
+            "bias": _np(sd[emb + "LayerNorm.bias"]),
+        },
+        "layers": {
+            "attn": {
+                "q_proj": lin(enc + "attention.self.query"),
+                "k_proj": lin(enc + "attention.self.key"),
+                "v_proj": lin(enc + "attention.self.value"),
+                "o_proj": lin(enc + "attention.output.dense"),
+            },
+            "attention_layernorm": ln(enc + "attention.output.LayerNorm"),
+            "mlp": {
+                "up_proj": lin(enc + "intermediate.dense"),
+                "down_proj": lin(enc + "output.dense"),
+            },
+            "output_layernorm": ln(enc + "output.LayerNorm"),
+        },
+        "pooler": {
+            "kernel": _np(sd[p + "pooler.dense.weight"]).T,
+            "bias": _np(sd[p + "pooler.dense.bias"]),
+        },
+    }
+    if "classifier.weight" in sd:
+        params["classifier"] = {
+            "kernel": _np(sd["classifier.weight"]).T,
+            "bias": _np(sd["classifier.bias"]),
+        }
+    else:  # bare BertModel: identity-ish head so forward still runs
+        params["classifier"] = {
+            "kernel": np.zeros((config.hidden_size, config.num_labels), np.float32),
+            "bias": np.zeros((config.num_labels,), np.float32),
+        }
+    return params
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+_FAMILIES = {
+    "llama": (llama_config_from_hf, llama_params_from_hf),
+    "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
+    "bert": (bert_config_from_hf, bert_params_from_hf),
+}
+
+
+def params_from_hf(family: str, config, state_dict: Mapping[str, Any]) -> dict:
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown family {family!r}; known: {sorted(_FAMILIES)}")
+    return _FAMILIES[family][1](config, state_dict)
+
+
+def config_from_hf(family: str, hf_config):
+    if family not in _FAMILIES:
+        raise ValueError(f"unknown family {family!r}; known: {sorted(_FAMILIES)}")
+    return _FAMILIES[family][0](hf_config)
+
+
+def load_hf_checkpoint(family: str, config, checkpoint: str, dtype=None) -> dict:
+    """Stream a HF checkpoint directory (sharded safetensors / torch .bin)
+    into a converted param pytree (ref load_checkpoint_in_model semantics,
+    but with the name/layout/stacking transform applied)."""
+    from ..utils.modeling import load_state_dict, resolve_checkpoint_files
+
+    sd: dict[str, np.ndarray] = {}
+    for f in resolve_checkpoint_files(checkpoint):
+        sd.update(load_state_dict(f))
+    params = params_from_hf(family, config, sd)
+    if dtype is not None:
+        import jax
+
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(dtype) if hasattr(x, "astype") else x, params
+        )
+    return params

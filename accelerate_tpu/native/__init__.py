@@ -113,6 +113,10 @@ def build_error() -> str | None:
 
 
 _MASK64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+# largest corpus (in samples) for which the fallback reproduces the native
+# shuffle bit-for-bit (the swap loop is Python-sequential, ~1s per 2M)
+_EXACT_SHUFFLE_MAX = int(os.environ.get("ACCELERATE_TPU_EXACT_SHUFFLE_MAX",
+                                        2_000_000))
 
 
 def _splitmix64_draws(seed: int, epoch: int, n: int) -> np.ndarray:
@@ -134,10 +138,27 @@ def _epoch_order(num_samples: int, seed: int, epoch: int, shuffle: bool,
     therefore see bit-identical epoch orders and disjoint host shards."""
     idx = np.arange(num_samples, dtype=np.int64)
     if shuffle and num_samples > 1:
-        draws = _splitmix64_draws(seed, epoch, num_samples - 1)
-        for k, i in enumerate(range(num_samples - 1, 0, -1)):
-            j = int(draws[k] % np.uint64(i + 1))
-            idx[i], idx[j] = idx[j], idx[i]
+        if num_samples > _EXACT_SHUFFLE_MAX:
+            # the bit-exact Fisher-Yates swap loop is Python-sequential;
+            # above this size use numpy's C shuffle instead. Still
+            # deterministic per (seed, epoch) — but a fleet MIXING native
+            # and fallback hosts would see different permutations, so warn.
+            import warnings
+
+            warnings.warn(
+                f"corpus has {num_samples} samples; fallback shuffle switches "
+                "to numpy (not bit-identical to the native loader). Ensure "
+                "all hosts use the same implementation, or set "
+                "ACCELERATE_TPU_EXACT_SHUFFLE_MAX higher.",
+                stacklevel=2,
+            )
+            rng = np.random.default_rng((seed ^ (epoch * 0xD1B54A32D192ED03)) & 0xFFFFFFFF)
+            rng.shuffle(idx)
+        else:
+            draws = _splitmix64_draws(seed, epoch, num_samples - 1)
+            for k, i in enumerate(range(num_samples - 1, 0, -1)):
+                j = int(draws[k] % np.uint64(i + 1))
+                idx[i], idx[j] = idx[j], idx[i]
     per = -(-num_samples // world)
     take = (rank + np.arange(per, dtype=np.int64) * world) % num_samples
     return idx[take]
@@ -222,10 +243,13 @@ class TokenCorpusLoader:
         )
         # drop_last=False wraps the final batch with recycled rows; report
         # them like every other loader so gather_for_metrics can drop them
-        # (DataLoaderShard reads these at end of epoch). Every host has the
-        # same `per`, so the layout is uniform (hosts, batch, real).
+        # (DataLoaderShard reads these at end of epoch). Only exact when the
+        # host shards themselves are even (num_samples % world == 0) — with
+        # uneven shards the wrapped rows are cross-host duplicates that the
+        # uniform (hosts, batch, real) layout cannot identify.
         real_tail = per - self.batch_size * (self.num_batches - 1)
-        if not drop_last and 0 < real_tail < self.batch_size:
+        if (not drop_last and 0 < real_tail < self.batch_size
+                and self.num_samples % self.world == 0):
             self.remainder = real_tail * self.world
             self.tail_layout = (self.world, self.batch_size, real_tail)
         else:

@@ -24,9 +24,29 @@ def test_can_initialize():
     notebook_launcher(basic_function, (), num_processes=NUM_PROCESSES)
 
 
+def test_refuses_after_state_initialized():
+    """Multi-process launch must fail fast once the runtime is live in this
+    process (ref launchers.py:89-97 'CUDA already initialized' guard)."""
+    from accelerate_tpu.launchers import notebook_launcher
+    from accelerate_tpu.state import AcceleratorState, PartialState
+
+    PartialState()  # initialize the runtime in-process
+    assert AcceleratorState._shared_state or PartialState._shared_state
+    try:
+        notebook_launcher(basic_function, (), num_processes=2)
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError(
+            "notebook_launcher(num_processes=2) should refuse to start after "
+            "the state singleton is initialized"
+        )
+
+
 def main() -> None:
     print("Test basic notebook can be ran")
     test_can_initialize()
+    test_refuses_after_state_initialized()
     print("test_notebook: ALL CHECKS PASSED")
 
 

@@ -371,3 +371,13 @@ def test_split_mode_scalar_leaf_replicates():
     (got,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
     np.testing.assert_array_equal(got["x"], np.arange(4, 8, dtype=np.float32))
     assert float(got["w"]) == 0.5
+
+
+def test_split_mode_string_list_slices_by_row():
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32),
+                "text": ["a", "b", "c", "d"]}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert got0["text"] == ["a", "b"] and got1["text"] == ["c", "d"]
