@@ -9,9 +9,10 @@ tokens/sec/chip). Design:
 - `remat` option wraps the scanned body in `jax.checkpoint` (activation
   checkpointing — replaces FSDP plugin activation_checkpointing,
   ref utils/dataclasses.py:1105-1112).
-- attention backends: 'einsum' (XLA), 'flash' (pallas kernel,
-  ops/flash_attention.py), 'ring' (sequence-parallel over the mesh `seq`
-  axis, parallel/ring_attention.py).
+- attention backends: 'auto' (default — einsum up to 4k, pallas flash
+  beyond, on TPU), 'einsum' (XLA), 'flash' (ops/flash_attention.py), 'ring'
+  (sequence-parallel over the mesh `seq` axis, parallel/ring_attention.py),
+  'ulysses' (head-scatter all-to-all, parallel/ulysses.py).
 - naming matches sharding/rules.py so the planner yields Megatron-style
   TP + ZeRO layouts with no per-model code.
 """
@@ -50,7 +51,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
-    attention_backend: str = "einsum"  # einsum | flash | ring | ulysses
+    attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
     remat: bool = False
     remat_policy: str = "full"  # full | dots (save MXU outputs, recompute rest)
 
@@ -140,16 +141,26 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         causal = True
     k = repeat_kv(k, nh // nkv)
     v = repeat_kv(v, nh // nkv)
+    backend = config.attention_backend
+    if backend == "auto":
+        # the einsum path materializes [B,H,S,S] in HBM — fine to ~4k, then
+        # bandwidth-bound; the pallas flash kernel wins beyond that. Decode
+        # (kv_cache) and padded batches keep the mask-capable einsum path.
+        on_tpu = jax.devices()[0].platform == "tpu"
+        backend = (
+            "flash" if on_tpu and kv_cache is None and mask is None and s >= 4096
+            else "einsum"
+        )
     # flash/ring paths take no padding mask: use them only when there is none
-    if config.attention_backend == "flash" and kv_cache is None and mask is None:
+    if backend == "flash" and kv_cache is None and mask is None:
         from ..ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
-    elif config.attention_backend == "ring" and kv_cache is None and mask is None:
+    elif backend == "ring" and kv_cache is None and mask is None:
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=True)
-    elif config.attention_backend == "ulysses" and kv_cache is None and mask is None:
+    elif backend == "ulysses" and kv_cache is None and mask is None:
         from ..parallel.ulysses import ulysses_attention
 
         out = ulysses_attention(q, k, v, causal=True)

@@ -45,7 +45,7 @@ class MixtralConfig:
     rms_norm_eps: float = 1e-5
     router_aux_loss_coef: float = 0.02
     remat: bool = False
-    attention_backend: str = "einsum"
+    attention_backend: str = "auto"
 
     @property
     def head_dim(self) -> int:

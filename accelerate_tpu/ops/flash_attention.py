@@ -10,9 +10,10 @@ Forward is a pallas kernel with grid [batch*heads, q_blocks, k_blocks]
 K/V into VMEM — VMEM use is O(block), not O(S), so 32k+ contexts fit — and
 carries the online-softmax state (running max / sum / accumulator) in VMEM
 scratch across the k dimension. Causal variant no-ops fully masked k blocks
-via `pl.when`. Backward is a custom_vjp that recomputes attention with the
-XLA einsum path — correct everywhere, O(S^2) only in the backward; a pallas
-backward kernel is a planned optimization.
+via `pl.when`. Backward is fused too (FlashAttention-2): the forward saves
+only O and the per-row logsumexp; a dQ kernel (k innermost) and a dK/dV
+kernel (q innermost) recompute the probability blocks on the fly, so both
+directions are O(S) memory — no S x S score matrix anywhere.
 
 On non-TPU backends the kernel runs in pallas interpret mode (slow, for
 tests); prefer `dot_product_attention` there.
@@ -32,9 +33,13 @@ NEG_INF = -1e30
 _LANES = 128  # TPU vector lane width; scalar-per-row state is kept 2D
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, sm_scale: float, block_q: int, block_k: int,
-                  num_k_blocks: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
+                  sm_scale: float, block_q: int, block_k: int,
+                  num_k_blocks: int, with_lse: bool = False):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -74,11 +79,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         l = l_scr[...][:, :1]
         o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp per row, lane-broadcast (the TPU-friendly layout the
+            # backward kernels read without transposes)
+            lse = m_scr[...][:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
-    """q,k,v: [BH, S, D] -> [BH, S, D]."""
+                   interpret: bool, save_residuals: bool = False):
+    """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, LANES] if asked)."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     sm_scale = 1.0 / math.sqrt(d)
@@ -87,17 +97,23 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
+        with_lse=save_residuals,
     )
-    return pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
+    if save_residuals:
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -108,19 +124,159 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
         ),
         interpret=interpret,
     )(q, k, v)
+    if save_residuals:
+        return res[0], res[1]
+    return res[0]
 
 
-def _reference_attention(q, k, v, causal):
-    """XLA einsum attention on [BH, S, D] (backward recompute path)."""
-    d = q.shape[-1]
-    s = jnp.einsum("bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32)
-    s = s / math.sqrt(d)
-    if causal:
-        sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((sq, sk), jnp.bool_), k=sk - sq)
-        s = jnp.where(mask[None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bqk,bkd->bqd", p, v, preferred_element_type=jnp.float32).astype(q.dtype)
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_scr, *, causal: bool, sm_scale: float, block_q: int,
+                     block_k: int, num_k_blocks: int):
+    """FlashAttention-2 backward, dQ pass: grid [BH, q_blocks, k_blocks]."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]      # [bq, 1]
+        delta = delta_ref[0][:, :1]  # [bq, 1]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq, bk]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_scr[...] += sm_scale * jnp.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                      sm_scale: float, block_q: int, block_k: int,
+                      num_q_blocks: int):
+    """FlashAttention-2 backward, dK/dV pass: grid [BH, k_blocks, q_blocks]."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [bq, bk]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # contract over the q dim without materializing transposes
+        # (dot_general; MXU takes either operand order)
+        contract_q = (((0,), (0,)), ((), ()))
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, contract_q, preferred_element_type=jnp.float32)
+        dk_scr[...] += sm_scale * jax.lax.dot_general(
+            ds, q, contract_q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    """Fused O(S) backward: no S x S materialization.
+
+    `lse` arrives compact ([BH, S]); it and delta are lane-broadcast to
+    [BH, S, LANES] only here — transient buffers inside the backward, not
+    saved residuals (the kernels read per-row state without relayouts this
+    way, matching jax's official TPU flash kernels)."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    sm_scale = 1.0 / math.sqrt(d)
+    num_q_blocks = seq_q // block_q
+    num_k_blocks = seq_k // block_k
+    lse = jnp.broadcast_to(lse[..., None], (bh, seq_q, _LANES))
+    # delta_i = rowsum(dO * O)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, seq_q, _LANES))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    kq_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        grid=(bh, num_q_blocks, num_k_blocks),
+        in_specs=[q_spec, kq_spec, kq_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dK/dV pass: k blocks outer (parallel), q blocks inner (reduction)
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0))
+    k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        grid=(bh, num_k_blocks, num_q_blocks),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        out_specs=[k_spec2, k_spec2],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -129,13 +285,17 @@ def _flash(q, k, v, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                            save_residuals=True)
+    # keep only one lane of the broadcast LSE as the saved residual
+    # ([BH, S] f32, not [BH, S, 128]) — re-broadcast transiently in bwd
+    return o, (q, k, v, o, lse[..., 0])
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _reference_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -150,13 +310,30 @@ def flash_attention(
     block_k: int = 128,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """[B, S, H, D] flash attention. Heads must already be repeated (GQA:
-    call models.common.repeat_kv first). Sequence lengths must divide the
-    block sizes; shorter sequences fall back to einsum attention."""
+    """[B, S, H, D] flash attention, fused forward AND backward. Heads must
+    already be repeated (GQA: call models.common.repeat_kv first). Causal
+    self-attention runs the kernel at any length above one block (shorter or
+    non-block-multiple lengths are padded to a block multiple — causally
+    exact — or fall back to einsum attention below one block; non-causal /
+    cross-attention requires block-multiple lengths)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if causal and sq == sk and (sq % block_q or sk % block_k) and sq > block_q:
+        # pad to a block multiple and slice the result: causally exact, since
+        # padded keys (index >= sq) are only visible to padded queries — the
+        # training loss slices inputs to S-1, which would otherwise dodge the
+        # kernel entirely
+        multiple = math.lcm(block_q, block_k)
+        target = -(-sq // multiple) * multiple
+        pad = target - sq
+        qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        out = flash_attention(qp, kp, vp, causal=True, block_q=block_q,
+                              block_k=block_k, interpret=interpret)
+        return out[:, :sq]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     # sq != sk would make the kernel's top-aligned causal mask disagree with

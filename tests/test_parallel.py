@@ -219,3 +219,28 @@ def test_flash_attention_cross_attention_falls_back():
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_padded_irregular_causal():
+    """Lengths above one block that don't divide it run the kernel via
+    pad+slice (causally exact), not the einsum fallback — the training loss
+    slices inputs to S-1 and would otherwise never hit the kernel."""
+    q, k, v = make_qkv(jax.random.key(7), s=161, d=32)
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_padded_gradients():
+    q, k, v = make_qkv(jax.random.key(8), s=130, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64, block_k=64) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
