@@ -174,6 +174,11 @@ def tpu_pod_launcher(args: argparse.Namespace, dry_run: bool = False) -> int:
 
 def launch_command(args: argparse.Namespace) -> int:
     args = _merge_config(args)
+    if getattr(args, "debug", None):
+        # pretty tracebacks in the launcher process (ref launch.py:729-733)
+        from ..utils.rich import install_pretty_traceback
+
+        install_pretty_traceback()
     if args.tpu_name:
         return tpu_pod_launcher(args)
     if args.num_processes and args.num_processes > 1:

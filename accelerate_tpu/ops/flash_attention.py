@@ -31,6 +31,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 _LANES = 128  # TPU vector lane width; scalar-per-row state is kept 2D
+_SUB = 8      # minimal lane width Mosaic accepts for a full-dim block: the
+              # LSE rides as [BH, S, 8] (16x smaller than lane-broadcast)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
@@ -88,7 +90,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                    interpret: bool, save_residuals: bool = False):
-    """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, LANES] if asked)."""
+    """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, 8] if asked)."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     sm_scale = 1.0 / math.sqrt(d)
@@ -102,8 +104,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
     if save_residuals:
-        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q, _LANES), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq_q, _SUB), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, _SUB), lambda b, i, j: (b, i, 0)))
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -129,7 +131,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     return res[0]
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                      dq_scr, *, causal: bool, sm_scale: float, block_q: int,
                      block_k: int, num_k_blocks: int):
     """FlashAttention-2 backward, dQ pass: grid [BH, q_blocks, k_blocks]."""
@@ -147,8 +149,11 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, :1]      # [bq, 1]
-        delta = delta_ref[0][:, :1]  # [bq, 1]
+        # per-row state: lse block is (1, bq, 8) -> column [bq, 1]; delta
+        # recomputed from O/dO blocks (cheap elementwise, no HBM buffer)
+        lse = lse_ref[0][:, :1]
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -167,7 +172,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                       dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
                       sm_scale: float, block_q: int, block_k: int,
                       num_q_blocks: int):
@@ -188,7 +193,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -217,22 +223,20 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
                     block_k: int, interpret: bool):
     """Fused O(S) backward: no S x S materialization.
 
-    `lse` arrives compact ([BH, S]); it and delta are lane-broadcast to
-    [BH, S, LANES] only here — transient buffers inside the backward, not
-    saved residuals (the kernels read per-row state without relayouts this
-    way, matching jax's official TPU flash kernels)."""
+    Per-row state stays near-compact: the saved residual is [BH, S] f32,
+    re-broadcast transiently to [BH, S, 8] here (Mosaic's narrowest legal
+    full-dim lane block); delta is recomputed inside the kernels from the
+    O/dO blocks — no [BH, S, LANES] buffers in HBM."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     sm_scale = 1.0 / math.sqrt(d)
     num_q_blocks = seq_q // block_q
     num_k_blocks = seq_k // block_k
-    lse = jnp.broadcast_to(lse[..., None], (bh, seq_q, _LANES))
-    # delta_i = rowsum(dO * O)
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (bh, seq_q, _LANES))
+
+    lse = jnp.broadcast_to(lse[..., None], (bh, seq_q, _SUB))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, _SUB), lambda b, i, j: (b, i, 0))
     kq_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
 
     dq = pl.pallas_call(
@@ -242,18 +246,18 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=(bh, num_q_blocks, num_k_blocks),
-        in_specs=[q_spec, kq_spec, kq_spec, q_spec, row_spec, row_spec],
+        in_specs=[q_spec, kq_spec, kq_spec, q_spec, q_spec, row_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, o, do, lse)
 
     # dK/dV pass: k blocks outer (parallel), q blocks inner (reduction)
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    row_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0))
+    row_spec2 = pl.BlockSpec((1, block_q, _SUB), lambda b, j, i: (b, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -265,7 +269,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
         ],
         grid=(bh, num_k_blocks, num_q_blocks),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, row_spec2, row_spec2],
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, row_spec2],
         out_specs=[k_spec2, k_spec2],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -275,7 +279,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, o, do, lse)
     return dq, dk, dv
 
 

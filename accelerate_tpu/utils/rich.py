@@ -1,0 +1,20 @@
+"""Rich console helpers (ref src/accelerate/utils/rich.py).
+
+`accelerate-tpu launch --debug` installs pretty tracebacks when `rich` is
+importable (ref commands/launch.py:729-733); everything degrades to plain
+tracebacks without it.
+"""
+
+from __future__ import annotations
+
+from .imports import is_rich_available
+
+
+def install_pretty_traceback() -> bool:
+    """Install rich tracebacks process-wide; returns whether it happened."""
+    if not is_rich_available():
+        return False
+    from rich.traceback import install
+
+    install(show_locals=False)
+    return True
