@@ -801,9 +801,16 @@ class Accelerator:
 
     def end_training(self) -> None:
         """ref :2653."""
-        for tracker in self.trackers:
-            tracker.finish()
-        self.wait_for_everyone()
+        from .checkpointing import wait_for_checkpoints
+
+        try:
+            wait_for_checkpoints()
+        finally:
+            # a failed background checkpoint must not leave trackers open or
+            # peers hanging at the barrier
+            for tracker in self.trackers:
+                tracker.finish()
+            self.wait_for_everyone()
 
     # --------------------------------------------------------- checkpoints
     def register_for_checkpointing(self, *objects) -> None:
@@ -833,8 +840,10 @@ class Accelerator:
         return handle
 
     def save_state(self, output_dir: str | None = None, state: TrainState | None = None,
-                   **save_model_kwargs) -> str:
-        """ref :2830-2994 + checkpointing.py:51."""
+                   async_save: bool = False, **save_model_kwargs) -> str:
+        """ref :2830-2994 + checkpointing.py:51. `async_save=True` overlaps
+        the array writes with subsequent steps (drain with
+        `wait_for_checkpoints()`; `load_state`/`end_training` drain too)."""
         from .checkpointing import save_accelerator_state
 
         if output_dir is None:
@@ -849,7 +858,14 @@ class Accelerator:
             dataloaders=self._dataloaders,
             custom_objects=self._custom_objects,
             step=self.step,
+            async_save=async_save,
         )
+
+    def wait_for_checkpoints(self) -> int:
+        """Drain in-flight async checkpoint saves."""
+        from .checkpointing import wait_for_checkpoints
+
+        return wait_for_checkpoints()
 
     def load_state(self, input_dir: str | None = None, state: TrainState | None = None,
                    **load_model_kwargs):

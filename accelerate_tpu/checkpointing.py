@@ -53,10 +53,66 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def _save_pytree(tree: Any, path: str) -> None:
+# ONE shared AsyncCheckpointer: orbax serializes saves on it (each save()
+# first waits out the previous one), so at most one write is in flight,
+# back-to-back saves to the same directory can't race, and host RAM holds at
+# most one extra staged copy.
+_async_state: dict = {"ckptr": None, "inflight": 0}
+
+
+def _get_async_checkpointer():
+    if _async_state["ckptr"] is None:
+        import atexit
+
+        import orbax.checkpoint as ocp
+
+        _async_state["ckptr"] = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        atexit.register(_close_async_checkpointer)
+    return _async_state["ckptr"]
+
+
+def _close_async_checkpointer() -> None:
+    ckptr = _async_state["ckptr"]
+    _async_state["ckptr"] = None
+    _async_state["inflight"] = 0
+    if ckptr is not None:
+        try:
+            ckptr.close()
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
+
+
+def _save_pytree(tree: Any, path: str, async_save: bool = False) -> None:
+    if async_save:
+        import orbax.checkpoint as ocp
+
+        ckptr = _get_async_checkpointer()
+        ckptr.save(_abspath(path), args=ocp.args.StandardSave(tree), force=True)
+        _async_state["inflight"] += 1
+        return
     ckptr = _checkpointer()
     ckptr.save(_abspath(path), tree, force=True)
     ckptr.wait_until_finished()
+
+
+def wait_for_checkpoints() -> int:
+    """Block until every in-flight async save has committed (the
+    tensorstore-style async checkpoint of SURVEY.md §5 — training steps
+    overlap the device->disk write). Returns how many were drained. A failed
+    background write re-raises here after the checkpointer is torn down, so
+    later saves start from a clean slate."""
+    ckptr = _async_state["ckptr"]
+    drained = _async_state["inflight"]
+    if ckptr is None or drained == 0:
+        _async_state["inflight"] = 0
+        return 0
+    try:
+        ckptr.wait_until_finished()
+    except Exception:
+        _close_async_checkpointer()
+        raise
+    _async_state["inflight"] = 0
+    return drained
 
 
 def _abstract_like(tree: Any) -> Any:
@@ -107,14 +163,19 @@ def save_accelerator_state(
     dataloaders: list = (),
     custom_objects: list = (),
     step: int = 0,
+    async_save: bool = False,
 ) -> str:
-    """ref checkpointing.py:51 `save_accelerator_state`."""
+    """ref checkpointing.py:51 `save_accelerator_state`. With
+    `async_save=True` array writes overlap subsequent training steps; call
+    `wait_for_checkpoints()` (or `load`) before relying on the files."""
     state = PartialState()
     output_dir = _abspath(output_dir)
     os.makedirs(output_dir, exist_ok=True)
 
     for i, ts in enumerate(train_states):
-        _save_pytree(_train_state_payload(ts), os.path.join(output_dir, f"{MODEL_NAME}_{i}"))
+        _save_pytree(_train_state_payload(ts),
+                     os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
+                     async_save=async_save)
     for i, opt in enumerate(optimizers):
         payload = {}
         if opt.opt_state is not None:
@@ -125,7 +186,8 @@ def save_accelerator_state(
             # optimizer.bin, checkpointing.py:51-133)
             payload["params"] = opt.params
         if payload:
-            _save_pytree(payload, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"))
+            _save_pytree(payload, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"),
+                         async_save=async_save)
 
     if state.is_main_process:
         for i, sched in enumerate(schedulers):
@@ -175,6 +237,10 @@ def load_accelerator_state(
     their current shardings (resharding to a different mesh works: orbax
     reads only the shards each host needs)."""
     state = PartialState()
+    # a load must see fully committed async saves from EVERY host: drain the
+    # local writes, then barrier so no host reads before the slowest commit
+    wait_for_checkpoints()
+    state.wait_for_everyone()
     input_dir = _abspath(input_dir)
     out: dict[str, Any] = {"train_states": [], "step": 0}
 
