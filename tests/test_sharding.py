@@ -127,3 +127,48 @@ def test_expert_rules():
     params = {"moe": {"experts": {"up_proj": {"kernel": jnp.zeros((4, 64, 128))}}}}
     plan = plan_sharding(params, mesh)
     assert plan["moe"]["experts"]["up_proj"]["kernel"].spec == P("expert", None, "model")
+
+
+def test_mesh_split_dcn_factoring():
+    """Multi-slice: the slice count factors out of the outermost axes."""
+    from accelerate_tpu.utils import MeshConfig
+
+    split = MeshConfig._split_dcn
+    assert split({"data": 4, "model": 2}, 2) == ((2, 1), (2, 2))
+    assert split({"data": 2, "fsdp": 4, "model": 2}, 2) == ((2, 1, 1), (1, 4, 2))
+    # slice count spanning two axes: data=2 entirely DCN, fsdp contributes 2
+    assert split({"data": 2, "fsdp": 4, "model": 2}, 4) == ((2, 2, 1), (1, 2, 2))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cannot factor"):
+        split({"data": 3, "model": 2}, 2)
+
+
+def test_hybrid_mesh_requested_for_multislice(monkeypatch):
+    """A device set spanning slices routes through create_hybrid_device_mesh
+    with the factored dcn/ici shapes."""
+    import numpy as np
+
+    from accelerate_tpu.utils import MeshConfig
+    from jax.experimental import mesh_utils
+    import jax
+
+    class FakeDev:
+        def __init__(self, d, si):
+            self._d = d
+            self.slice_index = si
+            self.platform = d.platform
+
+    devices = [FakeDev(d, i % 2) for i, d in enumerate(jax.devices())]
+    captured = {}
+
+    def fake_hybrid(ici_shape, dcn_mesh_shape=None, devices=None, **kw):
+        captured["ici"] = tuple(ici_shape)
+        captured["dcn"] = tuple(dcn_mesh_shape)
+        return np.asarray(devices).reshape(tuple(
+            d * i for d, i in zip(dcn_mesh_shape, ici_shape)))
+
+    monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh", fake_hybrid)
+    mesh = MeshConfig(axes={"data": 2, "model": 4}).build(devices)
+    assert captured == {"dcn": (2, 1), "ici": (1, 4)}
+    assert dict(mesh.shape) == {"data": 2, "model": 4}
