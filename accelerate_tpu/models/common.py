@@ -119,17 +119,25 @@ def init_dense(key, d_in: int, d_out: int, stddev: float = 0.02, bias: bool = Fa
     return params
 
 
-def cross_entropy_loss(
-    logits: jax.Array, labels: jax.Array, mask: jax.Array | None = None,
-    label_smoothing: float = 0.0,
-) -> jax.Array:
-    """Mean token cross-entropy in fp32 (stable under bf16 logits)."""
+def token_nll(logits: jax.Array, labels: jax.Array,
+              label_smoothing: float = 0.0) -> jax.Array:
+    """Per-token negative log-likelihood in fp32 (stable under bf16 logits).
+    Shared by the full and chunked loss paths."""
     logits = logits.astype(jnp.float32)
     log_probs = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
     if label_smoothing > 0:
         smooth = -jnp.mean(log_probs, axis=-1)
         nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+    return nll
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array | None = None,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean token cross-entropy in fp32."""
+    nll = token_nll(logits, labels, label_smoothing)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     return jnp.mean(nll)

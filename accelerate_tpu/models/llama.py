@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from .common import (
     apply_rope,
     cross_entropy_loss,
+    token_nll,
     dense,
     dot_product_attention,
     init_dense,
@@ -196,8 +197,14 @@ def forward(
     attention_mask: jax.Array | None = None,
     positions: jax.Array | None = None,
     kv_caches: Any = None,
+    return_hidden: bool = False,
 ) -> jax.Array | tuple:
-    """Logits [B, S, V]; with kv_caches, returns (logits, new_caches)."""
+    """Logits [B, S, V]; with kv_caches, returns (logits, new_caches);
+    with `return_hidden`, the final normed hidden states [B, S, H] instead
+    of logits (the chunked-loss path projects them itself)."""
+    if return_hidden and kv_caches is not None:
+        raise ValueError("return_hidden is not supported on the decode "
+                         "(kv_caches) path")
     x = params["embed_tokens"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(
@@ -243,6 +250,8 @@ def forward(
         scan_body = jax.checkpoint(scan_body, prevent_cse=False, policy=policy)
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+    if return_hidden:
+        return x
     return _project_out(config, params, x)
 
 
@@ -295,15 +304,68 @@ def _project_out(config: LlamaConfig, params: dict, x):
     )
 
 
-def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict) -> jax.Array:
-    """Next-token loss over a batch {input_ids, attention_mask?}."""
+def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
+                   loss_chunk_size: int | None = None) -> jax.Array:
+    """Next-token loss over a batch {input_ids, attention_mask?}.
+
+    Large vocab x long sequence makes the [B, S, V] f32 logits the single
+    biggest buffer of the step (e.g. 16 x 2048 x 32000 f32 = 4.2 GB). When
+    S divides into `loss_chunk_size` chunks (auto-picked so a chunk's logits
+    stay ~256 MB), the projection + cross-entropy run under `lax.scan` per
+    chunk and the full logits never exist."""
     input_ids = batch["input_ids"]
-    logits = forward(config, params, input_ids[:, :-1],
-                     attention_mask=None)
     labels = input_ids[:, 1:]
     mask = batch.get("attention_mask")
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
-    return cross_entropy_loss(logits, labels, mask)
+    B, S = labels.shape
+
+    if loss_chunk_size is None:
+        budget = 256 * 2**20 // 4  # f32 elements per chunk of logits
+        loss_chunk_size = max(128, budget // max(1, B * config.vocab_size))
+    chunk = _pick_chunk(S, loss_chunk_size)
+    if chunk is None or chunk >= S:
+        logits = forward(config, params, input_ids[:, :-1], attention_mask=None)
+        return cross_entropy_loss(logits, labels, mask)
+
+    hidden = forward(config, params, input_ids[:, :-1], attention_mask=None,
+                     return_hidden=True)
+    n = S // chunk
+    h_chunks = hidden.reshape(B, n, chunk, -1).transpose(1, 0, 2, 3)
+    l_chunks = labels.reshape(B, n, chunk).transpose(1, 0, 2)
+    m_chunks = (
+        mask.reshape(B, n, chunk).transpose(1, 0, 2)
+        if mask is not None else jnp.ones((n, B, chunk), jnp.float32)
+    )
+
+    def body(carry, xs):
+        h, l, m = xs
+        nll = token_nll(_project_out(config, params, h), l)
+        loss_sum, count = carry
+        return (loss_sum + jnp.sum(nll * m), count + jnp.sum(m)), None
+
+    # checkpoint the chunk body: otherwise scan's backward saves every
+    # chunk's logits and the full [B,S,V] buffer is back
+    body = jax.checkpoint(body, prevent_cse=False)
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (h_chunks, l_chunks, m_chunks)
+    )
+    return loss_sum / jnp.maximum(count, 1)
+
+
+def _pick_chunk(S: int, target: int) -> int | None:
+    """Largest divisor of S that is <= target; None when chunking is not
+    worthwhile (S already small, or — e.g. prime S — the best divisor is so
+    small the scan would degenerate into per-token matmuls)."""
+    if S <= target:
+        return None
+    best = None
+    for c in range(min(target, S - 1), 0, -1):
+        if S % c == 0:
+            best = c
+            break
+    if best is None or best < max(16, target // 8):
+        return None
+    return best
 
 
 def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16):

@@ -288,16 +288,17 @@ class MeshConfig:
                     ici.append(1)
                     remaining //= s
                     continue
-                raise ValueError(
-                    f"cannot factor {num_slices} slices out of mesh axes "
-                    f"{axes}: make the outer (data/fsdp) axes a multiple of "
-                    "the slice count"
-                )
+                # this axis can't absorb slices — keep it on ICI and let a
+                # later axis try
+                dcn.append(1)
+                ici.append(s)
+                continue
             dcn.append(1)
             ici.append(s)
         if remaining != 1:
             raise ValueError(
-                f"cannot factor {num_slices} slices out of mesh axes {axes}"
+                f"cannot factor {num_slices} slices out of mesh axes {axes}: "
+                "make an outer (data/fsdp) axis a multiple of the slice count"
             )
         return tuple(dcn), tuple(ici)
 

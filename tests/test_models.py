@@ -163,3 +163,29 @@ def test_mixtral_trains_expert_parallel():
     for _ in range(10):
         ts, m = step(ts, batch)
     assert float(m["loss"]) < l0
+
+
+def test_chunked_causal_lm_loss_matches_full():
+    """Chunked projection+xent == full-logits loss, values and gradients."""
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(max_position_embeddings=64)
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 49)).astype(np.int32)  # S=48
+    mask = np.ones((2, 49), np.int32)
+    mask[0, 30:] = 0
+    batch = {"input_ids": ids, "attention_mask": mask}
+
+    full = llama.causal_lm_loss(cfg, params, batch, loss_chunk_size=10_000)
+    chunked = llama.causal_lm_loss(cfg, params, batch, loss_chunk_size=16)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
+
+    g_full = jax.grad(lambda p: llama.causal_lm_loss(cfg, p, batch,
+                                                     loss_chunk_size=10_000))(params)
+    g_chunk = jax.grad(lambda p: llama.causal_lm_loss(cfg, p, batch,
+                                                      loss_chunk_size=16))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_chunk),
+                    jax.tree_util.tree_leaves(g_full)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)

@@ -138,10 +138,12 @@ def test_mesh_split_dcn_factoring():
     assert split({"data": 2, "fsdp": 4, "model": 2}, 2) == ((2, 1, 1), (1, 4, 2))
     # slice count spanning two axes: data=2 entirely DCN, fsdp contributes 2
     assert split({"data": 2, "fsdp": 4, "model": 2}, 4) == ((2, 2, 1), (1, 2, 2))
+    # an unfactorable outer axis is skipped; a later axis absorbs the slices
+    assert split({"data": 3, "model": 2}, 2) == ((1, 2), (3, 1))
     import pytest as _pytest
 
     with _pytest.raises(ValueError, match="cannot factor"):
-        split({"data": 3, "model": 2}, 2)
+        split({"data": 3, "model": 3}, 2)
 
 
 def test_hybrid_mesh_requested_for_multislice(monkeypatch):
@@ -172,3 +174,11 @@ def test_hybrid_mesh_requested_for_multislice(monkeypatch):
     mesh = MeshConfig(axes={"data": 2, "model": 4}).build(devices)
     assert captured == {"dcn": (2, 1), "ici": (1, 4)}
     assert dict(mesh.shape) == {"data": 2, "model": 4}
+
+
+def test_mesh_split_dcn_size_one_axis():
+    from accelerate_tpu.utils import MeshConfig
+
+    assert MeshConfig._split_dcn({"data": 1, "fsdp": 4, "model": 2}, 2) == (
+        (1, 2, 1), (1, 2, 2)
+    )
