@@ -321,7 +321,7 @@ def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
 
     if loss_chunk_size is None:
         budget = 256 * 2**20 // 4  # f32 elements per chunk of logits
-        loss_chunk_size = max(128, budget // max(1, B * config.vocab_size))
+        loss_chunk_size = max(1, budget // max(1, B * config.vocab_size))
     chunk = _pick_chunk(S, loss_chunk_size)
     if chunk is None or chunk >= S:
         logits = forward(config, params, input_ids[:, :-1], attention_mask=None)
@@ -363,7 +363,10 @@ def _pick_chunk(S: int, target: int) -> int | None:
         if S % c == 0:
             best = c
             break
-    if best is None or best < max(16, target // 8):
+    # a divisor far below the target (prime-ish S) degenerates the scan into
+    # per-token matmuls — prefer the full path then. When the memory budget
+    # itself demands tiny chunks, honor them: slow beats OOM.
+    if best is None or best < max(1, target // 8):
         return None
     return best
 

@@ -12,6 +12,7 @@ variant lives in parallel/moe.py).
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 
 import jax
@@ -46,6 +47,8 @@ class MixtralConfig:
     router_aux_loss_coef: float = 0.02
     remat: bool = False
     attention_backend: str = "auto"
+    moe_impl: str = "dense"        # dense (exact) | sparse (capacity dispatch)
+    capacity_factor: float = 1.25  # sparse mode: C = ceil(k*S/E * factor)
 
     @property
     def head_dim(self) -> int:
@@ -116,14 +119,8 @@ def init_params(config: MixtralConfig, key: jax.Array, dtype=jnp.float32) -> dic
     }
 
 
-def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Top-k routed expert MLP via dense one-hot dispatch.
-
-    Returns (output, router_aux_loss). The [B,S,E] combine weights contract
-    against expert-stacked weights with einsum — when `experts` shard on the
-    expert axis GSPMD lowers this to a2a dispatch/combine.
-    """
-    b, s, h = x.shape
+def _route(config: MixtralConfig, moe: dict, x: jax.Array):
+    """Shared router: returns (probs [B,S,E], topk_probs, topk_idx, aux)."""
     E, k = config.num_local_experts, config.num_experts_per_tok
     router_logits = jnp.einsum(
         "bsh,he->bse", x, moe["router"]["kernel"], preferred_element_type=jnp.float32
@@ -131,12 +128,40 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array
     probs = jax.nn.softmax(router_logits, axis=-1)
     topk_probs, topk_idx = jax.lax.top_k(probs, k)
     topk_probs = topk_probs / jnp.sum(topk_probs, axis=-1, keepdims=True)
+    # load-balancing aux loss (Switch-style)
+    token_frac = jnp.mean(
+        jax.nn.one_hot(topk_idx, E, dtype=jnp.float32).sum(axis=2), axis=(0, 1)
+    ) / k
+    prob_frac = jnp.mean(probs, axis=(0, 1))
+    aux = E * jnp.sum(token_frac * prob_frac)
+    return probs, topk_probs, topk_idx, aux
+
+
+def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Top-k routed expert MLP. Returns (output, router_aux_loss).
+
+    Two implementations, selected by `config.moe_impl`:
+    - "dense": every expert processes every token; the [B,S,E] combine
+      weights zero out non-routed contributions. Exact (drops nothing) but
+      spends E/k times the needed MLP FLOPs — right for tiny models and for
+      expert-axis sharding where GSPMD lowers the einsums to all-to-alls.
+    - "sparse": GShard/Switch-style capacity dispatch — each expert
+      processes at most C = ceil(k*S/E * capacity_factor) tokens, gathered
+      with a [B,S,E,C] one-hot. MLP FLOPs drop from E to ~k*capacity_factor
+      per token; tokens over capacity fall through on the residual path
+      (standard MoE-training behavior under load imbalance).
+    """
+    if config.moe_impl == "sparse":
+        return moe_block_sparse(config, moe, x)
+    if config.moe_impl != "dense":
+        raise ValueError(f"unknown moe_impl {config.moe_impl!r}; use 'dense' or 'sparse'")
+    E = config.num_local_experts
+    probs, topk_probs, topk_idx, aux = _route(config, moe, x)
     # combine weights [B,S,E]
     combine = jnp.sum(
         jax.nn.one_hot(topk_idx, E, dtype=x.dtype) * topk_probs[..., None].astype(x.dtype),
         axis=2,
     )
-    # every expert processes every token (dense); combine selects
     gate = jax.nn.silu(jnp.einsum("bsh,ehf->besf", x, moe["experts"]["gate_proj"]["kernel"],
                                   preferred_element_type=jnp.float32).astype(x.dtype))
     up = jnp.einsum("bsh,ehf->besf", x, moe["experts"]["up_proj"]["kernel"],
@@ -144,12 +169,44 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array
     expert_out = jnp.einsum("besf,efh->besh", gate * up, moe["experts"]["down_proj"]["kernel"],
                             preferred_element_type=jnp.float32).astype(x.dtype)
     out = jnp.einsum("besh,bse->bsh", expert_out, combine)
-    # load-balancing aux loss (Switch-style)
-    token_frac = jnp.mean(
-        jax.nn.one_hot(topk_idx, E, dtype=jnp.float32).sum(axis=2), axis=(0, 1)
-    ) / k
-    prob_frac = jnp.mean(probs, axis=(0, 1))
-    aux = E * jnp.sum(token_frac * prob_frac)
+    return out, aux
+
+
+def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Capacity-bounded dispatch (GShard): experts compute C tokens, not S."""
+    b, s, h = x.shape
+    E, k = config.num_local_experts, config.num_experts_per_tok
+    cap = int(math.ceil(k * s / E * config.capacity_factor))
+    cap = min(cap, s * k)
+    probs, topk_probs, topk_idx, aux = _route(config, moe, x)
+
+    # slot of token (s, choice j) within its expert's capacity buffer:
+    # cumulative count of prior assignments to that expert in this batch row.
+    # Flatten the k choices into the sequence order so slots are unique.
+    flat_idx = topk_idx.reshape(b, s * k)                      # [B, S*k]
+    flat_prob = topk_probs.reshape(b, s * k).astype(jnp.float32)
+    onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)      # [B, S*k, E]
+    slot = jnp.cumsum(onehot, axis=1) * onehot - 1             # [B, S*k, E]
+    slot = jnp.max(slot, axis=-1)                              # [B, S*k]
+    keep = slot < cap
+    # dispatch/combine one-hots [B, S*k, E, C]
+    d = (
+        jax.nn.one_hot(flat_idx, E, dtype=x.dtype)[..., None]
+        * jax.nn.one_hot(jnp.where(keep, slot, cap), cap + 1, dtype=x.dtype)[..., None, :]
+    )[..., :cap]                                               # dropped -> all-zero
+    x_rep = jnp.repeat(x, k, axis=1)                           # [B, S*k, H]
+    expert_in = jnp.einsum("btec,bth->bech", d, x_rep)         # gather
+    gate = jax.nn.silu(jnp.einsum(
+        "bech,ehf->becf", expert_in, moe["experts"]["gate_proj"]["kernel"],
+        preferred_element_type=jnp.float32).astype(x.dtype))
+    up = jnp.einsum("bech,ehf->becf", expert_in, moe["experts"]["up_proj"]["kernel"],
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "becf,efh->bech", gate * up, moe["experts"]["down_proj"]["kernel"],
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    combine = d * flat_prob[..., None, None].astype(x.dtype)   # [B, S*k, E, C]
+    out_flat = jnp.einsum("btec,bech->bth", combine, expert_out)  # [B, S*k, H]
+    out = out_flat.reshape(b, s, k, h).sum(axis=2)
     return out, aux
 
 

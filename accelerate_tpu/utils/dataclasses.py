@@ -271,28 +271,23 @@ class MeshConfig:
         with the axis order."""
         dcn, ici = [], []
         remaining = num_slices
+        # only bandwidth-tolerant axes may span DCN: per-layer tp/sp/ep
+        # collectives over the slow inter-slice network would crater
+        # throughput silently
+        absorbers = (AXIS_DATA, AXIS_FSDP, AXIS_STAGE)
         for a, s in axes.items():
-            if remaining > 1:
-                if s == 1:  # size-1 axis can't absorb slices; skip it
-                    dcn.append(1)
-                    ici.append(1)
-                    continue
+            if remaining > 1 and a in absorbers and s > 1:
                 if s % remaining == 0:
                     dcn.append(remaining)
                     ici.append(s // remaining)
                     remaining = 1
                     continue
-                if remaining % s == 0 and s > 1:
+                if remaining % s == 0:
                     # this whole axis spans DCN; keep factoring
                     dcn.append(s)
                     ici.append(1)
                     remaining //= s
                     continue
-                # this axis can't absorb slices — keep it on ICI and let a
-                # later axis try
-                dcn.append(1)
-                ici.append(s)
-                continue
             dcn.append(1)
             ici.append(s)
         if remaining != 1:

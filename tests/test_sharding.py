@@ -138,12 +138,14 @@ def test_mesh_split_dcn_factoring():
     assert split({"data": 2, "fsdp": 4, "model": 2}, 2) == ((2, 1, 1), (1, 4, 2))
     # slice count spanning two axes: data=2 entirely DCN, fsdp contributes 2
     assert split({"data": 2, "fsdp": 4, "model": 2}, 4) == ((2, 2, 1), (1, 2, 2))
-    # an unfactorable outer axis is skipped; a later axis absorbs the slices
-    assert split({"data": 3, "model": 2}, 2) == ((1, 2), (3, 1))
+    # an unfactorable data axis is skipped; fsdp absorbs the slices
+    assert split({"data": 3, "fsdp": 2, "model": 2}, 2) == ((1, 2, 1), (3, 1, 2))
     import pytest as _pytest
 
+    # the model (tensor-parallel) axis must NOT absorb slices: per-layer
+    # collectives over DCN would silently crater throughput
     with _pytest.raises(ValueError, match="cannot factor"):
-        split({"data": 3, "model": 3}, 2)
+        split({"data": 3, "model": 2}, 2)
 
 
 def test_hybrid_mesh_requested_for_multislice(monkeypatch):
