@@ -49,8 +49,34 @@ def layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-
 # --- rotary embeddings ------------------------------------------------------
 
 
-def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0) -> tuple:
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10000.0,
+                     scaling: dict | None = None) -> tuple:
+    """Rotary cos/sin tables, optionally frequency-scaled.
+
+    `scaling` follows the HF `rope_scaling` dict: `rope_type` of
+    - "linear": positions stretched by `factor` (position interpolation);
+    - "llama3": Llama-3.1 wavelength-banded scaling — wavelengths beyond
+      `original_max_position_embeddings/low_freq_factor` divide by `factor`,
+      short wavelengths stay, the band between interpolates smoothly.
+    """
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+        if rope_type == "llama3":
+            factor = scaling["factor"]
+            low = scaling["low_freq_factor"]
+            high = scaling["high_freq_factor"]
+            old_len = scaling["original_max_position_embeddings"]
+            wavelen = 2 * np.pi / inv_freq
+            scaled = np.where(wavelen > old_len / low, inv_freq / factor, inv_freq)
+            smooth = (old_len / wavelen - low) / (high - low)
+            smoothed = (1 - smooth) * scaled / factor + smooth * scaled
+            medium = (wavelen <= old_len / low) & (wavelen >= old_len / high)
+            inv_freq = np.where(medium, smoothed, scaled)
+        elif rope_type == "linear":
+            inv_freq = inv_freq / scaling["factor"]
+        elif rope_type not in ("default", None):
+            raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
     t = np.arange(max_len)
     freqs = np.outer(t, inv_freq)
     return jnp.asarray(np.cos(freqs), jnp.float32), jnp.asarray(np.sin(freqs), jnp.float32)

@@ -67,6 +67,7 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
         max_position_embeddings=get("max_position_embeddings", 2048),
         rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=dict(get("rope_scaling")) if get("rope_scaling") else None,
         rms_norm_eps=get("rms_norm_eps", 1e-6),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
     )

@@ -50,6 +50,7 @@ class LlamaConfig:
     num_key_value_heads: int = 32
     max_position_embeddings: int = 2048
     rope_theta: float = 10000.0
+    rope_scaling: Any = None  # HF-style dict, e.g. {"rope_type": "llama3", ...}
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
@@ -214,7 +215,8 @@ def forward(
         kv_caches[0][0].shape[1] if kv_caches is not None
         else config.max_position_embeddings
     )
-    cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta)
+    cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta,
+                                scaling=config.rope_scaling)
 
     if kv_caches is not None:
         # decode path: python loop over per-layer caches (stacked scan would
@@ -270,7 +272,8 @@ def forward_offloaded(
 
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     cos, sin = rope_frequencies(
-        config.head_dim, config.max_position_embeddings, config.rope_theta
+        config.head_dim, config.max_position_embeddings, config.rope_theta,
+        scaling=config.rope_scaling,
     )
     layer_step = jax.jit(
         lambda layer, x: _layer_body(

@@ -221,7 +221,7 @@ def forward(
     x = params["embed_tokens"]["embedding"][input_ids]
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     cos, sin = rope_frequencies(config.head_dim, config.max_position_embeddings,
-                                config.rope_theta)
+                                config.rope_theta)  # mixtral ships no rope_scaling
 
     def scan_body(carry, layer):
         x, aux_sum = carry

@@ -165,3 +165,51 @@ def test_checkpoint_dir_roundtrip(tmp_path):
         want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
     got = np.asarray(llama.forward(cfg, params, ids))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama3_rope_scaling_parity():
+    """Llama-3.1-style rope_scaling must reproduce HF logits — real Llama-3.1
+    checkpoints ship this config and silently degrade without it."""
+    from accelerate_tpu.models import hf_import, llama
+
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=6, num_key_value_heads=3,
+        max_position_embeddings=256, rope_theta=500000.0,
+        attention_dropout=0.0,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(9)
+    hf_model = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg = hf_import.llama_config_from_hf(cfg_hf)
+    assert cfg.rope_scaling and cfg.rope_scaling["rope_type"] == "llama3"
+    params = hf_import.llama_params_from_hf(cfg, hf_model.state_dict())
+    ids = np.arange(0, 96, dtype=np.int32)[None, :]  # long enough to engage scaling
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(llama.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_linear_rope_scaling_parity():
+    from accelerate_tpu.models import hf_import, llama
+
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, attention_dropout=0.0,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    torch.manual_seed(10)
+    hf_model = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg = hf_import.llama_config_from_hf(cfg_hf)
+    params = hf_import.llama_params_from_hf(cfg, hf_model.state_dict())
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 64, (2, 40)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(llama.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
