@@ -119,6 +119,12 @@ def mixtral_config_from_hf(hf_config) -> MixtralConfig:
     get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
         hf_config, dict
     ) else (lambda k, d=None: hf_config.get(k, d))
+    if get("rope_scaling"):
+        raise ValueError(
+            "this Mixtral checkpoint sets rope_scaling, which the mixtral "
+            "forward does not apply yet — importing it would silently degrade "
+            "long-context generation"
+        )
     return MixtralConfig(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
