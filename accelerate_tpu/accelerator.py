@@ -889,33 +889,53 @@ class Accelerator:
         return result
 
     def _checkpoint_dir(self, new: bool) -> str:
+        """Versioned dir resolution. On a shared filesystem, EVERY process
+        must agree on the index: the main process lists/prunes and broadcasts
+        its decision (independent listings race each other — a straggler can
+        see one fewer checkpoint and write into the wrong version)."""
         from .utils.constants import CHECKPOINT_DIR_PREFIX
 
         base = os.path.join(self.project_configuration.project_dir or ".", "checkpoints")
         if not self.project_configuration.automatic_checkpoint_naming:
             return base
-        os.makedirs(base, exist_ok=True)
-        existing = sorted(
-            int(d.rsplit("_", 1)[1])
-            for d in os.listdir(base)
-            if d.startswith(CHECKPOINT_DIR_PREFIX + "_")
-        )
-        if new:
-            idx = (existing[-1] + 1) if existing else 0
-            self.project_configuration.iteration = idx
-            limit = self.project_configuration.total_limit
-            if limit is not None and len(existing) + 1 > limit:
-                import shutil
+        idx = None
+        if self.is_main_process:
+            # any exception here MUST still reach the broadcast below, or
+            # every other host hangs in the collective waiting for rank 0
+            try:
+                os.makedirs(base, exist_ok=True)
+                existing = sorted(
+                    int(d.rsplit("_", 1)[1])
+                    for d in os.listdir(base)
+                    if d.startswith(CHECKPOINT_DIR_PREFIX + "_")
+                    and d.rsplit("_", 1)[1].isdigit()
+                )
+                if new:
+                    idx = (existing[-1] + 1) if existing else 0
+                    limit = self.project_configuration.total_limit
+                    if limit is not None and len(existing) + 1 > limit:
+                        import shutil
 
-                for old in existing[: len(existing) + 1 - limit]:
-                    shutil.rmtree(
-                        os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{old}"),
-                        ignore_errors=True,
-                    )
-        else:
-            if not existing:
-                raise FileNotFoundError(f"no checkpoints under {base}")
-            idx = existing[-1]
+                        for old in existing[: len(existing) + 1 - limit]:
+                            shutil.rmtree(
+                                os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{old}"),
+                                ignore_errors=True,
+                            )
+                else:
+                    idx = existing[-1] if existing else -1
+            except Exception as e:
+                idx = f"__error__:{type(e).__name__}: {e}"
+        if self.num_processes > 1:
+            (idx,) = ops.broadcast_object_list([idx])
+        if isinstance(idx, str):
+            raise RuntimeError(
+                f"checkpoint dir resolution failed on the main process: "
+                f"{idx.removeprefix('__error__:')}"
+            )
+        if idx is None or idx < 0:
+            raise FileNotFoundError(f"no checkpoints under {base}")
+        if new:
+            self.project_configuration.iteration = idx
         return os.path.join(base, f"{CHECKPOINT_DIR_PREFIX}_{idx}")
 
     def save_model(self, params: Any, save_directory: str,

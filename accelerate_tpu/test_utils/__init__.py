@@ -114,3 +114,13 @@ def bundled_script_path(name: str) -> str:
     from pathlib import Path
 
     return str(Path(__file__).parent / "scripts" / name)
+
+
+def host_values(tree):
+    """Fetch a (possibly globally-sharded) pytree to host numpy on every
+    process — `jax.device_get` refuses arrays spanning other hosts' devices."""
+    import jax
+
+    from ..utils.operations import _to_local
+
+    return jax.tree_util.tree_map(_to_local, tree)

@@ -38,9 +38,12 @@ def _make_world(tmpdir: str, total_limit: int | None = None):
             total_limit=total_limit,
         ),
     )
-    ds = RegressionDataset(length=64, seed=7)
+    # scale with the world so every host still sees 8 batches per epoch
+    # (prepared loaders stride whole batches across hosts)
+    n = 64 * acc.num_processes
+    ds = RegressionDataset(length=n, seed=7)
     batches = [
-        {"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 64, 8)
+        {"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, n, 8)
     ]
     loader = acc.prepare(batches)
     ts = acc.prepare(
@@ -61,7 +64,9 @@ def check_save_resume_equivalence(tmpdir: str):
     assert os.path.isdir(ckpt), ckpt
     for _ in range(4):
         ts, _ = step(ts, next(it))
-    direct = jax.device_get(ts.params)
+    from accelerate_tpu.test_utils import host_values
+
+    direct = host_values(ts.params)
 
     # fresh world resumes from the checkpoint and replays the same tail
     acc2, loader2, ts2, step2 = _make_world(tmpdir)
@@ -72,15 +77,17 @@ def check_save_resume_equivalence(tmpdir: str):
         next(it2)
     for _ in range(4):
         ts2, _ = step2(ts2, next(it2))
-    resumed = jax.device_get(ts2.params)
+    resumed = host_values(ts2.params)
     np.testing.assert_array_equal(direct["a"], resumed["a"])
     np.testing.assert_array_equal(direct["b"], resumed["b"])
 
 
 def check_skip_first_batches(tmpdir: str):
+    from accelerate_tpu.test_utils import host_values
+
     acc, loader, _, _ = _make_world(tmpdir)
-    all_batches = [np.asarray(b["x"]) for b in loader]
-    tail = [np.asarray(b["x"]) for b in acc.skip_first_batches(loader, 3)]
+    all_batches = [host_values(b["x"]) for b in loader]
+    tail = [host_values(b["x"]) for b in acc.skip_first_batches(loader, 3)]
     assert len(tail) == len(all_batches) - 3
     for got, want in zip(tail, all_batches[3:]):
         np.testing.assert_array_equal(got, want)
@@ -100,16 +107,33 @@ def check_total_limit(tmpdir: str):
 
 
 def main() -> None:
+    import shutil
+
     from accelerate_tpu.state import PartialState
+    from accelerate_tpu.utils.operations import broadcast_object_list
 
     state = PartialState()
-    with tempfile.TemporaryDirectory() as tmp_a, \
-         tempfile.TemporaryDirectory() as tmp_b, \
-         tempfile.TemporaryDirectory() as tmp_c:
-        check_save_resume_equivalence(tmp_a)
-        check_skip_first_batches(tmp_b)
-        check_total_limit(tmp_c)
+    # multi-host checkpointing needs ONE directory every process agrees on
+    # (orbax: non-primary hosts wait for the primary's commit markers) — the
+    # main process creates it and broadcasts the path, exactly as a real
+    # multi-host run points every host at the same shared-filesystem dir
+    dirs = (
+        [tempfile.mkdtemp() for _ in range(3)]
+        if state.is_main_process else [None, None, None]
+    )
+    dirs = broadcast_object_list(dirs)
+    tmp_a, tmp_b, tmp_c = dirs
+    check_save_resume_equivalence(tmp_a)
+    check_skip_first_batches(tmp_b)
+    check_total_limit(tmp_c)
+    # cleanup on the success path only: a barrier in a finally would hang the
+    # world when one host fails mid-check (its peers are still inside other
+    # collectives); a failed run leaking a tmpdir is the lesser evil
     state = PartialState()
+    state.wait_for_everyone()
+    if state.is_main_process:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
     if state.is_main_process:
         print(f"test_checkpointing: ALL CHECKS PASSED ({state.num_processes} process(es))")
 

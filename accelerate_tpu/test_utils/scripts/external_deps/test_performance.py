@@ -38,8 +38,10 @@ def check_regression_convergence():
     for _ in range(12):  # epochs
         for batch in loader:
             ts, _ = step(ts, batch)
-    a = float(jax.device_get(ts.params["a"]))
-    b = float(jax.device_get(ts.params["b"]))
+    from accelerate_tpu.test_utils import host_values
+
+    a = float(host_values(ts.params["a"]))
+    b = float(host_values(ts.params["b"]))
     # ground truth y = 2x + 1 (+0.1 noise): the quality gate
     assert abs(a - 2.0) < 0.15, f"slope {a} off baseline 2.0"
     assert abs(b - 1.0) < 0.15, f"intercept {b} off baseline 1.0"

@@ -49,6 +49,9 @@ def _train(plugin, batches, steps: int):
 
     PartialState._reset_state()
     acc = Accelerator(fsdp_plugin=plugin, gradient_clipping=1.0)
+    # each host strides every num_processes-th batch: replicate the batch
+    # list so `steps` next() calls never exhaust a host's shard
+    batches = list(batches) * acc.num_processes
     params = _mlp_params(jax.random.key(0))
     ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=optax.adam(1e-2)))
     step = acc.train_step(_mlp_loss)
@@ -96,14 +99,16 @@ def check_sharded_matches_replicated():
          "y": rng.normal(size=(8, 256)).astype(np.float32)}
         for _ in range(6)
     ]
-    _, ts_full = _train(FullyShardedDataParallelPlugin("FULL_SHARD"), batches, 6)
-    _, ts_none = _train(FullyShardedDataParallelPlugin("NO_SHARD"), batches, 6)
-    full = jax.device_get(ts_full.params["layer_2"]["kernel"])
-    none = jax.device_get(ts_none.params["layer_2"]["kernel"])
-    # sharded vs replicated matmuls reduce in different orders; after 6 adam
-    # steps a few ULPs of drift is expected (ref test asserts metric parity,
-    # not bitwise equality)
-    np.testing.assert_allclose(full, none, rtol=5e-4, atol=1e-5)
+    acc_full, ts_full = _train(FullyShardedDataParallelPlugin("FULL_SHARD"), batches, 6)
+    # get_state_dict regathers multi-host shards (device_get cannot read an
+    # array spanning non-addressable devices)
+    full = acc_full.get_state_dict(ts_full)["layer_2"]["kernel"]
+    acc_none, ts_none = _train(FullyShardedDataParallelPlugin("NO_SHARD"), batches, 6)
+    none = acc_none.get_state_dict(ts_none)["layer_2"]["kernel"]
+    # sharded vs replicated matmuls reduce in different orders (more so
+    # across hosts); after 6 adam steps a small drift is expected (ref test
+    # asserts metric parity, not bitwise equality)
+    np.testing.assert_allclose(full, none, rtol=3e-3, atol=5e-5)
 
 
 def check_state_dict_regathers():

@@ -121,7 +121,9 @@ def check_training(state):
             last = loss
     assert last < first, f"loss did not decrease: {first} -> {last}"
     # every process must hold identical params (grads ride the mesh/world)
-    a_values = gather_object(float(jax.device_get(ts.params["a"])))
+    from accelerate_tpu.test_utils import host_values
+
+    a_values = gather_object(float(host_values(ts.params["a"])))
     assert len(set(a_values)) == 1, f"params diverged: {a_values}"
     assert abs(a_values[0] - 2.0) < 0.5, f"did not approach a=2: {a_values[0]}"
 

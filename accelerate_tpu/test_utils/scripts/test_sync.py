@@ -81,7 +81,9 @@ def check_accumulation_equivalence():
                 ts, _ = step(ts, {"x": ds.x[sl], "y": ds.y[sl]})
         else:
             ts, _ = step(ts, {"x": ds.x, "y": ds.y})
-        return jax.device_get(ts.params)
+        from accelerate_tpu.test_utils import host_values
+
+        return host_values(ts.params)
 
     accum = run(k)
     big = run(1)
@@ -120,8 +122,10 @@ def check_params_identical_across_ranks():
     step = acc.train_step(regression_loss)
     for batch in loader:
         ts, _ = step(ts, batch)
-    a = float(jax.device_get(ts.params["a"]))
-    b = float(jax.device_get(ts.params["b"]))
+    from accelerate_tpu.test_utils import host_values
+
+    a = float(host_values(ts.params["a"]))
+    b = float(host_values(ts.params["b"]))
     everyone = gather_object((a, b))
     assert len(set(everyone)) == 1, f"params diverged across ranks: {everyone}"
 
