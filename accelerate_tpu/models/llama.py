@@ -20,6 +20,7 @@ tokens/sec/chip). Design:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any
 
@@ -50,12 +51,24 @@ class LlamaConfig:
     num_key_value_heads: int = 32
     max_position_embeddings: int = 2048
     rope_theta: float = 10000.0
-    rope_scaling: Any = None  # HF-style dict, e.g. {"rope_type": "llama3", ...}
+    # HF-style dict, e.g. {"rope_type": "llama3", ...}; normalized to a
+    # sorted item tuple so the config stays hashable (jit/lru_cache keys)
+    rope_scaling: Any = None
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
     remat: bool = False
     remat_policy: str = "full"  # full | dots (save MXU outputs, recompute rest)
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(
+                self, "rope_scaling", tuple(sorted(self.rope_scaling.items()))
+            )
+
+    @property
+    def rope_scaling_dict(self) -> dict | None:
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     @property
     def head_dim(self) -> int:
@@ -216,7 +229,7 @@ def forward(
         else config.max_position_embeddings
     )
     cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta,
-                                scaling=config.rope_scaling)
+                                scaling=config.rope_scaling_dict)
 
     if kv_caches is not None:
         # decode path: python loop over per-layer caches (stacked scan would
@@ -273,7 +286,7 @@ def forward_offloaded(
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     cos, sin = rope_frequencies(
         config.head_dim, config.max_position_embeddings, config.rope_theta,
-        scaling=config.rope_scaling,
+        scaling=config.rope_scaling_dict,
     )
     layer_step = jax.jit(
         lambda layer, x: _layer_body(
@@ -387,6 +400,48 @@ def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bflo
     ]
 
 
+@functools.lru_cache(maxsize=32)
+def _generate_programs(config: LlamaConfig, temperature: float):
+    """Compiled prefill + fused-decode programs, cached per (config,
+    temperature). Shapes (prompt length, token budget, batch) are ordinary
+    traced-array shapes: jit retraces on genuinely new shapes and keeps the
+    old entries — fresh closures per generate() call would instead recompile
+    every single time."""
+
+    def select(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits[:, -1], axis=-1)
+        return jax.random.categorical(k, logits[:, -1] / temperature)
+
+    @jax.jit
+    def prefill(params, input_ids, caches, k):
+        logits, caches = forward(config, params, input_ids, kv_caches=caches)
+        return select(logits, k), caches
+
+    # the whole decode is ONE compiled program: lax.scan over steps with
+    # (last, caches) as carry — a single dispatch for all tokens instead of a
+    # host round-trip per token (which dominates on remote/tunneled devices)
+    @jax.jit
+    def decode_all(params, last, caches, steps, keys):
+        b = last.shape[0]
+
+        def body(carry, xs):
+            last, caches = carry
+            pos, k = xs
+            positions = jnp.broadcast_to(pos, (b, 1))
+            logits, caches = forward(
+                config, params, last[:, None], positions=positions,
+                kv_caches=caches,
+            )
+            return (select(logits, k), caches), last
+
+        (final, _), emitted = jax.lax.scan(body, (last, caches), (steps, keys))
+        # emitted[i] is the token fed at step i ([T, B]); final is the last
+        return jnp.concatenate([emitted.T, final[:, None]], axis=1)
+
+    return prefill, decode_all
+
+
 def generate(
     config: LlamaConfig,
     params: dict,
@@ -402,33 +457,12 @@ def generate(
     caches = init_kv_caches(config, b, total)
     if key is None:
         key = jax.random.key(0)
-
-    def select(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits[:, -1], axis=-1)
-        return jax.random.categorical(k, logits[:, -1] / temperature)
-
-    prefill = jax.jit(partial(forward, config))
-    logits, caches = prefill(params, input_ids, kv_caches=caches)
+    prefill, decode_all = _generate_programs(config, float(temperature))
     key, sub = jax.random.split(key)
-    last = select(logits, sub)
-
-    # one compiled program reused for every decode token (traced cache_len
-    # and positions keep the trace static)
-    @jax.jit
-    def decode_step(params, last, caches, pos, k):
-        positions = jnp.broadcast_to(pos, (b, 1))
-        logits, caches = forward(
-            config, params, last[:, None], positions=positions, kv_caches=caches
-        )
-        return select(logits, k), caches
-
-    tokens = [input_ids]
-    for step in range(max_new_tokens - 1):
-        tokens.append(last[:, None])
-        key, sub = jax.random.split(key)
-        last, caches = decode_step(
-            params, last, caches, jnp.asarray(prompt_len + step, jnp.int32), sub
-        )
-    tokens.append(last[:, None])
-    return jnp.concatenate(tokens, axis=1)
+    last, caches = prefill(params, input_ids, caches, sub)
+    if max_new_tokens == 1:
+        return jnp.concatenate([input_ids, last[:, None]], axis=1)
+    keys = jax.random.split(key, max_new_tokens - 1)
+    steps = jnp.arange(prompt_len, prompt_len + max_new_tokens - 1, dtype=jnp.int32)
+    new_tokens = decode_all(params, last, caches, steps, keys)
+    return jnp.concatenate([input_ids, new_tokens], axis=1)

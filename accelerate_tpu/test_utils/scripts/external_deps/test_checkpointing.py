@@ -129,12 +129,10 @@ def main() -> None:
     # cleanup on the success path only: a barrier in a finally would hang the
     # world when one host fails mid-check (its peers are still inside other
     # collectives); a failed run leaking a tmpdir is the lesser evil
-    state = PartialState()
     state.wait_for_everyone()
     if state.is_main_process:
         for d in dirs:
             shutil.rmtree(d, ignore_errors=True)
-    if state.is_main_process:
         print(f"test_checkpointing: ALL CHECKS PASSED ({state.num_processes} process(es))")
 
 

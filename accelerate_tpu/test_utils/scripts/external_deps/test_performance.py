@@ -28,8 +28,10 @@ def check_regression_convergence():
 
     PartialState._reset_state()
     acc = Accelerator(mixed_precision="no", gradient_clipping=1.0)
-    ds = RegressionDataset(length=96, seed=1)
-    batches = [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 96, 8)]
+    # world-scaled so every host runs the same number of steps per epoch
+    n = 96 * acc.num_processes
+    ds = RegressionDataset(length=n, seed=1)
+    batches = [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, n, 8)]
     loader = acc.prepare(batches)
     ts = acc.prepare(
         TrainState.create(apply_fn=None, params=regression_params(), tx=optax.adam(0.1))
@@ -84,7 +86,8 @@ def check_bert_classifier_learns():
         acc_metric = (jnp.argmax(logits, -1) == labels).mean()
         return loss, {"accuracy": acc_metric}
 
-    batches = _synthetic_cls_batches(vocab=32, seq=16, n=256, bs=16, seed=5)
+    batches = _synthetic_cls_batches(vocab=32, seq=16,
+                                     n=256 * acc.num_processes, bs=16, seed=5)
     loader = acc.prepare(batches)
     ts = acc.prepare(
         TrainState.create(apply_fn=None, params=params, tx=optax.adamw(3e-3))

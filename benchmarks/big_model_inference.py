@@ -1,0 +1,110 @@
+"""Big-model inference benchmark: checkpoint load time + per-token decode.
+
+Mirror of ref benchmarks/big_model_inference.py (the reference's ONLY
+published benchmark — GPT-J/NeoX/OPT load + generate times,
+benchmarks/README.md:25-36). Zero-egress: a synthetic safetensors checkpoint
+is written once, then timed through the real load path
+(init_empty_weights -> device-map plan -> streamed safetensors load ->
+dispatch) and the KV-cache greedy decode.
+
+Run: python benchmarks/big_model_inference.py [--preset 1b|tiny] [--offload]
+Prints one JSON line per phase.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="tiny", choices=["tiny", "1b"])
+    parser.add_argument("--offload", action="store_true",
+                        help="force host-offload of half the layers")
+    parser.add_argument("--new_tokens", type=int, default=32)
+    parser.add_argument("--checkpoint", default=None,
+                        help="existing checkpoint dir (else synthesized)")
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    from accelerate_tpu import init_empty_weights, load_checkpoint_and_dispatch
+    from accelerate_tpu.checkpointing import save_model
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.models.common import count_params
+
+    if args.preset == "1b":
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=22, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048,
+        )
+    else:
+        cfg = llama.LlamaConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=704,
+            num_hidden_layers=4, num_attention_heads=8, num_key_value_heads=8,
+            max_position_embeddings=512,
+        )
+
+    ckpt = args.checkpoint
+    tmp = None
+    if ckpt is None:
+        tmp = tempfile.mkdtemp()
+        ckpt = os.path.join(tmp, "model")
+        params = llama.init_params(cfg, jax.random.key(0))
+        save_model(params, ckpt, max_shard_size="512MB")
+        del params
+
+    # --- timed load: abstract init -> plan -> streamed safetensors -> place
+    t0 = time.perf_counter()
+    shapes = init_empty_weights(llama.init_params, cfg, jax.random.key(0))
+    max_memory = None
+    if args.offload:
+        # leave room for only ~half the params on device; rest goes to host
+        n_bytes = sum(
+            int(np.prod(l.shape)) * 4 for l in jax.tree_util.tree_leaves(shapes)
+        )
+        max_memory = {0: n_bytes // 2, "cpu": n_bytes * 2}
+    params = load_checkpoint_and_dispatch(
+        shapes, ckpt, device_map="auto", max_memory=max_memory,
+    )
+    load_s = time.perf_counter() - t0
+    n_params = count_params(params)
+    print(json.dumps({
+        "metric": "big_model_load_seconds",
+        "value": round(load_s, 2),
+        "unit": "s",
+        "extra": {"params": n_params, "offload": bool(args.offload)},
+    }))
+
+    # --- timed decode (greedy, KV cache)
+    ids = np.random.default_rng(0).integers(
+        4, cfg.vocab_size, (1, 32)).astype(np.int32)
+    t0 = time.perf_counter()
+    out = llama.generate(cfg, params, ids, max_new_tokens=args.new_tokens)
+    jax.block_until_ready(out)
+    first = time.perf_counter() - t0  # includes compile
+    t0 = time.perf_counter()
+    out = llama.generate(cfg, params, ids, max_new_tokens=args.new_tokens)
+    np.asarray(out)
+    decode_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "big_model_seconds_per_token",
+        "value": round(decode_s / args.new_tokens, 4),
+        "unit": "s/token",
+        "extra": {"new_tokens": args.new_tokens,
+                  "first_call_with_compile_s": round(first, 2)},
+    }))
+    if tmp:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

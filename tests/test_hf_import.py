@@ -185,7 +185,7 @@ def test_llama3_rope_scaling_parity():
     torch.manual_seed(9)
     hf_model = transformers.LlamaForCausalLM(cfg_hf).eval()
     cfg = hf_import.llama_config_from_hf(cfg_hf)
-    assert cfg.rope_scaling and cfg.rope_scaling["rope_type"] == "llama3"
+    assert cfg.rope_scaling_dict and cfg.rope_scaling_dict["rope_type"] == "llama3"
     params = hf_import.llama_params_from_hf(cfg, hf_model.state_dict())
     ids = np.arange(0, 96, dtype=np.int32)[None, :]  # long enough to engage scaling
     with torch.no_grad():
