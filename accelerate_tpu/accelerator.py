@@ -847,6 +847,19 @@ class Accelerator:
         from .checkpointing import save_accelerator_state
 
         if output_dir is None:
+            if (
+                self.project_configuration.total_limit == 1
+                and self.project_configuration.automatic_checkpoint_naming
+            ):
+                # with total_limit=1 the prune in _checkpoint_dir targets the
+                # newest existing dir — the only one a previous async save
+                # can still be committing (the single AsyncCheckpointer
+                # serializes saves). Every process drains its own writer,
+                # then a barrier keeps rank 0 from pruning before the other
+                # hosts' drains have finished. Larger limits never prune the
+                # newest dir, so they keep full async overlap.
+                self.wait_for_checkpoints()
+                self.wait_for_everyone()
             output_dir = self._checkpoint_dir(new=True)
         for hook in self._save_model_state_pre_hook.values():
             hook(self._models, None, output_dir)

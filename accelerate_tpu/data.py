@@ -39,10 +39,8 @@ from .utils.dataclasses import DataLoaderConfiguration, RNGType
 from .utils.operations import (
     broadcast_object_list,
     concatenate,
-    find_batch_size,
     get_data_structure,
     send_to_device,
-    slice_tensors,
 )
 from .utils.random import synchronize_rng_states
 from .logging import get_logger
@@ -77,6 +75,124 @@ def _to_numpy(x: Any) -> Any:
 def batch_to_numpy(batch: Any) -> Any:
     """Convert a host batch (torch tensors / lists / numpy) to numpy leaves."""
     return jax.tree_util.tree_map(_to_numpy, batch)
+
+
+def _batch_size(batch: Any) -> int | None:
+    """find_batch_size plus the row-container case: a row container (a list
+    of strings/scalars/ragged sequences) anywhere in the tree contributes its
+    len() as the row count — find_batch_size alone would return the first
+    ragged row's *token* count for e.g. {'ids': [arr, ...], 'x': array}.
+
+    Evidence priority, independent of dict key order: (1) array leading
+    dims — arrays are the collated fields and their leading dim IS the batch
+    size (a short metadata string list must not override it); (2) row
+    containers (ragged/scalar/string lists); (3) ambiguous equal-length 1-D
+    lists, via their first array's leading dim (the field interpretation,
+    matching find_batch_size)."""
+    containers: list = []
+    deferred: list = []
+
+    def walk(node) -> int | None:
+        if _is_row_container(node):
+            if len(node):
+                containers.append(node)  # empty ones carry no evidence
+            return None
+        if (
+            isinstance(node, (list, tuple))
+            and not hasattr(node, "_fields")
+            and node
+            and getattr(node[0], "ndim", None) == 1
+        ):
+            deferred.append(node)  # ambiguous: equal-length 1-D rows/fields
+            return None
+        # numpy / torch / jax arrays all expose .ndim and .shape
+        if getattr(node, "ndim", 0):
+            return int(node.shape[0])
+        if isinstance(node, dict):
+            children = (v for _, v in sorted(node.items(), key=lambda kv: str(kv[0])))
+        elif isinstance(node, (list, tuple)):
+            children = iter(node)
+        else:
+            return None
+        for child in children:
+            found = walk(child)
+            if found is not None:
+                return found
+        return None
+
+    size = walk(batch)
+    if size is not None:
+        return size
+    for node in containers:
+        return len(node)
+    for node in deferred:
+        return int(np.shape(node[0])[0])
+    return None
+
+
+def _wrap_pad_rows(x: Any, target: int) -> Any:
+    """Wraparound-extend the rows of a list/tuple (row container) or the
+    leading dim of an array up to `target`; anything else passes through."""
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0 or len(x) >= target:
+            return x
+        reps = math.ceil(target / len(x))
+        return type(x)((list(x) * reps)[:target])
+    if not isinstance(x, np.ndarray) or x.ndim == 0 or x.shape[0] >= target:
+        return x
+    reps = math.ceil(target / x.shape[0])
+    return np.concatenate([x] * reps, axis=0)[:target]
+
+
+def _is_row_container(x: Any, expected_rows: int | None = None) -> bool:
+    """True for a list/tuple whose elements are individual *rows* (strings /
+    scalars) rather than pytree structure. A tuple batch like
+    (inputs, labels) holds arrays and is structure, so tree_map recurses into
+    it and each field is sliced/padded row-wise; a list of strings is a leaf
+    sliced whole."""
+    if not isinstance(x, (list, tuple)) or hasattr(x, "_fields"):
+        # namedtuples are pytree structure (fixed fields), never row batches
+        return False
+    if len(x) == 0:
+        return True
+    head = x[0]
+    if isinstance(x, tuple):
+        # numeric tuples like (224, 224) are almost always metadata, and a
+        # tuple of arrays like (inputs, labels) is a field pair, not a
+        # 2-row batch; only string/bytes tuples count as rows, so slicing
+        # and padding agree on what is a row container
+        return isinstance(head, (str, bytes))
+    # lists: scalar-like rows, ragged token sequences (lists of lists, the
+    # HF tokenizer output shape), or 0-d arrays are rows; a list of >=2-D
+    # arrays or dicts is field structure
+    if isinstance(
+        head,
+        (str, bytes, int, float, bool, complex, type(None), np.generic, list),
+    ):
+        return True
+    # numpy / torch / jax arrays all expose .ndim — classify generically so
+    # torch-tensor rows behave exactly like numpy rows
+    head_ndim = getattr(head, "ndim", None)
+    if head_ndim == 0:
+        return True
+    if head_ndim == 1:
+        # a list of 1-D arrays is ambiguous: ragged token rows, or the
+        # [features, labels] field list torch's default_collate emits for
+        # scalar-sample datasets. Varying lengths mean ragged rows; for
+        # equal lengths the batch's known row count disambiguates (a list
+        # with one entry per row is rows, a short field list is structure).
+        # Without that context, equal lengths default to field structure —
+        # pad genuinely ragged-but-equal batches into a 2-D array instead.
+        lengths = {len(e) for e in x if getattr(e, "ndim", None) == 1}
+        if len(lengths) > 1:
+            return True
+        if expected_rows is None or len(x) != expected_rows:
+            return False
+        # square case (k fields of k samples vs k rows of k tokens) is
+        # undecidable — default to the default_collate field interpretation
+        (inner,) = lengths or {0}
+        return inner != expected_rows
+    return False
 
 
 # ---------------------------------------------------------------------------
@@ -205,9 +321,11 @@ class ShardedBatchIterable:
 
     Two modes (matching the reference's split_batches switch):
     - stride (default): batch i goes to host i % P. `even_batches=True`
-      recycles initial batches and pads ANY short batch up to the size of the
-      first batch, so every host yields the same number of equally-shaped
-      batches and SPMD steps stay in lockstep.
+      recycles initial batches and pads a short FINAL batch up to the size of
+      the first batch, so every host yields the same number of equally-shaped
+      batches and SPMD steps stay in lockstep; a mid-stream batch whose size
+      differs raises (its padding would corrupt `remainder`). With
+      `even_batches=False` nothing pads and variable sizes are legal.
     - split (`split_batches=True`): every host takes its contiguous slice of
       EVERY batch, so the global batch size equals the source batch size.
 
@@ -253,7 +371,7 @@ class ShardedBatchIterable:
         self.tail_layout = None
         full_size = None
         for cursor, batch in enumerate(self.batches):
-            size = find_batch_size(batch)
+            size = _batch_size(batch)
             if full_size is None:
                 if size is None or size % P != 0:
                     raise ValueError(
@@ -261,7 +379,19 @@ class ShardedBatchIterable:
                         f"{P} processes, got {size}"
                     )
                 full_size = size
-            if size < full_size:  # short tail: pad, record true rows
+            if size is None:
+                raise ValueError(
+                    f"batch {cursor} has no measurable batch size (no array "
+                    "leaves or row container); split_batches needs sized "
+                    "batches"
+                )
+            if size > full_size:
+                # slicing would silently drop rows beyond full_size
+                raise ValueError(
+                    f"batch {cursor} has {size} rows but the first batch had "
+                    f"{full_size}; batches may not grow with split_batches"
+                )
+            if size < full_size:  # short tail: pad below, record true rows
                 if cursor != n - 1:
                     raise ValueError(
                         "only the final batch may be short with split_batches"
@@ -274,23 +404,37 @@ class ShardedBatchIterable:
                         f"a short final batch ({size} rows < {full_size}); "
                         "drop it or enable even_batches"
                     )
-                batch = pad_batch_to(batch, full_size)
                 self.remainder = size
             per = full_size // P
+            true_rows = size
 
-            def _slice(x):
-                if isinstance(x, np.ndarray):
-                    # 0-d leaves replicate; batched arrays slice
-                    return x if x.ndim == 0 else x[rank * per : (rank + 1) * per]
-                if isinstance(x, (list, tuple)):  # e.g. a list of strings
+            # pad + slice in ONE pass so every leaf is classified exactly
+            # once, against the batch's true (pre-pad) row count — padding
+            # first and re-classifying at slice time can flip an equal-length
+            # ragged tail from rows to field structure. A row container (a
+            # list of strings / scalars / ragged sequences) wraparound-pads
+            # and slices whole; arrays pad and slice their leading dim;
+            # structure (dicts, field tuples) is recursed into by tree_map.
+            def _prepare(x):
+                if _is_row_container(x, true_rows):
+                    if len(x) != true_rows:
+                        # metadata container (e.g. a short label-name list):
+                        # replicate untouched, never wrap/slice
+                        return x
+                    x = _wrap_pad_rows(x, full_size)
                     return x[rank * per : (rank + 1) * per]
-                return x  # strings/scalars replicate
+                x = _to_numpy(x)
+                if isinstance(x, np.ndarray) and x.ndim > 0:
+                    if x.shape[0] != true_rows:
+                        # aux array (e.g. per-class weights): replicate
+                        return x
+                    x = _wrap_pad_rows(x, full_size)
+                    return x[rank * per : (rank + 1) * per]
+                return x  # strings/scalars/0-d leaves replicate
 
-            # lists are row containers here, not pytree structure: keep them
-            # whole so a list of strings slices by row, never by character
             yield jax.tree_util.tree_map(
-                _slice, batch_to_numpy(batch),
-                is_leaf=lambda x: isinstance(x, (list, tuple)),
+                _prepare, batch,
+                is_leaf=lambda x: _is_row_container(x, true_rows),
             )
 
     def _iter_stride_mode(self):
@@ -307,11 +451,41 @@ class ShardedBatchIterable:
         full_size = None
         last_size = None
         for cursor, batch in enumerate(self.batches):
-            size = find_batch_size(batch)
+            size = _batch_size(batch)
             if full_size is None:
                 full_size = size
             if cursor == n - 1:
                 last_size = size
+                if (
+                    self.even_batches
+                    and size is not None
+                    and full_size is not None
+                    and size > full_size
+                ):
+                    # _pad_to_full only pads upward: an oversized final batch
+                    # would leave this rank's final round bigger than its
+                    # peers', breaking SPMD lockstep
+                    raise ValueError(
+                        f"final batch has {size} rows but earlier batches had "
+                        f"{full_size}; batches may not grow when "
+                        "even_batches=True"
+                    )
+            elif (
+                self.even_batches
+                and size is not None
+                and full_size is not None
+                and size != full_size
+            ):
+                # the remainder bookkeeping below (and gather_for_metrics'
+                # truncation built on it) assumes only the final batch can be
+                # short — a padded mid-stream batch would leak filler rows
+                # into gather_for_metrics as real samples. even_batches=False
+                # never pads, so variable-size streams stay legal there.
+                raise ValueError(
+                    f"batch {cursor} has {size} rows but the first batch had "
+                    f"{full_size}; only the final batch may be short "
+                    "when even_batches=True"
+                )
             if cursor == recycle_idx:
                 recycled = batch
             if cursor % P == rank:
@@ -336,9 +510,9 @@ class ShardedBatchIterable:
         size of a full batch."""
         if full_size is None:
             return batch
-        size = find_batch_size(batch)
+        size = _batch_size(batch)
         if size is not None and size < full_size:
-            return pad_batch_to(batch, full_size)
+            return pad_batch_to(batch, full_size, rows=size)
         return batch
 
 
@@ -435,17 +609,35 @@ def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
     return jax.tree_util.tree_map(_make, batch)
 
 
-def pad_batch_to(batch: Any, target: int) -> Any:
-    """Wraparound-pad every leaf's leading dim to `target` rows."""
+def pad_batch_to(batch: Any, target: int, rows: int | None = None) -> Any:
+    """Wraparound-pad every leaf's leading dim to `target` rows. Row
+    containers (see `_is_row_container`) wraparound-extend too, so short-tail
+    padding never leaves one rank with fewer rows than its peers. `rows` is
+    the batch's current row count (disambiguates equal-length 1-D lists)."""
 
     def _pad(x):
+        if _is_row_container(x, rows):
+            if rows is None:
+                # unknown row count: leave containers untouched — the
+                # dispatcher path replicates list leaves, and recursing would
+                # pad ragged token rows along the TOKEN dimension
+                return x
+            # only a container with exactly one entry per row is row data; a
+            # short metadata list (e.g. label names) replicates untouched
+            return _wrap_pad_rows(x, target) if len(x) == rows else x
         x = _to_numpy(x)
-        if not isinstance(x, np.ndarray) or x.ndim == 0 or x.shape[0] >= target:
-            return x
-        reps = math.ceil(target / x.shape[0])
-        return np.concatenate([x] * reps, axis=0)[:target]
+        if (
+            rows is not None
+            and isinstance(x, np.ndarray)
+            and x.ndim > 0
+            and x.shape[0] != rows
+        ):
+            return x  # aux array (e.g. per-class weights): not batch rows
+        return _wrap_pad_rows(x, target)
 
-    return jax.tree_util.tree_map(_pad, batch)
+    return jax.tree_util.tree_map(
+        _pad, batch, is_leaf=lambda x: _is_row_container(x, rows)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -557,7 +749,7 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     def _prepare(self, batch):
         batch = batch_to_numpy(batch)
-        n = find_batch_size(batch)
+        n = _batch_size(batch)
         per_host = self.dp_size // jax.process_count()
         remainder = -1
         tail_layout = None
@@ -574,7 +766,7 @@ class DataLoaderShard(DataLoaderStateMixin):
             # recorded so gather_for_metrics can drop pads per host block.
             remainder = n * jax.process_count()
             tail_layout = (jax.process_count(), target, n)
-            batch = pad_batch_to(batch, target)
+            batch = pad_batch_to(batch, target, rows=n)
         if self.put_on_device:
             batch = make_global_batch(batch, self.mesh, self.batch_axes)
         return batch, remainder, tail_layout
@@ -681,7 +873,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             current, stop = self._fetch_and_broadcast(source)
             while not stop:
                 nxt, stop = self._fetch_and_broadcast(source)
-                n = find_batch_size(current)
+                n = _batch_size(current)
                 P = self.state.num_processes
                 remainder = -1
                 if n is not None and n % P != 0:
@@ -690,14 +882,31 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     # dispatcher pads at the GLOBAL tail, so plain [:n]
                     # truncation is correct (no per-host layout needed)
                     target = math.ceil(n / P) * P
-                    current = pad_batch_to(current, target)
+                    current = pad_batch_to(current, target, rows=n)
                     remainder = n
                     n = target
-                # slice this host's shard of the global batch
+                # slice this host's shard of the global batch: arrays and
+                # row containers with one entry per row slice; aux leaves
+                # (short metadata lists, per-class weight arrays) replicate.
+                # slice_tensors would recurse into ragged row lists and cut
+                # each ROW along its token dimension instead.
                 per_host = n // P if n else None
                 if per_host is not None and P > 1:
                     start = self.state.process_index * per_host
-                    local = slice_tensors(current, slice(start, start + per_host))
+                    sl = slice(start, start + per_host)
+                    rows_now = n
+
+                    def _shard(x):
+                        if _is_row_container(x, rows_now):
+                            return x[sl] if len(x) == rows_now else x
+                        if getattr(x, "ndim", 0) and x.shape[0] == rows_now:
+                            return x[sl]
+                        return x
+
+                    local = jax.tree_util.tree_map(
+                        _shard, current,
+                        is_leaf=lambda v: _is_row_container(v, rows_now),
+                    )
                 else:
                     local = current
                 if stop:

@@ -158,12 +158,13 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     v = repeat_kv(v, nh // nkv)
     backend = config.attention_backend
     if backend == "auto":
-        # the einsum path materializes [B,H,S,S] in HBM — fine to ~4k, then
-        # bandwidth-bound; the pallas flash kernel wins beyond that. Decode
-        # (kv_cache) and padded batches keep the mask-capable einsum path.
+        # the einsum path materializes [B,H,S,S] f32 scores in HBM and is
+        # bandwidth-bound from ~1k context; the pallas flash kernel measures
+        # >=2x faster from s=1024 on v5e (benchmarks/sweep_attn.py). Decode
+        # (kv_cache) keeps the mask-capable einsum path.
         on_tpu = jax.devices()[0].platform == "tpu"
         backend = (
-            "flash" if on_tpu and kv_cache is None and mask is None and s >= 4096
+            "flash" if on_tpu and kv_cache is None and s >= 1024
             else "einsum"
         )
     # flash/ring paths take no padding mask: use them only when there is none

@@ -35,6 +35,11 @@ _SUB = 8      # minimal lane width Mosaic accepts for a full-dim block: the
               # LSE rides as [BH, S, 8] (16x smaller than lane-broadcast)
 
 
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (0 for n < 1)."""
+    return 1 << (n.bit_length() - 1) if n >= 1 else 0
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
                   sm_scale: float, block_q: int, block_k: int,
                   num_k_blocks: int, with_lse: bool = False):
@@ -56,10 +61,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * sm_scale  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        # dots run on native (bf16) inputs with f32 accumulation: full MXU
+        # rate on v5e/v5p (f32 matmul is 4x slower); softmax state stays f32
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -73,7 +80,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -145,15 +152,17 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype (bf16) MXU dots with f32 accumulation; f32-only for
+        # the softmax state and elementwise math
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         # per-row state: lse block is (1, bq, 8) -> column [bq, 1]; delta
         # recomputed from O/dO blocks (cheap elementwise, no HBM buffer)
         lse = lse_ref[0][:, :1]
-        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
-                        keepdims=True)
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                        axis=-1, keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -165,7 +174,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_scr[...] += sm_scale * jnp.dot(
-            ds, k, preferred_element_type=jnp.float32)
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
@@ -188,13 +197,14 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # native-dtype (bf16) MXU dots with f32 accumulation
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
-        delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
-                        keepdims=True)
+        delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                        axis=-1, keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -209,9 +219,11 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         # (dot_general; MXU takes either operand order)
         contract_q = (((0,), (0,)), ((), ()))
         dv_scr[...] += jax.lax.dot_general(
-            p, do, contract_q, preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, contract_q,
+            preferred_element_type=jnp.float32)
         dk_scr[...] += sm_scale * jax.lax.dot_general(
-            ds, q, contract_q, preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, contract_q,
+            preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
@@ -310,42 +322,81 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """[B, S, H, D] flash attention, fused forward AND backward. Heads must
-    already be repeated (GQA: call models.common.repeat_kv first). Causal
-    self-attention runs the kernel at any length above one block (shorter or
-    non-block-multiple lengths are padded to a block multiple — causally
-    exact — or fall back to einsum attention below one block; non-causal /
-    cross-attention requires block-multiple lengths)."""
+    already be repeated (GQA: call models.common.repeat_kv first). Block
+    sizes are clamped to power-of-two divisors of the sequence where needed:
+    causal self-attention at a non-block-multiple length runs the kernel on
+    unpadded pow2-divisor blocks when they stay >= 256, else pads to a block
+    multiple (causally exact) and slices; non-causal shrinks blocks to the
+    largest pow2 divisor of the length. Only lengths whose usable block
+    would drop under 16 rows (Mosaic sublane floor) — e.g. s < 16, or
+    non-causal odd lengths — fall back to einsum attention.
+
+    Default blocks come from the v5e sweep (benchmarks/sweep_attn.py):
+    big blocks amortize pallas grid overhead — 512x1024 wins to ~2k context,
+    1024x1024 from 4k up (96.7 TF/s vs einsum's 18.2 at s=4096)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if block_q is None:
+        block_q = 1024 if sq >= 4096 else 512
+    if block_k is None:
+        block_k = 1024
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    if causal and sq == sk and (sq % block_q or sk % block_k) and sq > block_q:
-        # pad to a block multiple and slice the result: causally exact, since
-        # padded keys (index >= sq) are only visible to padded queries — the
-        # training loss slices inputs to S-1, which would otherwise dodge the
-        # kernel entirely
-        multiple = math.lcm(block_q, block_k)
-        target = -(-sq // multiple) * multiple
-        pad = target - sq
-        qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        out = flash_attention(qp, kp, vp, causal=True, block_q=block_q,
-                              block_k=block_k, interpret=interpret)
-        return out[:, :sq]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    # sq != sk would make the kernel's top-aligned causal mask disagree with
-    # the bottom-aligned reference (and read past the k buffer when sq > sk)
-    if sq % block_q or sk % block_k or (causal and sq != sk):
+    # clamp blocks to the sequence, rounded down to a power of two (>= 16 for
+    # Mosaic sublane tiling): an unaligned block (e.g. 300 rows after a plain
+    # min()) fails Mosaic lowering on real TPUs even though interpret-mode
+    # tests would pass, and a non-power-of-two block (e.g. 528) would make
+    # the lcm pad target below explode to ~32x the sequence
+    block_q = _pow2_floor(min(block_q, sq))
+    block_k = _pow2_floor(min(block_k, sk))
+
+    def _fallback():
         from ..models.common import dot_product_attention
 
         return dot_product_attention(q, k, v, causal=causal)
+
+    # sq != sk would make the kernel's top-aligned causal mask disagree with
+    # the bottom-aligned reference (and read past the k buffer when sq > sk)
+    if block_q < 16 or block_k < 16 or (causal and sq != sk):
+        return _fallback()
+    if sq % block_q or sk % block_k:
+        if causal:
+            # first preference: shrink to power-of-two divisor blocks and run
+            # unpadded — s=1280 runs at 256-blocks instead of padding to 2048
+            bq2, bk2 = min(block_q, sq & -sq), min(block_k, sk & -sk)
+            if bq2 >= 256 and bk2 >= 256:
+                block_q, block_k = bq2, bk2
+            else:
+                # pad to a block multiple and slice the result: causally
+                # exact, since padded keys (index >= sq) are only visible to
+                # padded queries — the training loss slices inputs to S-1,
+                # which would otherwise dodge the kernel entirely. Equal
+                # blocks keep the lcm (= block_q) and so the pad under one
+                # block's worth.
+                block_k = min(block_k, block_q)
+                multiple = math.lcm(block_q, block_k)
+                target = -(-sq // multiple) * multiple
+                pad = target - sq
+                qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                out = flash_attention(qp, kp, vp, causal=True,
+                                      block_q=block_q, block_k=block_k,
+                                      interpret=interpret)
+                return out[:, :sq]
+        else:
+            # non-causal can't pad (extra keys would get real softmax
+            # weight); shrink to the largest power-of-two divisor of the
+            # length so e.g. s=1920 (divisible by 128, not 512) still runs
+            block_q = min(block_q, sq & -sq)
+            block_k = min(block_k, sk & -sk)
+            if block_q < 16 or block_k < 16:
+                return _fallback()
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)

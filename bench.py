@@ -32,9 +32,9 @@ def main() -> None:
         cfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
-            max_position_embeddings=2048, remat=True,
+            max_position_embeddings=2048, remat=True, remat_policy="dots",
         )
-        batch, seq, steps = 16, 2048, 20
+        batch, seq, steps = 8, 2048, 20
     else:  # CPU smoke fallback so the bench always emits a line
         cfg = llama.LlamaConfig.tiny()
         batch, seq, steps = 4, 64, 3

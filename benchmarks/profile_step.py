@@ -1,0 +1,86 @@
+"""Decompose the bench step: fwd-only vs fwd+bwd vs full train step MFU."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.models.common import count_params
+from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
+from accelerate_tpu.training import cast_floating
+
+BATCH, SEQ, STEPS = 8, 2048, 20
+
+cfg = llama.LlamaConfig(
+    vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+    num_hidden_layers=12, num_attention_heads=12, num_key_value_heads=4,
+    max_position_embeddings=SEQ, remat=True, remat_policy="dots",
+)
+acc = Accelerator(mixed_precision="bf16", gradient_clipping=1.0)
+params = llama.init_params(cfg, jax.random.key(0))
+ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=optax.adamw(3e-4)))
+n_params = count_params(ts.params)
+rng = np.random.default_rng(0)
+ids = rng.integers(0, cfg.vocab_size, (BATCH, SEQ + 1)).astype(np.int32)
+loader = acc.prepare([{"input_ids": ids}])
+(batch_arrays,) = list(loader)
+
+device_kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+peak = next((v for k, v in TPU_PEAK_FLOPS.items() if k in device_kind), 197e12)
+attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * SEQ
+fwd_flops_tok = 2 * n_params + attn_flops // 3
+tot_flops_tok = 6 * n_params + attn_flops
+
+
+def timeit(name, fn, *args, flops_per_token):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    tok_s = BATCH * SEQ * STEPS / best
+    mfu = flops_per_token * tok_s / peak
+    print(f"{name:24s}: {best/STEPS*1000:8.1f} ms/step  "
+          f"eq-mfu={mfu:.4f}", flush=True)
+    return best / STEPS
+
+
+loss_fn = lambda p, b: llama.causal_lm_loss(cfg, p, b)
+
+fwd = jax.jit(lambda p, b: loss_fn(cast_floating(p, jnp.bfloat16), b))
+t_fwd = timeit("fwd only", fwd, ts.params, batch_arrays, flops_per_token=fwd_flops_tok)
+
+grad = jax.jit(jax.grad(lambda p, b: loss_fn(cast_floating(p, jnp.bfloat16), b)))
+t_bwd = timeit("fwd+bwd", grad, ts.params, batch_arrays, flops_per_token=tot_flops_tok)
+
+step = acc.train_step(loss_fn)
+
+
+def full(ts, b):
+    ts, m = step(ts, b)
+    return m["loss"]
+
+out = step(ts, batch_arrays)
+jax.block_until_ready(out[1]["loss"])
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    s = ts
+    for _ in range(STEPS):
+        s, m = step(s, batch_arrays)
+    jax.block_until_ready(m["loss"])
+    best = min(best, time.perf_counter() - t0)
+tok_s = BATCH * SEQ * STEPS / best
+print(f"{'full train step':24s}: {best/STEPS*1000:8.1f} ms/step  "
+      f"eq-mfu={tot_flops_tok * tok_s / peak:.4f}", flush=True)

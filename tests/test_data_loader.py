@@ -381,3 +381,365 @@ def test_split_mode_string_list_slices_by_row():
     (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
     (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
     assert got0["text"] == ["a", "b"] and got1["text"] == ["c", "d"]
+
+
+def test_split_mode_tuple_batch_slices_per_field():
+    """A tuple batch (inputs, labels) is pytree structure, not a row
+    container: every field slices row-wise on each rank (advisor r1 finding —
+    the old is_leaf matched the top-level tuple and sliced it element-wise)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [(np.arange(8, dtype=np.float32), np.arange(100, 108, dtype=np.int64))]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert isinstance(got0, tuple) and len(got0) == 2
+    np.testing.assert_array_equal(got0[0], np.arange(4, dtype=np.float32))
+    np.testing.assert_array_equal(got1[0], np.arange(4, 8, dtype=np.float32))
+    np.testing.assert_array_equal(got0[1], np.arange(100, 104))
+    np.testing.assert_array_equal(got1[1], np.arange(104, 108))
+
+
+def test_split_mode_top_level_string_list_slices_by_row():
+    """A batch that IS a list of strings stays a row container."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [["a", "b", "c", "d"]]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert got0 == ["a", "b"] and got1 == ["c", "d"]
+
+
+def test_stride_mode_short_midstream_batch_raises():
+    """Only the final batch may be short in stride mode: a short mid-stream
+    batch would silently inflate `remainder` (advisor r1 finding)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32)},
+               {"x": np.arange(2, dtype=np.float32)},  # short, not last
+               {"x": np.arange(4, dtype=np.float32)}]
+    it = ShardedBatchIterable(batches, 2, 0, even_batches=True)
+    with pytest.raises(ValueError, match="only the final batch"):
+        list(it)
+
+
+def test_split_mode_row_container_short_tail_pads():
+    """A short final row-container batch wraparound-pads like array batches
+    (code-review r2 finding: pad_batch_to skipped list leaves, so nonzero
+    ranks got empty shards)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [["a", "b", "c", "d"], ["e", "f"]]
+    it0 = ShardedBatchIterable(batches, 2, 0, split_batches=True)
+    it1 = ShardedBatchIterable(batches, 2, 1, split_batches=True)
+    got0, got1 = list(it0), list(it1)
+    # tail padded to 4 rows then split 2/2: real rows first, filler after,
+    # remainder=2 marks how many of the reassembled rows are real
+    assert got0[1] == ["e", "f"] and got1[1] == ["e", "f"]
+    assert it0.remainder == 2
+    assert (got0[1] + got1[1])[: it0.remainder] == ["e", "f"]
+
+
+def test_stride_mode_variable_sizes_ok_without_even_batches():
+    """even_batches=False never pads, so variable-size streams stay legal."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32)},
+               {"x": np.arange(6, dtype=np.float32)},
+               {"x": np.arange(4, dtype=np.float32)}]
+    got = list(ShardedBatchIterable(batches, 2, 0, even_batches=False))
+    assert [len(b["x"]) for b in got] == [4, 4]
+
+
+def test_split_mode_numpy_scalar_row_list_slices():
+    """A batch that is a list of numpy scalars slices per rank (code-review
+    r2: conversion to 0-d arrays used to defeat row-container detection and
+    replicate every row on every rank)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [[np.int64(1), np.int64(2), np.int64(3), np.int64(4)]]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert [int(x) for x in got0] == [1, 2]
+    assert [int(x) for x in got1] == [3, 4]
+
+
+def test_split_mode_zero_d_array_row_list_slices():
+    """A list of 0-d numpy arrays is a row container too."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32),
+                "y": [np.asarray(1), np.asarray(2), np.asarray(3), np.asarray(4)]}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert [int(v) for v in got0["y"]] == [1, 2]
+    assert [int(v) for v in got1["y"]] == [3, 4]
+
+
+def test_split_mode_oversized_batch_raises():
+    """Slicing an oversized mid-stream batch would silently drop rows."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32)},
+               {"x": np.arange(12, dtype=np.float32)}]
+    it = ShardedBatchIterable(batches, 2, 0, split_batches=True)
+    with pytest.raises(ValueError, match="may not grow"):
+        list(it)
+
+
+def test_split_mode_ragged_token_lists_slice_by_row():
+    """Ragged tokenizer output (list of lists / list of 1-D arrays) is a row
+    container: sliced by row, never along the token dimension."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32),
+                "y": [[1, 2], [3, 4, 5], [6], [7, 8]]}]
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert got1["y"] == [[6], [7, 8]]
+
+    batches = [[np.asarray([1, 2]), np.asarray([3, 4, 5]),
+                np.asarray([6]), np.asarray([7, 8])]]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    assert [t.tolist() for t in got0] == [[1, 2], [3, 4, 5]]
+
+
+def test_split_mode_collate_field_list_slices_per_field():
+    """A list of EQUAL-length 1-D arrays is torch default_collate's
+    [features, labels] field list — sliced per field, not treated as rows."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [[np.arange(8, dtype=np.float32), np.arange(100, 108)]]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    np.testing.assert_array_equal(got0[0], np.arange(4, dtype=np.float32))
+    np.testing.assert_array_equal(got1[0], np.arange(4, 8, dtype=np.float32))
+    np.testing.assert_array_equal(got0[1], np.arange(100, 104))
+    np.testing.assert_array_equal(got1[1], np.arange(104, 108))
+
+
+def test_split_mode_equal_length_ragged_rows_with_context():
+    """A list of equal-length 1-D arrays with one entry per batch row IS a
+    row container when the batch's row count says so (coincidentally-equal
+    ragged rows must not flip to field-slicing mid-stream)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32),
+                "y": [np.asarray([1, 2]), np.asarray([3, 4]),
+                      np.asarray([5, 6]), np.asarray([7, 8])]}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert [t.tolist() for t in got0["y"]] == [[1, 2], [3, 4]]
+    assert [t.tolist() for t in got1["y"]] == [[5, 6], [7, 8]]
+
+
+def test_split_mode_square_collate_pair_stays_fields():
+    """batch_rows == field_count == inner_length (the undecidable square
+    case) defaults to default_collate field structure: each field slices by
+    row instead of ranks receiving different fields."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [[np.arange(2, dtype=np.float32), np.arange(100, 102)]]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    np.testing.assert_array_equal(got0[0], [0.0])
+    np.testing.assert_array_equal(got0[1], [100])
+    np.testing.assert_array_equal(got1[0], [1.0])
+    np.testing.assert_array_equal(got1[1], [101])
+
+
+def test_split_mode_torch_tensor_ragged_rows():
+    """Torch-tensor ragged rows behave exactly like numpy rows."""
+    import torch
+
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(2, dtype=np.float32),
+                "y": [torch.tensor([1, 2, 3]), torch.tensor([4, 5])]},
+               ]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert [list(map(int, t)) for t in got0["y"]] == [[1, 2, 3]]
+    assert [list(map(int, t)) for t in got1["y"]] == [[4, 5]]
+
+
+def test_split_mode_ragged_key_sorts_first():
+    """Row count must come from the ragged row container even when its dict
+    key sorts before the array leaves (code-review r2: find_batch_size used
+    to return the first row's token count)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"ids": [np.asarray([1, 2, 3, 4]), np.asarray([5]),
+                        np.asarray([6, 7]), np.asarray([8, 9, 10]),
+                        np.asarray([11]), np.asarray([12, 13])],
+                "x": np.arange(6, dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert [t.tolist() for t in got0["ids"]] == [[1, 2, 3, 4], [5], [6, 7]]
+    assert [t.tolist() for t in got1["ids"]] == [[8, 9, 10], [11], [12, 13]]
+    np.testing.assert_array_equal(got0["x"], [0.0, 1.0, 2.0])
+
+
+def test_split_mode_equal_length_tail_keeps_row_classification():
+    """A short tail of equal-length token rows (token length == full batch
+    size) keeps its rows classification through pad + slice (code-review r2:
+    pad/slice used contradictory contexts and sliced along tokens)."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(4, dtype=np.float32),
+                "y": [[1, 2], [3, 4, 5], [6], [7, 8]]},
+               {"x": np.arange(2, dtype=np.float32),
+                "y": [np.asarray([1, 2, 3, 4]), np.asarray([5, 6, 7, 8])]}]
+    it0 = ShardedBatchIterable(batches, 2, 0, split_batches=True)
+    it1 = ShardedBatchIterable(batches, 2, 1, split_batches=True)
+    got0, got1 = list(it0), list(it1)
+    # tail rows wraparound-padded to 4 then split 2/2 as whole rows
+    assert [t.tolist() for t in got0[1]["y"]] == [[1, 2, 3, 4], [5, 6, 7, 8]]
+    assert [t.tolist() for t in got1[1]["y"]] == [[1, 2, 3, 4], [5, 6, 7, 8]]
+    assert it0.remainder == 2
+
+
+def test_split_mode_ambiguous_list_key_order_independent():
+    """An ambiguous equal-length 1-D list must not hijack the batch size
+    even when its key sorts first; unambiguous array leaves win."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"a_ids": [np.asarray([1, 2]), np.asarray([3, 4]),
+                          np.asarray([5, 6]), np.asarray([7, 8])],
+                "z": np.arange(4, dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    np.testing.assert_array_equal(got0["z"], [0.0, 1.0])
+    np.testing.assert_array_equal(got1["z"], [2.0, 3.0])
+    assert [t.tolist() for t in got0["a_ids"]] == [[1, 2], [3, 4]]
+    assert [t.tolist() for t in got1["a_ids"]] == [[5, 6], [7, 8]]
+
+
+def test_split_mode_empty_container_leaf_ignored():
+    """An empty list leaf must not zero out the batch size."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"empty": [], "x": np.arange(4, dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    np.testing.assert_array_equal(got0["x"], [0.0, 1.0])
+    assert got0["empty"] == []
+
+
+def test_split_mode_short_metadata_list_does_not_hijack_size():
+    """A short metadata string list must not override array leading-dim
+    evidence for the batch size."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"class_names": ["pos", "neg"],
+                "x": np.zeros((8, 2), dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert got0["x"].shape == (4, 2) and got1["x"].shape == (4, 2)
+
+
+def test_stride_mode_short_array_with_metadata_list_pads():
+    """Stride mode's tail padding keys off the short ARRAY rows, not a
+    same-length metadata list."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": np.arange(8, dtype=np.float32),
+                "names": ["a"] * 8},
+               {"x": np.arange(4, dtype=np.float32),
+                "names": ["a"] * 8}]
+    it0 = ShardedBatchIterable(batches, 2, 0, even_batches=True)
+    it1 = ShardedBatchIterable(batches, 2, 1, even_batches=True)
+    got0, got1 = list(it0), list(it1)
+    assert got0[0]["x"].shape[0] == 8
+    assert got1[0]["x"].shape[0] == 8  # padded from 4 to 8
+
+
+def test_split_mode_short_metadata_list_replicates_untouched():
+    """A metadata list shorter than the batch must replicate unmodified —
+    not be wraparound-extended into fake rows."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"class_names": ["pos", "neg"],
+                "x": np.zeros((8, 2), dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    assert got0["class_names"] == ["pos", "neg"]
+    assert got1["class_names"] == ["pos", "neg"]
+
+
+def test_dispatcher_list_leaves_pass_through_unpadded():
+    """DispatcherLoader pads arrays only; list leaves replicate unchanged
+    (slice_tensors never slices lists, so padding them would leak filler)."""
+    from accelerate_tpu.data import DataLoaderDispatcher
+
+    names = [f"n{i}" for i in range(10)]
+    loader = DataLoaderDispatcher(
+        [{"x": np.arange(10, dtype=np.float32), "names": names}])
+    (batch,) = list(loader)
+    assert batch["names"] == names
+
+
+def test_split_mode_aux_array_replicates():
+    """An auxiliary array whose leading dim is not the batch size (e.g.
+    per-class weights) replicates instead of being tiled into fake rows."""
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"a_x": np.zeros((8, 2), dtype=np.float32),
+                "z_w": np.asarray([0.2, 0.3, 0.5], dtype=np.float32)}]
+    (got0,) = list(ShardedBatchIterable(batches, 2, 0, split_batches=True))
+    np.testing.assert_array_equal(
+        got0["z_w"], np.asarray([0.2, 0.3, 0.5], dtype=np.float32)
+    )
+    assert got0["a_x"].shape == (4, 2)
+
+
+def test_split_mode_torch_array_leaves():
+    """Torch-collated batches measure and slice like numpy ones."""
+    import torch
+
+    from accelerate_tpu.data import ShardedBatchIterable
+
+    batches = [{"x": torch.arange(8).reshape(8, 1)}]
+    (got1,) = list(ShardedBatchIterable(batches, 2, 1, split_batches=True))
+    np.testing.assert_array_equal(np.asarray(got1["x"]).ravel(), [4, 5, 6, 7])
+
+
+def test_shard_loader_ragged_rows_not_token_padded():
+    """DataLoaderShard tail padding pads ragged row LISTS by rows, never each
+    row along the token dimension."""
+    from accelerate_tpu.data import pad_batch_to
+
+    batch = {"x": np.arange(10, dtype=np.float32),
+             "ids": [np.asarray([1, 2, 3]), np.asarray([4, 5])] * 5}
+    out = pad_batch_to(batch, 12, rows=10)
+    assert len(out["ids"]) == 12
+    assert out["ids"][0].tolist() == [1, 2, 3]
+    assert out["x"].shape[0] == 12
+    # without a known row count, containers stay untouched entirely
+    out2 = pad_batch_to(batch, 12)
+    assert len(out2["ids"]) == 10
+    assert out2["ids"][1].tolist() == [4, 5]
+
+
+def test_dispatcher_ragged_rows_slice_by_row(monkeypatch):
+    """Dispatcher sharding slices ragged row lists by ROW (never along the
+    token dim) and replicates aux leaves."""
+    from accelerate_tpu import data as data_mod
+    from accelerate_tpu.data import DataLoaderDispatcher
+
+    ids = [np.asarray([1, 2, 3]), np.asarray([4, 5]), np.asarray([6]),
+           np.asarray([7, 8, 9]), np.asarray([10]), np.asarray([11, 12])]
+    batch = {"ids": ids, "x": np.arange(6, dtype=np.float32)}
+
+    class FakeState:
+        num_processes = 2
+        process_index = 1
+        is_main_process = True
+
+    loader = DataLoaderDispatcher([batch], put_on_device=False)
+    monkeypatch.setattr(loader, "state", FakeState())
+    def fake_fetch(source):
+        item = next(source, None)
+        return (item, item is None)
+
+    monkeypatch.setattr(loader, "_fetch_and_broadcast", fake_fetch)
+    (got,) = list(loader)
+    assert [t.tolist() for t in got["ids"]] == [[7, 8, 9], [10], [11, 12]]
+    np.testing.assert_array_equal(got["x"], [3.0, 4.0, 5.0])

@@ -58,10 +58,19 @@ def test_flash_attention_gradients_match():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3)
 
 
-def test_flash_attention_irregular_length_fallback():
+def test_flash_attention_irregular_length_pads():
     q, k, v = make_qkv(jax.random.key(3), s=50)
     ref = dot_product_attention(q, k, v, causal=True)
-    out = flash_attention(q, k, v, causal=True)  # 50 % 128 != 0 -> fallback
+    # 50 -> block 32 (pow2 floor), padded to 64, kernel runs causally exact
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_tiny_length_einsum_fallback():
+    q, k, v = make_qkv(jax.random.key(3), s=12)
+    ref = dot_product_attention(q, k, v, causal=True)
+    # 12 -> pow2 floor 8 < 16 (Mosaic sublane minimum) -> einsum fallback
+    out = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
