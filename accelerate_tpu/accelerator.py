@@ -231,6 +231,10 @@ class Accelerator:
             return jnp.bfloat16
         if self.state.mixed_precision == PrecisionType.FP16:
             return jnp.float16
+        if self.state.mixed_precision == PrecisionType.FP8:
+            # fp8 is a matmul-level format (fp8_dense inside the model);
+            # everything else — norms, softmax, residuals — runs bf16
+            return jnp.bfloat16
         return jnp.float32
 
     @property
@@ -450,6 +454,16 @@ class Accelerator:
         """Jitted value_and_grad with the mixed-precision policy applied —
         the functional stand-in for `loss.backward()` (ref :2093). Returns
         (loss, grads) or ((loss, aux), grads)."""
+        if self.state.mixed_precision == PrecisionType.FP8:
+            # the eager path has nowhere to thread the delayed-scaling metas;
+            # running it in bf16 would silently drop the fp8 the user asked
+            # for
+            raise NotImplementedError(
+                "mixed_precision='fp8' requires the fused "
+                "accelerator.train_step() path (it threads Fp8Meta state "
+                "through TrainState); the eager compute_gradients/backward "
+                "chain does not support fp8."
+            )
         fn = self._grad_fn_cache_get(loss_fn, has_aux)
         return fn(params, *batch)
 
@@ -545,6 +559,25 @@ class Accelerator:
             max_grad_norm if max_grad_norm is not None else self.gradient_clipping
         )
         use_scale = self.state.mixed_precision == PrecisionType.FP16
+        use_fp8 = self.state.mixed_precision == PrecisionType.FP8
+        if use_fp8:
+            import inspect
+
+            try:
+                sig_params = inspect.signature(loss_fn).parameters
+            except (TypeError, ValueError):
+                sig_params = {}
+            accepts_fp8 = "fp8_state" in sig_params or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in sig_params.values()
+            )
+            if not accepts_fp8:
+                raise ValueError(
+                    "mixed_precision='fp8' needs a loss_fn that accepts an "
+                    "fp8_state kwarg and returns (loss, new_fp8_state) — e.g. "
+                    "models.llama.causal_lm_loss. fp8 never silently degrades "
+                    "to full precision."
+                )
 
         def step_fn(state: TrainState, *batch):
             if use_scale and state.loss_scale is None:
@@ -558,21 +591,45 @@ class Accelerator:
                     "gradient_accumulation_steps>1 needs TrainState.create("
                     "use_grad_accum_buffer=True)"
                 )
+            if use_fp8 and state.fp8_state is None:
+                raise ValueError(
+                    "mixed_precision='fp8' needs delayed-scaling state: create "
+                    "it with TrainState.create(fp8_state=model.init_fp8_state("
+                    "config)) — e.g. models.llama.init_fp8_state. fp8 never "
+                    "silently degrades to full precision."
+                )
 
             def compute_loss(params):
                 # bf16 policy casts float inputs too (lax convs/dots require
                 # matching dtypes). fp16 keeps inputs fp32: targets can
                 # overflow fp16's range, and jnp promotion handles the mix.
+                # fp8 runs the non-matmul compute in bf16; the fp8 casts
+                # happen inside the model's fp8_dense calls.
                 cast_batch = batch
                 if dtype == jnp.bfloat16:
                     cast_batch = tuple(cast_floating(b, dtype) for b in batch)
+                if use_fp8:
+                    out = loss_fn(
+                        cast_floating(params, dtype), *cast_batch,
+                        fp8_state=state.fp8_state,
+                    )
+                    if has_aux:
+                        loss, aux, new_fp8 = out
+                    else:
+                        loss, new_fp8 = out
+                        aux = None
+                    return loss, (loss, aux, new_fp8)
                 out = loss_fn(cast_floating(params, dtype), *cast_batch)
                 loss = out[0] if has_aux else out
                 aux = out[1] if has_aux else None
                 scaled = loss * state.loss_scale.scale if use_scale else loss
-                return scaled, (loss, aux)
+                return scaled, (loss, aux, None)
 
-            grads, (loss, aux) = jax.grad(compute_loss, has_aux=True)(state.params)
+            grads, (loss, aux, new_fp8) = jax.grad(compute_loss, has_aux=True)(state.params)
+            if use_fp8:
+                # metas updated every micro-step (amax history is per-step
+                # statistics, independent of the accumulation boundary)
+                state = dataclasses.replace(state, fp8_state=new_fp8)
             if use_scale:
                 grads = jax.tree_util.tree_map(
                     lambda g: g / state.loss_scale.scale, grads

@@ -129,13 +129,28 @@ def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     return params
 
 
+def _dense_maybe_fp8(x, kernel, meta):
+    """te.Linear-style swap point: with an Fp8Meta pair the projection runs
+    in fp8 (ops/fp8.py, replacing ref utils/transformer_engine.py:24-84);
+    otherwise the ordinary bf16/f32 dense."""
+    if meta is None:
+        return dense(x, kernel), None
+    from ..ops.fp8 import fp8_dense
+
+    return fp8_dense(x, kernel, meta)
+
+
 def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
-               kv_cache=None):
+               kv_cache=None, fp8=None):
     b, s, h = x.shape
     nh, nkv, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
-    q = dense(x, layer["attn"]["q_proj"]["kernel"]).reshape(b, s, nh, hd)
-    k = dense(x, layer["attn"]["k_proj"]["kernel"]).reshape(b, s, nkv, hd)
-    v = dense(x, layer["attn"]["v_proj"]["kernel"]).reshape(b, s, nkv, hd)
+    fa = fp8["attn"] if fp8 is not None else {}
+    q, mq = _dense_maybe_fp8(x, layer["attn"]["q_proj"]["kernel"], fa.get("q_proj"))
+    k, mk = _dense_maybe_fp8(x, layer["attn"]["k_proj"]["kernel"], fa.get("k_proj"))
+    v, mv = _dense_maybe_fp8(x, layer["attn"]["v_proj"]["kernel"], fa.get("v_proj"))
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nkv, hd)
+    v = v.reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     new_cache = None
@@ -183,26 +198,50 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     else:
         out = dot_product_attention(q, k, v, mask=mask, causal=causal)
     out = out.reshape(b, s, nh * hd)
-    return dense(out, layer["attn"]["o_proj"]["kernel"]), new_cache
+    o, mo = _dense_maybe_fp8(out, layer["attn"]["o_proj"]["kernel"],
+                             fa.get("o_proj"))
+    new_fp8 = (
+        {"q_proj": mq, "k_proj": mk, "v_proj": mv, "o_proj": mo}
+        if fp8 is not None else None
+    )
+    return o, new_cache, new_fp8
 
 
-def _mlp(layer: dict, x):
-    gate = jax.nn.silu(dense(x, layer["mlp"]["gate_proj"]["kernel"]))
-    up = dense(x, layer["mlp"]["up_proj"]["kernel"])
-    return dense(gate * up, layer["mlp"]["down_proj"]["kernel"])
+def _mlp(layer: dict, x, fp8=None):
+    fm = fp8["mlp"] if fp8 is not None else {}
+    gate, mg = _dense_maybe_fp8(x, layer["mlp"]["gate_proj"]["kernel"],
+                                fm.get("gate_proj"))
+    up, mu = _dense_maybe_fp8(x, layer["mlp"]["up_proj"]["kernel"],
+                              fm.get("up_proj"))
+    down, md = _dense_maybe_fp8(jax.nn.silu(gate) * up,
+                                layer["mlp"]["down_proj"]["kernel"],
+                                fm.get("down_proj"))
+    new_fp8 = (
+        {"gate_proj": mg, "up_proj": mu, "down_proj": md}
+        if fp8 is not None else None
+    )
+    return down, new_fp8
 
 
 def _layer_body(config: LlamaConfig, x, layer, cos, sin, positions, mask,
-                kv_cache=None):
-    attn_out, new_cache = _attention(
+                kv_cache=None, fp8=None):
+    attn_out, new_cache, fp8_attn = _attention(
         config, layer,
         rms_norm(x, layer["input_layernorm"]["scale"], config.rms_norm_eps),
-        cos, sin, positions, mask, kv_cache,
+        cos, sin, positions, mask, kv_cache, fp8,
     )
     x = x + attn_out
-    x = x + _mlp(layer, rms_norm(x, layer["post_attention_layernorm"]["scale"],
-                                 config.rms_norm_eps))
-    return x, new_cache
+    mlp_out, fp8_mlp = _mlp(
+        layer,
+        rms_norm(x, layer["post_attention_layernorm"]["scale"],
+                 config.rms_norm_eps),
+        fp8,
+    )
+    x = x + mlp_out
+    new_fp8 = (
+        {"attn": fp8_attn, "mlp": fp8_mlp} if fp8 is not None else None
+    )
+    return x, new_cache, new_fp8
 
 
 def forward(
@@ -213,13 +252,19 @@ def forward(
     positions: jax.Array | None = None,
     kv_caches: Any = None,
     return_hidden: bool = False,
+    fp8_state: Any = None,
 ) -> jax.Array | tuple:
     """Logits [B, S, V]; with kv_caches, returns (logits, new_caches);
     with `return_hidden`, the final normed hidden states [B, S, H] instead
-    of logits (the chunked-loss path projects them itself)."""
+    of logits (the chunked-loss path projects them itself). With
+    `fp8_state` (see `init_fp8_state`), layer projections run in fp8 and the
+    result is (out, new_fp8_state)."""
     if return_hidden and kv_caches is not None:
         raise ValueError("return_hidden is not supported on the decode "
                          "(kv_caches) path")
+    if fp8_state is not None and kv_caches is not None:
+        raise ValueError("fp8 is a training-path feature; decode "
+                         "(kv_caches) runs bf16")
     x = params["embed_tokens"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(
@@ -238,8 +283,8 @@ def forward(
         new_caches = []
         for i in range(config.num_hidden_layers):
             layer = jax.tree_util.tree_map(lambda p: p[i], params["layers"])
-            x, cache = _layer_body(config, x, layer, cos, sin, positions,
-                                   attention_mask, kv_caches[i])
+            x, cache, _ = _layer_body(config, x, layer, cos, sin, positions,
+                                      attention_mask, kv_caches[i])
             new_caches.append(cache)
         x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
         logits = _project_out(config, params, x)
@@ -247,9 +292,22 @@ def forward(
 
     body = partial(_layer_body, config)
 
-    def scan_body(carry, layer):
-        y, _ = body(carry, layer, cos, sin, positions, attention_mask)
-        return y, None
+    if fp8_state is not None:
+        # per-layer metas ride the scan as xs; updated metas stack back on
+        # the layer dim as ys — fp8 state threads like optimizer state
+        def scan_body(carry, xs):
+            layer, fp8_layer = xs
+            y, _, new_fp8 = body(carry, layer, cos, sin, positions,
+                                 attention_mask, fp8=fp8_layer)
+            return y, new_fp8
+
+        scan_xs = (params["layers"], fp8_state["layers"])
+    else:
+        def scan_body(carry, layer):
+            y, _, _ = body(carry, layer, cos, sin, positions, attention_mask)
+            return y, None
+
+        scan_xs = params["layers"]
 
     if config.remat:
         # "dots" keeps MXU outputs resident and recomputes only cheap
@@ -264,11 +322,13 @@ def forward(
             if config.remat_policy == "dots" else None
         )
         scan_body = jax.checkpoint(scan_body, prevent_cse=False, policy=policy)
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x, scan_ys = jax.lax.scan(scan_body, x, scan_xs)
+    new_fp8_state = {"layers": scan_ys} if fp8_state is not None else None
     x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
     if return_hidden:
-        return x
-    return _project_out(config, params, x)
+        return (x, new_fp8_state) if fp8_state is not None else x
+    out = _project_out(config, params, x)
+    return (out, new_fp8_state) if fp8_state is not None else out
 
 
 def forward_offloaded(
@@ -322,14 +382,19 @@ def _project_out(config: LlamaConfig, params: dict, x):
 
 
 def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
-                   loss_chunk_size: int | None = None) -> jax.Array:
+                   loss_chunk_size: int | None = None,
+                   fp8_state: Any = None) -> jax.Array | tuple:
     """Next-token loss over a batch {input_ids, attention_mask?}.
 
     Large vocab x long sequence makes the [B, S, V] f32 logits the single
     biggest buffer of the step (e.g. 16 x 2048 x 32000 f32 = 4.2 GB). When
     S divides into `loss_chunk_size` chunks (auto-picked so a chunk's logits
     stay ~256 MB), the projection + cross-entropy run under `lax.scan` per
-    chunk and the full logits never exist."""
+    chunk and the full logits never exist.
+
+    With `fp8_state` (mixed_precision="fp8"), layer projections run fp8 and
+    the return is (loss, new_fp8_state) — the fused train step threads it
+    through TrainState.fp8_state."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
     mask = batch.get("attention_mask")
@@ -341,11 +406,16 @@ def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
         loss_chunk_size = max(1, budget // max(1, B * config.vocab_size))
     chunk = _pick_chunk(S, loss_chunk_size)
     if chunk is None or chunk >= S:
-        logits = forward(config, params, input_ids[:, :-1], attention_mask=None)
-        return cross_entropy_loss(logits, labels, mask)
+        out = forward(config, params, input_ids[:, :-1], attention_mask=None,
+                      fp8_state=fp8_state)
+        if fp8_state is not None:
+            logits, new_fp8 = out
+            return cross_entropy_loss(logits, labels, mask), new_fp8
+        return cross_entropy_loss(out, labels, mask)
 
-    hidden = forward(config, params, input_ids[:, :-1], attention_mask=None,
-                     return_hidden=True)
+    out = forward(config, params, input_ids[:, :-1], attention_mask=None,
+                  return_hidden=True, fp8_state=fp8_state)
+    hidden, new_fp8 = out if fp8_state is not None else (out, None)
     n = S // chunk
     h_chunks = hidden.reshape(B, n, chunk, -1).transpose(1, 0, 2, 3)
     l_chunks = labels.reshape(B, n, chunk).transpose(1, 0, 2)
@@ -366,7 +436,8 @@ def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
     (loss_sum, count), _ = jax.lax.scan(
         body, (jnp.float32(0.0), jnp.float32(0.0)), (h_chunks, l_chunks, m_chunks)
     )
-    return loss_sum / jnp.maximum(count, 1)
+    loss = loss_sum / jnp.maximum(count, 1)
+    return (loss, new_fp8) if fp8_state is not None else loss
 
 
 def _pick_chunk(S: int, target: int) -> int | None:
@@ -386,6 +457,36 @@ def _pick_chunk(S: int, target: int) -> int | None:
     if best is None or best < max(1, target // 8):
         return None
     return best
+
+
+def init_fp8_state(config: LlamaConfig, history_len: int = 16) -> dict:
+    """Per-layer delayed-scaling metas for every layer projection, stacked on
+    the layer dim so they ride the forward's `lax.scan` (the functional
+    analogue of transformer-engine's per-module buffers, ref
+    utils/transformer_engine.py:24-84). Pass to
+    `TrainState.create(fp8_state=...)` and train with
+    `Accelerator(mixed_precision="fp8")`."""
+    from ..ops.fp8 import Fp8Meta
+
+    L = config.num_hidden_layers
+
+    def stacked():
+        # fresh arrays per role: shared buffers would be donated twice by
+        # the fused train step
+        return Fp8Meta(
+            scale=jnp.ones((L,), jnp.float32),
+            amax_history=jnp.zeros((L, history_len), jnp.float32),
+        )
+
+    def pair():
+        return {"x": stacked(), "w": stacked()}
+
+    return {
+        "layers": {
+            "attn": {k: pair() for k in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "mlp": {k: pair() for k in ("gate_proj", "up_proj", "down_proj")},
+        }
+    }
 
 
 def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16):

@@ -225,7 +225,7 @@ def forward(
 
     def scan_body(carry, layer):
         x, aux_sum = carry
-        attn_out, _ = _attention(
+        attn_out, _, _ = _attention(
             lcfg, layer,
             rms_norm(x, layer["input_layernorm"]["scale"], config.rms_norm_eps),
             cos, sin, positions, attention_mask,

@@ -58,9 +58,7 @@ def update_meta(meta: Fp8Meta, amax: jax.Array, fmt: str = "E4M3",
 
 def fp8_cast(x: jax.Array, meta: Fp8Meta, fmt: str = "E4M3") -> jax.Array:
     dtype = jnp.float8_e4m3fn if fmt.upper() == "E4M3" else jnp.float8_e5m2
-    fmax = _fmt_max(fmt)
-    scaled = jnp.clip(x.astype(jnp.float32) * meta.scale, -fmax, fmax)
-    return scaled.astype(dtype)
+    return _cast8(x, meta.scale, dtype, _fmt_max(fmt))
 
 
 def fp8_dot(
@@ -84,6 +82,71 @@ def fp8_dot(
     x_meta = update_meta(x_meta, jnp.max(jnp.abs(x)), fmt, margin)
     w_meta = update_meta(w_meta, jnp.max(jnp.abs(w)), fmt, margin)
     return out.astype(out_dtype), x_meta, w_meta
+
+
+def _cast8(t: jax.Array, scale: jax.Array, dtype, fmax: float) -> jax.Array:
+    return jnp.clip(t.astype(jnp.float32) * scale, -fmax, fmax).astype(dtype)
+
+
+@jax.custom_vjp
+def _fp8_matmul(x, w, x_scale, w_scale):
+    """x[..., D] @ w[D, O]: E4M3 forward with the given delayed scales,
+    E5M2 current-scaled backward (grad scale derived from the live grad
+    inside the vjp, so no cross-step grad state is needed)."""
+    return _fp8_matmul_fwd(x, w, x_scale, w_scale)[0]
+
+
+def _fp8_matmul_fwd(x, w, x_scale, w_scale):
+    x8 = _cast8(x, x_scale, jnp.float8_e4m3fn, E4M3_MAX)
+    w8 = _cast8(w, w_scale, jnp.float8_e4m3fn, E4M3_MAX)
+    out = jnp.dot(
+        x8, w8, preferred_element_type=jnp.float32
+    ) / (x_scale * w_scale)
+    return out.astype(jnp.bfloat16), (x8, w8, x_scale, w_scale)
+
+
+def _fp8_matmul_bwd(res, g):
+    x8, w8, x_scale, w_scale = res
+    amax_g = jnp.max(jnp.abs(g)).astype(jnp.float32)
+    g_scale = jnp.where(amax_g > 0.0, E5M2_MAX / amax_g, 1.0)
+    g8 = _cast8(g, g_scale, jnp.float8_e5m2, E5M2_MAX)
+    dx = jnp.dot(
+        g8, w8.T, preferred_element_type=jnp.float32
+    ) / (g_scale * w_scale)
+    g2 = g8.reshape(-1, g8.shape[-1])
+    x2 = x8.reshape(-1, x8.shape[-1])
+    dw = jnp.dot(
+        x2.T, g2, preferred_element_type=jnp.float32
+    ) / (x_scale * g_scale)
+    return (
+        dx.astype(jnp.bfloat16),
+        dw.astype(jnp.bfloat16),
+        jnp.zeros_like(x_scale),
+        jnp.zeros_like(w_scale),
+    )
+
+
+_fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
+def fp8_dense(
+    x: jax.Array,
+    kernel: jax.Array,
+    meta: dict,
+    margin: int = 0,
+) -> tuple[jax.Array, dict]:
+    """The te.Linear replacement used inside models: x @ kernel with E4M3
+    delayed scaling on both operands (ref utils/transformer_engine.py:24-84
+    swaps nn.Linear for te.Linear; here the dense call itself swaps). Takes
+    and returns {'x': Fp8Meta, 'w': Fp8Meta}; thread it through the train
+    step like optimizer state. Backward runs E5M2 with current scaling."""
+    out = _fp8_matmul(x, kernel, meta["x"].scale, meta["w"].scale)
+    stop = jax.lax.stop_gradient
+    new_meta = {
+        "x": update_meta(meta["x"], stop(jnp.max(jnp.abs(x))).astype(jnp.float32), "E4M3", margin),
+        "w": update_meta(meta["w"], stop(jnp.max(jnp.abs(kernel))).astype(jnp.float32), "E4M3", margin),
+    }
+    return out, new_meta
 
 
 def init_fp8_state(params, recipe: FP8RecipeKwargs | None = None):

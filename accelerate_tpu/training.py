@@ -66,6 +66,9 @@ class TrainState:
     loss_scale: DynamicLossScale | None
     apply_fn: Callable = dataclasses.field(metadata={"static": True})
     tx: optax.GradientTransformation = dataclasses.field(metadata={"static": True})
+    # fp8 delayed-scaling metas (ops/fp8.py), threaded through the fused
+    # step like optimizer state when mixed_precision="fp8"
+    fp8_state: Any = None
 
     @classmethod
     def create(
@@ -76,6 +79,7 @@ class TrainState:
         tx: optax.GradientTransformation,
         use_grad_accum_buffer: bool = False,
         use_loss_scale: bool = False,
+        fp8_state: Any = None,
     ) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
@@ -89,6 +93,7 @@ class TrainState:
             loss_scale=DynamicLossScale.create() if use_loss_scale else None,
             apply_fn=apply_fn,
             tx=tx,
+            fp8_state=fp8_state,
         )
 
     def apply_gradients(self, grads: Any) -> "TrainState":

@@ -226,3 +226,126 @@ def test_context_parallel_plugin_validates_mode():
     with pytest.raises(ValueError, match="mode"):
         ContextParallelPlugin(mode="allgather")
     assert ContextParallelPlugin(mode="ulysses").mode == "ulysses"
+
+
+# -- fp8 end-to-end path ------------------------------------------------------
+
+
+def test_fp8_dense_matches_f32_forward_and_grad():
+    from accelerate_tpu.ops.fp8 import fp8_dense, init_fp8_state
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (4, 32), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (32, 16), jnp.float32) * 0.1
+    meta = {"x": Fp8Meta.init(), "w": Fp8Meta.init()}
+
+    def loss8(x, w):
+        out, _ = fp8_dense(x, w, meta)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss32(x, w):
+        return jnp.sum(jnp.dot(x, w) ** 2)
+
+    g8 = jax.grad(loss8, argnums=(0, 1))(x, w)
+    g32 = jax.grad(loss32, argnums=(0, 1))(x, w)
+    for a, b in zip(g8, g32):
+        a, b = np.asarray(a, np.float32), np.asarray(b)
+        # norm-relative: per-element fp8 noise is large on tiny entries, but
+        # the gradient direction/magnitude must match closely
+        rel = np.linalg.norm(a - b) / np.linalg.norm(b)
+        assert rel < 0.1, rel
+
+
+def test_fp8_dense_updates_meta():
+    from accelerate_tpu.ops.fp8 import fp8_dense
+
+    x = jnp.ones((2, 8)) * 3.0
+    w = jnp.ones((8, 4)) * 0.5
+    meta = {"x": Fp8Meta.init(), "w": Fp8Meta.init()}
+    _, new_meta = fp8_dense(x, w, meta)
+    assert float(new_meta["x"].amax_history[0]) == 3.0
+    assert float(new_meta["w"].amax_history[0]) == 0.5
+    assert float(new_meta["x"].scale) != 1.0
+
+
+def test_llama_fp8_train_step_converges():
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    cfg = llama.LlamaConfig.tiny()
+    acc = Accelerator(mixed_precision="fp8")
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(5e-3),
+        fp8_state=llama.init_fp8_state(cfg),
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 33)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: llama.causal_lm_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    losses = []
+    for _ in range(12):
+        ts, m = step(ts, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+    # delayed-scaling state actually updated
+    scale = ts.fp8_state["layers"]["attn"]["q_proj"]["x"].scale
+    assert scale.shape == (cfg.num_hidden_layers,)
+    assert not np.allclose(np.asarray(scale), 1.0)
+
+
+def test_fp8_without_state_hard_errors():
+    import optax
+    import pytest
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    cfg = llama.LlamaConfig.tiny()
+    acc = Accelerator(mixed_precision="fp8")
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = TrainState.create(apply_fn=None, params=params, tx=optax.sgd(1e-3))
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: llama.causal_lm_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    batch = {"input_ids": jnp.zeros((2, 9), jnp.int32)}
+    with pytest.raises(ValueError, match="fp8"):
+        step(ts, batch)
+
+
+def test_fp8_loss_fn_without_kwarg_hard_errors():
+    import pytest
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="fp8")
+    with pytest.raises(ValueError, match="fp8"):
+        acc.train_step(lambda p, b: jnp.float32(0.0))
+
+
+def test_fp8_eager_path_hard_errors():
+    import pytest
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="fp8")
+    with pytest.raises(NotImplementedError, match="fp8"):
+        acc.compute_gradients(lambda p: jnp.float32(0.0), {})
