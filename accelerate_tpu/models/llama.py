@@ -182,11 +182,14 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
             "flash" if on_tpu and kv_cache is None and s >= 1024
             else "einsum"
         )
-    # flash/ring paths take no padding mask: use them only when there is none
-    if backend == "flash" and kv_cache is None and mask is None:
+    # flash takes key-padding masks ([B, S]) natively; ring/ulysses still
+    # require mask-free batches
+    if backend == "flash" and kv_cache is None and (
+        mask is None or getattr(mask, "ndim", 0) == 2
+    ):
         from ..ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, mask=mask)
     elif backend == "ring" and kv_cache is None and mask is None:
         from ..parallel.ring_attention import ring_attention
 

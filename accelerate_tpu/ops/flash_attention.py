@@ -40,13 +40,18 @@ def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1) if n >= 1 else 0
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
                   sm_scale: float, block_q: int, block_k: int,
-                  num_k_blocks: int, with_lse: bool = False):
+                  num_k_blocks: int, with_lse: bool = False,
+                  with_mask: bool = False):
+    if with_mask:
+        mask_ref, o_ref, *rest = rest
+    else:
+        mask_ref, (o_ref, *rest) = None, rest
     if with_lse:
         lse_ref, m_scr, l_scr, acc_scr = rest
     else:
-        lse_ref, (m_scr, l_scr, acc_scr) = None, rest
+        (lse_ref,), (m_scr, l_scr, acc_scr) = (None,), rest
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -73,10 +78,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            # mask block is [1, SUB, bk]; one sublane row broadcasts over bq
+            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
         m_prev = m_scr[...][:, :1]  # [bq, 1]
         l_prev = l_scr[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if mask_ref is not None:
+            # a fully-masked row keeps m_new at NEG_INF, where exp(s - m_new)
+            # would be exp(0)=1 per masked key — zero those explicitly
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
@@ -90,14 +102,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
         o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp per row, lane-broadcast (the TPU-friendly layout the
-            # backward kernels read without transposes)
+            # backward kernels read without transposes). Fully-masked rows
+            # (l == 0) pin lse to 0 so the backward's exp(s - lse) stays 0
+            # instead of exp(NEG_INF - NEG_INF) garbage.
             lse = m_scr[...][:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+            lse = jnp.where(l > 0, lse, 0.0)
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool, save_residuals: bool = False):
-    """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, 8] if asked)."""
+                   interpret: bool, save_residuals: bool = False, mask=None,
+                   heads: int = 1):
+    """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, 8] if asked).
+    mask: optional [B, SUB, S_k] key-padding mask (1 = attend), sublane-
+    broadcast like the LSE residual and shared across `heads` heads via the
+    index map (one HBM copy per batch row, not per head)."""
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     sm_scale = 1.0 / math.sqrt(d)
@@ -106,22 +125,30 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
-        with_lse=save_residuals,
+        with_lse=save_residuals, with_mask=mask is not None,
     )
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
     if save_residuals:
         out_shape.append(jax.ShapeDtypeStruct((bh, seq_q, _SUB), jnp.float32))
         out_specs.append(pl.BlockSpec((1, block_q, _SUB), lambda b, i, j: (b, i, 0)))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, _SUB, block_k),
+                         lambda b, i, j: (b // heads, 0, j))
+        )
+        operands.append(mask)
     res = pl.pallas_call(
         kernel,
         out_shape=out_shape,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),
@@ -132,16 +159,21 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     if save_residuals:
         return res[0], res[1]
     return res[0]
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                     dq_scr, *, causal: bool, sm_scale: float, block_q: int,
-                     block_k: int, num_k_blocks: int):
+def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+                     causal: bool, sm_scale: float, block_q: int,
+                     block_k: int, num_k_blocks: int,
+                     with_mask: bool = False):
     """FlashAttention-2 backward, dQ pass: grid [BH, q_blocks, k_blocks]."""
+    if with_mask:
+        mask_ref, dq_ref, dq_scr = rest
+    else:
+        mask_ref, (dq_ref, dq_scr) = None, rest
     qi, ki = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -170,6 +202,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -181,11 +215,15 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                      dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                      sm_scale: float, block_q: int, block_k: int,
-                      num_q_blocks: int):
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+                      causal: bool, sm_scale: float, block_q: int,
+                      block_k: int, num_q_blocks: int,
+                      with_mask: bool = False):
     """FlashAttention-2 backward, dK/dV pass: grid [BH, k_blocks, q_blocks]."""
+    if with_mask:
+        mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        mask_ref, (dk_ref, dv_ref, dk_scr, dv_scr) = None, rest
     ki, qi = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qi == 0)
@@ -212,6 +250,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if mask_ref is not None:
+            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -232,7 +272,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
-                    block_k: int, interpret: bool):
+                    block_k: int, interpret: bool, mask=None,
+                    heads: int = 1):
     """Fused O(S) backward: no S x S materialization.
 
     Per-row state stays near-compact: the saved residual is [BH, S] f32,
@@ -251,37 +292,55 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     row_spec = pl.BlockSpec((1, block_q, _SUB), lambda b, i, j: (b, i, 0))
     kq_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
 
+    dq_in_specs = [q_spec, kq_spec, kq_spec, q_spec, q_spec, row_spec]
+    dq_operands = [q, k, v, o, do, lse]
+    if mask is not None:
+        dq_in_specs.append(
+            pl.BlockSpec((1, _SUB, block_k),
+                         lambda b, i, j: (b // heads, 0, j))
+        )
+        dq_operands.append(mask)
     dq = pl.pallas_call(
         functools.partial(
             _flash_dq_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
+            with_mask=mask is not None,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=(bh, num_q_blocks, num_k_blocks),
-        in_specs=[q_spec, kq_spec, kq_spec, q_spec, q_spec, row_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*dq_operands)
 
     # dK/dV pass: k blocks outer (parallel), q blocks inner (reduction)
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
     row_spec2 = pl.BlockSpec((1, block_q, _SUB), lambda b, j, i: (b, i, 0))
     k_spec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dkv_in_specs = [q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, row_spec2]
+    dkv_operands = [q, k, v, o, do, lse]
+    if mask is not None:
+        dkv_in_specs.append(
+            pl.BlockSpec((1, _SUB, block_k),
+                         lambda b, j, i: (b // heads, 0, j))
+        )
+        dkv_operands.append(mask)
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_dkv_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
+            with_mask=mask is not None,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
         ],
         grid=(bh, num_k_blocks, num_q_blocks),
-        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, row_spec2],
+        in_specs=dkv_in_specs,
         out_specs=[k_spec2, k_spec2],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -291,7 +350,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, o, do, lse)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -317,11 +376,38 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_masked(q, k, v, mask, causal, block_q, block_k, interpret, heads):
+    """Masked variant: mask is [B, SUB, S_k] (1 = attend), nondifferentiable
+    data threaded as a regular operand (its cotangent is zeros) and shared
+    across heads by the kernels' index maps."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                          mask=mask, heads=heads)
+
+
+def _flash_masked_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
+                      heads):
+    o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                            save_residuals=True, mask=mask, heads=heads)
+    return o, (q, k, v, o, lse[..., 0], mask)
+
+
+def _flash_masked_bwd(causal, block_q, block_k, interpret, heads, res, g):
+    q, k, v, o, lse, mask = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal, block_q,
+                                 block_k, interpret, mask=mask, heads=heads)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_flash_masked.defvjp(_flash_masked_fwd, _flash_masked_bwd)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
+    mask: jax.Array | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -336,11 +422,27 @@ def flash_attention(
     would drop under 16 rows (Mosaic sublane floor) — e.g. s < 16, or
     non-causal odd lengths — fall back to einsum attention.
 
+    `mask` is a key-padding mask — [B, S_k] (or any shape squeezable to it,
+    e.g. [B, 1, 1, S_k]) with 1/True = attend — applied inside the kernel in
+    forward and backward; fully-masked rows produce zero output. Full
+    per-position [B, ..., S_q, S_k] masks fall back to einsum attention.
+
     Default blocks come from the v5e sweep (benchmarks/sweep_attn.py):
     big blocks amortize pallas grid overhead — 512x1024 wins to ~2k context,
     1024x1024 from 4k up (96.7 TF/s vs einsum's 18.2 at s=4096)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    key_mask = None
+    if mask is not None:
+        m = mask
+        while m.ndim > 2 and m.shape[1] == 1:
+            m = m[:, 0]
+        if m.ndim == 2 and m.shape == (b, sk):
+            key_mask = m
+        else:
+            from ..models.common import dot_product_attention
+
+            return dot_product_attention(q, k, v, mask=mask, causal=causal)
     if block_q is None:
         block_q = 1024 if sq >= 4096 else 512
     if block_k is None:
@@ -358,7 +460,7 @@ def flash_attention(
     def _fallback():
         from ..models.common import dot_product_attention
 
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, k, v, mask=key_mask, causal=causal)
 
     # sq != sk would make the kernel's top-aligned causal mask disagree with
     # the bottom-aligned reference (and read past the k buffer when sq > sk)
@@ -385,7 +487,11 @@ def flash_attention(
                 qp = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
                 kp = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
                 vp = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-                out = flash_attention(qp, kp, vp, causal=True,
+                mp = (
+                    jnp.pad(key_mask, ((0, 0), (0, pad)))
+                    if key_mask is not None else None
+                )
+                out = flash_attention(qp, kp, vp, causal=True, mask=mp,
                                       block_q=block_q, block_k=block_k,
                                       interpret=interpret)
                 return out[:, :sq]
@@ -400,5 +506,16 @@ def flash_attention(
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    out = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
+    if key_mask is not None:
+        # [B, SUB, S_k] layout: Mosaic needs the sublane dim of a block to
+        # be a multiple of 8 (same trick as the LSE residual); one copy per
+        # batch row, shared across heads by the kernels' index maps
+        # f32, not bf16: Mosaic's vector compare doesn't lower for bf16
+        mf = jnp.broadcast_to(
+            key_mask.astype(jnp.float32)[:, None, :], (b, _SUB, sk)
+        )
+        out = _flash_masked(qf, kf, vf, mf, causal, block_q, block_k,
+                            interpret, h)
+    else:
+        out = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
