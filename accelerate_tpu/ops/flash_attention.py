@@ -40,6 +40,23 @@ def _pow2_floor(n: int) -> int:
     return 1 << (n.bit_length() - 1) if n >= 1 else 0
 
 
+def _mask_spec(heads: int, block_k: int, swap_grid: bool = False):
+    """BlockSpec for the [B, SUB, S_k] key-padding mask: one copy per batch
+    row, shared across `heads` heads via the index map. `swap_grid` matches
+    the dK/dV kernel whose grid is (bh, k_blocks, q_blocks)."""
+    if swap_grid:
+        return pl.BlockSpec((1, _SUB, block_k),
+                            lambda b, j, i: (b // heads, 0, j))
+    return pl.BlockSpec((1, _SUB, block_k),
+                        lambda b, i, j: (b // heads, 0, j))
+
+
+def _apply_key_mask(mask_ref, s):
+    """NEG_INF-out masked keys; mask block is [1, SUB, bk], one sublane row
+    broadcasts over the q rows of s."""
+    return jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
                   sm_scale: float, block_q: int, block_k: int,
                   num_k_blocks: int, with_lse: bool = False,
@@ -79,8 +96,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            # mask block is [1, SUB, bk]; one sublane row broadcasts over bq
-            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
+            s = _apply_key_mask(mask_ref, s)
         m_prev = m_scr[...][:, :1]  # [bq, 1]
         l_prev = l_scr[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -139,10 +155,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     ]
     operands = [q, k, v]
     if mask is not None:
-        in_specs.append(
-            pl.BlockSpec((1, _SUB, block_k),
-                         lambda b, i, j: (b // heads, 0, j))
-        )
+        in_specs.append(_mask_spec(heads, block_k))
         operands.append(mask)
     res = pl.pallas_call(
         kernel,
@@ -203,7 +216,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
+            s = _apply_key_mask(mask_ref, s)
         p = jnp.exp(s - lse)                                   # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -251,7 +264,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if mask_ref is not None:
-            s = jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
+            s = _apply_key_mask(mask_ref, s)
         p = jnp.exp(s - lse)                                   # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -295,10 +308,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     dq_in_specs = [q_spec, kq_spec, kq_spec, q_spec, q_spec, row_spec]
     dq_operands = [q, k, v, o, do, lse]
     if mask is not None:
-        dq_in_specs.append(
-            pl.BlockSpec((1, _SUB, block_k),
-                         lambda b, i, j: (b // heads, 0, j))
-        )
+        dq_in_specs.append(_mask_spec(heads, block_k))
         dq_operands.append(mask)
     dq = pl.pallas_call(
         functools.partial(
@@ -324,10 +334,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     dkv_in_specs = [q_spec2, k_spec2, k_spec2, q_spec2, q_spec2, row_spec2]
     dkv_operands = [q, k, v, o, do, lse]
     if mask is not None:
-        dkv_in_specs.append(
-            pl.BlockSpec((1, _SUB, block_k),
-                         lambda b, j, i: (b // heads, 0, j))
-        )
+        dkv_in_specs.append(_mask_spec(heads, block_k, swap_grid=True))
         dkv_operands.append(mask)
     dk, dv = pl.pallas_call(
         functools.partial(

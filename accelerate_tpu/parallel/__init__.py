@@ -2,7 +2,11 @@
 pipeline parallelism, expert-parallel MoE dispatch."""
 
 from .moe import expert_parallel_moe
-from .pipeline import pipeline_apply, stack_layers_into_stages
+from .pipeline import (
+    pipeline_apply,
+    pipeline_value_and_grad,
+    stack_layers_into_stages,
+)
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention
 

@@ -3,12 +3,23 @@
 Replaces the reference's two delegated PP paths: Megatron's 1F1B/interleaved
 schedules for training (ref utils/megatron_lm.py:964-1063) and PiPPy stage
 graphs for inference (ref inference.py:78-188). TPU-native design: the S
-pipeline stages live on a `stage` mesh axis; a `shard_map`-wrapped GPipe
-schedule rotates micro-batch activations stage-to-stage with `lax.ppermute`.
-The whole schedule (fills, steady state, drains) is ONE `lax.scan` inside
-jit, so forward AND backward (autodiff through ppermute) compile into a
-single XLA program — the backward drains in reverse automatically, giving
-GPipe memory/throughput semantics without a hand-written 1F1B interleave.
+pipeline stages live on a `stage` mesh axis; schedules rotate micro-batch
+activations stage-to-stage with `lax.ppermute` inside `shard_map`, and the
+whole schedule compiles into ONE `lax.scan` under jit.
+
+Two training schedules:
+- `pipeline_apply` (GPipe): differentiable forward; autodiff reverses the
+  scan, so every micro-batch's activations stay resident across the full
+  forward — O(M) activation memory, simplest code path.
+- `pipeline_value_and_grad(schedule="1f1b")`: hand-written interleaved
+  forward/backward in one scan. Each tick runs one micro-batch forward AND
+  one backward (of an earlier micro-batch) per stage; activation cotangents
+  ppermute backward while activations ppermute forward. Stage s keeps at
+  most 2(S-1-s)+1 saved stage-inputs in a fixed ring buffer — O(S)
+  activation memory independent of M, matching Megatron 1F1B semantics
+  (ref megatron_lm.py:964-1063). The backward recomputes the stage forward
+  from the saved input (per-stage remat, as Megatron does with activation
+  recomputation).
 
 Stage-stacked params: a pytree whose leaves lead with dim S (one slice per
 stage), sharded over the `stage` axis by the planner.
@@ -132,3 +143,149 @@ def pipeline_apply(
         check_vma=False,
     )(stage_params, micro)
     return out.reshape((b,) + out.shape[2:])
+
+
+def _pipeline_1f1b_local(stage_params, x_micro, targets, *, stage_fn,
+                         loss_fn, axis_name, num_stages, num_micro):
+    """1F1B schedule, runs INSIDE shard_map. Returns (loss, grads) where
+    loss is already psum'd across stages and averaged over micro-batches.
+
+    Clock: forward of micro m at stage s fires at tick t = m + s; backward
+    of micro m at stage s fires at t = m + 2(S-1) - s. On the last stage
+    both coincide (its backward consumes the loss gradient of the forward it
+    just ran); elsewhere the cotangent ppermuted from stage s+1 on the
+    previous tick arrives exactly in time. Total ticks: M + 2(S-1).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    micro_shape = x_micro.shape[1:]
+    S, M = num_stages, num_micro
+    ring_size = 2 * S  # in-flight saved inputs per stage < 2S
+    total_ticks = M + 2 * (S - 1)
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    last = idx == S - 1
+
+    carry0 = (
+        jnp.zeros(micro_shape, x_micro.dtype),            # inbound activation
+        jnp.zeros(micro_shape, x_micro.dtype),            # inbound cotangent
+        jnp.zeros((ring_size,) + micro_shape, x_micro.dtype),  # saved inputs
+        jax.tree_util.tree_map(jnp.zeros_like, params),   # grad accumulator
+        jnp.zeros((), jnp.float32),                       # loss sum
+    )
+
+    def tick(carry, t):
+        inb_act, inb_cot, ring, grads, loss_sum = carry
+
+        # ---- forward slot: micro m_f enters this stage
+        m_f = t - idx
+        f_valid = (m_f >= 0) & (m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        x_in = jnp.where(idx == 0, x_micro[m_f_c], inb_act)
+        y = stage_fn(params, x_in)
+        slot_f = m_f_c % ring_size
+        ring = ring.at[slot_f].set(jnp.where(f_valid, x_in, ring[slot_f]))
+
+        # ---- loss + its gradient on the last stage (same tick as B below)
+        tgt = jax.tree_util.tree_map(lambda v: v[m_f_c], targets)
+        lval, dy_self = jax.value_and_grad(
+            lambda yy: loss_fn(yy, tgt).astype(jnp.float32)
+        )(y)
+        loss_sum = loss_sum + jnp.where(last & f_valid, lval, 0.0)
+
+        # ---- backward slot: micro m_b leaves this stage
+        m_b = t - 2 * (S - 1) + idx
+        b_valid = (m_b >= 0) & (m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_saved = ring[m_b_c % ring_size]
+        dy = jnp.where(last, (dy_self / M).astype(inb_cot.dtype), inb_cot)
+        _, vjp_fn = jax.vjp(stage_fn, params, x_saved)
+        dp, dx = vjp_fn(dy)
+        grads = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(b_valid, g, jnp.zeros_like(g)),
+            grads, dp,
+        )
+
+        nxt_act = jax.lax.ppermute(y, axis_name, perm_fwd)
+        nxt_cot = jax.lax.ppermute(dx, axis_name, perm_bwd)
+        return (nxt_act, nxt_cot, ring, grads, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total_ticks)
+    )
+    loss = jax.lax.psum(loss_sum, axis_name) / M
+    # grads were accumulated against the UNSCALED per-micro loss gradient on
+    # every stage via dy_self / M above, so they already average over micros
+    grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+    return loss, grads
+
+
+def pipeline_value_and_grad(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    targets: Any,
+    num_micro_batches: int,
+    mesh=None,
+    axis_name: str = AXIS_STAGE,
+    schedule: str = "1f1b",
+) -> tuple[jax.Array, Any]:
+    """(loss, grads) of mean_m loss_fn(stages(x_m), targets_m).
+
+    `schedule="1f1b"` runs the memory-bounded interleaved schedule (O(S)
+    saved activations per stage); `schedule="gpipe"` differentiates
+    `pipeline_apply` (O(M) activations, kept for comparison/debug). Both
+    return identical values up to float reassociation.
+
+    - `stage_fn(params_slice, x_micro) -> y_micro`: one stage's compute.
+    - `loss_fn(y_micro, target_micro) -> scalar`: per-micro loss (mean-style;
+      the pipeline averages it over micro-batches).
+    - `targets`: pytree of arrays with the same leading batch dim as `x`.
+    """
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"unknown schedule {schedule!r}; use '1f1b' or 'gpipe'")
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+    num_stages = mesh.shape.get(axis_name, 1)
+    if num_stages == 1:
+        raise ValueError(
+            f"mesh has no '{axis_name}' axis (or size 1); use an ordinary "
+            "value_and_grad instead of the pipeline schedules"
+        )
+    b = x.shape[0]
+    M = num_micro_batches
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} micro-batches")
+    mb = b // M
+    micro = x.reshape((M, mb) + x.shape[1:])
+    tmicro = jax.tree_util.tree_map(
+        lambda v: v.reshape((M, mb) + v.shape[1:]), targets
+    )
+
+    if schedule == "gpipe":
+        def total_loss(sp):
+            y = pipeline_apply(stage_fn, sp, x, M, mesh=mesh,
+                               axis_name=axis_name)
+            ym = y.reshape((M, mb) + y.shape[1:])
+            losses = jax.vmap(loss_fn)(ym, tmicro)
+            return jnp.mean(losses.astype(jnp.float32))
+
+        return jax.value_and_grad(total_loss)(stage_params)
+
+    stage_spec = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
+    )
+    fn = partial(
+        _pipeline_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
+        axis_name=axis_name, num_stages=num_stages, num_micro=M,
+    )
+    loss, grads = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(stage_spec, P(), P()),
+        out_specs=(P(), stage_spec),
+        check_vma=False,
+    )(stage_params, micro, tmicro)
+    return loss, grads

@@ -339,3 +339,107 @@ def test_llama_explicit_flash_masked_matches_einsum():
         np.asarray(out_flash) * keep, np.asarray(out_einsum) * keep,
         atol=5e-2,
     )
+
+
+# --- 1F1B pipeline schedule --------------------------------------------------
+
+
+def _mlp_stage(p, x):
+    return jnp.tanh(x @ p["w"][0] + p["b"][0])
+
+
+def _mse(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _pipeline_ref(layer_params, x, targets, L, M):
+    """Sequential reference: mean over micro-batches of per-micro loss."""
+    mb = x.shape[0] // M
+
+    def total(params):
+        losses = []
+        for m in range(M):
+            y = x[m * mb:(m + 1) * mb]
+            for i in range(L):
+                y = jnp.tanh(y @ params["w"][i] + params["b"][i])
+            losses.append(_mse(y, targets[m * mb:(m + 1) * mb]))
+        return jnp.mean(jnp.stack(losses))
+
+    return jax.value_and_grad(total)(layer_params)
+
+
+@pytest.mark.parametrize("M", [4, 8])
+def test_pipeline_1f1b_matches_sequential(M):
+    from accelerate_tpu.parallel import pipeline_value_and_grad
+
+    mesh = MeshConfig(axes={"stage": 4, "data": 2}).build()
+    L, H, B = 4, 16, 16
+    key = jax.random.key(0)
+    layer_params = {
+        "w": jax.random.normal(key, (L, H, H)) * 0.3,
+        "b": jnp.zeros((L, H)),
+    }
+    staged = stack_layers_into_stages(layer_params, 4)
+    x = jax.random.normal(jax.random.key(1), (B, H))
+    targets = jax.random.normal(jax.random.key(2), (B, H))
+
+    loss_ref, grads_ref = _pipeline_ref(layer_params, x, targets, L, M)
+    loss, grads = pipeline_value_and_grad(
+        _mlp_stage, _mse, staged, x, targets, M, mesh=mesh, schedule="1f1b")
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    for k in ("w", "b"):
+        got = np.asarray(grads[k]).reshape(np.asarray(grads_ref[k]).shape)
+        np.testing.assert_allclose(got, np.asarray(grads_ref[k]), atol=1e-5)
+
+
+def test_pipeline_1f1b_matches_gpipe():
+    from accelerate_tpu.parallel import pipeline_value_and_grad
+
+    mesh = MeshConfig(axes={"stage": 4, "data": 2}).build()
+    L, H, B, M = 4, 8, 8, 4
+    layer_params = {
+        "w": jax.random.normal(jax.random.key(0), (L, H, H)) * 0.3,
+        "b": jnp.zeros((L, H)),
+    }
+    staged = stack_layers_into_stages(layer_params, 4)
+    x = jax.random.normal(jax.random.key(1), (B, H))
+    targets = jax.random.normal(jax.random.key(2), (B, H))
+    l1, g1 = pipeline_value_and_grad(
+        _mlp_stage, _mse, staged, x, targets, M, mesh=mesh, schedule="1f1b")
+    l2, g2 = pipeline_value_and_grad(
+        _mlp_stage, _mse, staged, x, targets, M, mesh=mesh, schedule="gpipe")
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for k in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(g1[k]), np.asarray(g2[k]), atol=1e-5)
+
+
+def test_pipeline_1f1b_micro_fewer_than_stages():
+    """M < S must still be exact (warmup/drain masking)."""
+    from accelerate_tpu.parallel import pipeline_value_and_grad
+
+    mesh = MeshConfig(axes={"stage": 4, "data": 2}).build()
+    L, H, B, M = 4, 8, 4, 2
+    layer_params = {
+        "w": jax.random.normal(jax.random.key(3), (L, H, H)) * 0.3,
+        "b": jnp.zeros((L, H)),
+    }
+    staged = stack_layers_into_stages(layer_params, 4)
+    x = jax.random.normal(jax.random.key(4), (B, H))
+    targets = jax.random.normal(jax.random.key(5), (B, H))
+    loss_ref, grads_ref = _pipeline_ref(layer_params, x, targets, L, M)
+    loss, grads = pipeline_value_and_grad(
+        _mlp_stage, _mse, staged, x, targets, M, mesh=mesh, schedule="1f1b")
+    np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+    got = np.asarray(grads["w"]).reshape(np.asarray(grads_ref["w"]).shape)
+    np.testing.assert_allclose(got, np.asarray(grads_ref["w"]), atol=1e-5)
+
+
+def test_pipeline_value_and_grad_validates_schedule():
+    from accelerate_tpu.parallel import pipeline_value_and_grad
+
+    mesh = MeshConfig(axes={"stage": 4, "data": 2}).build()
+    with pytest.raises(ValueError, match="schedule"):
+        pipeline_value_and_grad(
+            _mlp_stage, _mse, {}, jnp.zeros((4, 8)), jnp.zeros((4, 8)), 2,
+            mesh=mesh, schedule="2f2b")
