@@ -172,17 +172,64 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array
     return out, aux
 
 
+# crossover measured on v5e (benchmarks/bench_moe.py): one-hot einsum
+# dispatch wins to ~2k context, sort-based wins beyond
+_ONEHOT_DISPATCH_MAX_ELEMENTS = 16 * 2**20
+
+
 def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Capacity-bounded dispatch (GShard): experts compute C tokens, not S."""
+    """Capacity-bounded dispatch: experts compute C tokens, not S.
+
+    Two dispatch mechanisms, auto-selected by the would-be one-hot size:
+    - short sequences: GShard-style [B, S*k, E, C] one-hot einsum dispatch —
+      the extra FLOPs ride the MXU and beat gather/scatter latency (measured
+      on v5e: 170k vs 151k tok/s at S=1024 on the 8-expert bench config);
+    - long sequences: sort-based dispatch from parallel/moe.py (stable
+      argsort + gathers) — the one-hot grows O(S^2) in memory and FLOPs and
+      loses from ~S=2048 up (113k vs 96k tok/s at S=4096), then OOMs.
+
+    Over-capacity assignments drop; the residual path carries those tokens
+    (standard MoE-training behavior under load imbalance)."""
     b, s, h = x.shape
     E, k = config.num_local_experts, config.num_experts_per_tok
     cap = int(math.ceil(k * s / E * config.capacity_factor))
     cap = min(cap, s * k)
     probs, topk_probs, topk_idx, aux = _route(config, moe, x)
 
-    # slot of token (s, choice j) within its expert's capacity buffer:
-    # cumulative count of prior assignments to that expert in this batch row.
-    # Flatten the k choices into the sequence order so slots are unique.
+    # one-hot dispatch tensor is [S*k, E, C] per batch row; past the
+    # threshold (bf16: 32 MB/row) the sort path wins on v5e
+    use_onehot = s * k * E * cap <= _ONEHOT_DISPATCH_MAX_ELEMENTS
+    if use_onehot:
+        expert_out, combine = _dispatch_onehot(
+            config, moe, x, topk_idx, topk_probs, cap
+        )
+        return _combine_onehot(expert_out, combine, b, s, k, h), aux
+    from ..parallel.moe import sort_combine, sort_dispatch
+
+    buffers, info = jax.vmap(
+        lambda xr, ir, gr: sort_dispatch(xr, ir, gr.astype(xr.dtype), E, cap)
+    )(x, topk_idx, topk_probs)                                 # [B, E, C, H]
+    expert_out = _expert_mlp(moe, buffers, x.dtype)
+    out = jax.vmap(sort_combine)(expert_out, info)
+    return out, aux
+
+
+def _expert_mlp(moe: dict, buffers: jax.Array, dtype) -> jax.Array:
+    """SwiGLU expert MLP over [B, E, C, H] capacity buffers."""
+    gate = jax.nn.silu(jnp.einsum(
+        "bech,ehf->becf", buffers, moe["experts"]["gate_proj"]["kernel"],
+        preferred_element_type=jnp.float32).astype(dtype))
+    up = jnp.einsum("bech,ehf->becf", buffers, moe["experts"]["up_proj"]["kernel"],
+                    preferred_element_type=jnp.float32).astype(dtype)
+    return jnp.einsum(
+        "becf,efh->bech", gate * up, moe["experts"]["down_proj"]["kernel"],
+        preferred_element_type=jnp.float32).astype(dtype)
+
+
+def _dispatch_onehot(config, moe, x, topk_idx, topk_probs, cap):
+    """GShard one-hot einsum dispatch; returns (expert_out, combine)."""
+    b, s, h = x.shape
+    E, k = config.num_local_experts, config.num_experts_per_tok
     flat_idx = topk_idx.reshape(b, s * k)                      # [B, S*k]
     flat_prob = topk_probs.reshape(b, s * k).astype(jnp.float32)
     onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)      # [B, S*k, E]
@@ -196,18 +243,14 @@ def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[ja
     )[..., :cap]                                               # dropped -> all-zero
     x_rep = jnp.repeat(x, k, axis=1)                           # [B, S*k, H]
     expert_in = jnp.einsum("btec,bth->bech", d, x_rep)         # gather
-    gate = jax.nn.silu(jnp.einsum(
-        "bech,ehf->becf", expert_in, moe["experts"]["gate_proj"]["kernel"],
-        preferred_element_type=jnp.float32).astype(x.dtype))
-    up = jnp.einsum("bech,ehf->becf", expert_in, moe["experts"]["up_proj"]["kernel"],
-                    preferred_element_type=jnp.float32).astype(x.dtype)
-    expert_out = jnp.einsum(
-        "becf,efh->bech", gate * up, moe["experts"]["down_proj"]["kernel"],
-        preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = _expert_mlp(moe, expert_in, x.dtype)
     combine = d * flat_prob[..., None, None].astype(x.dtype)   # [B, S*k, E, C]
+    return expert_out, combine
+
+
+def _combine_onehot(expert_out, combine, b, s, k, h):
     out_flat = jnp.einsum("btec,bech->bth", combine, expert_out)  # [B, S*k, H]
-    out = out_flat.reshape(b, s, k, h).sum(axis=2)
-    return out, aux
+    return out_flat.reshape(b, s, k, h).sum(axis=2)
 
 
 def forward(

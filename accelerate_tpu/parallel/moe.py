@@ -1,16 +1,20 @@
-"""Expert-parallel MoE dispatch via explicit all-to-all.
+"""Expert-parallel MoE dispatch via sort-based routing + explicit all-to-all.
 
-models/mixtral.py uses dense one-hot dispatch (every expert sees every token;
-GSPMD shards the expert dim). This module adds Switch-style capacity-bounded
-top-1 routing with an explicit `lax.all_to_all` over the `expert` mesh axis —
-behavior the reference could only reach through DeepSpeed-MoE
-(ref utils/dataclasses.py:724-730).
+models/mixtral.py's dense path has every expert see every token (GSPMD shards
+the expert dim). This module provides the production dispatch: capacity-
+bounded top-k routing where token->expert assignment is resolved by a stable
+argsort over expert ids — O(T·k·log(T·k)) index math and an [E, C, H]
+buffer, never the [T, E, C] one-hot dispatch tensor of GShard-style einsum
+dispatch. With an `expert` mesh axis, each device computes
+only its own experts' capacity buffers (the routing/index math runs
+replicated — cheap int ops) and one `all_gather` reassembles the outputs,
+the behavior the reference could only reach through DeepSpeed-MoE
+(ref utils/dataclasses.py:724-730). With token-sharded inputs an
+all-to-all dispatch would replace the all_gather; that variant lands with
+token-parallel routing.
 
-Known cost (acceptable for moderate token counts, to be replaced by a
-sort-based dispatch): the [T, E, C] one-hot dispatch tensor is ~1.25*T^2
-elements and the routing math runs replicated on every device of the expert
-axis. For the training hot path at scale prefer the dense dispatch in
-models/mixtral.py, which GSPMD shards end to end.
+`sort_dispatch` / `sort_combine` are shared with models/mixtral.py's sparse
+implementation (vmapped per batch row there).
 """
 
 from __future__ import annotations
@@ -25,51 +29,93 @@ from jax.sharding import PartitionSpec as P
 from ..utils.constants import AXIS_EXPERT
 
 
+def sort_dispatch(x, topk_idx, topk_gate, num_experts: int, capacity: int):
+    """Fill per-expert capacity buffers by sorted assignment, gather-style.
+
+    x: [T, H]; topk_idx/topk_gate: [T, k]. Returns (buffers [E, C, H],
+    combine_info). A stable argsort over the T*k expert assignments groups
+    them per expert while preserving token order, so a token's slot is its
+    rank within its expert's group; assignments ranked past `capacity` drop
+    (Switch-Transformer semantics — the token's residual path carries it).
+
+    TPU-shaped: the only scatters are two [A]-sized int32 index inversions;
+    the H-wide data movement is pure gathers (buffer rows gather their
+    source token; the combine gathers each token's k buffer rows), which the
+    TPU memory system handles far better than wide scatter-adds.
+    """
+    T, H = x.shape
+    k = topk_idx.shape[-1]
+    A = T * k
+    flat_e = topk_idx.reshape(A)
+    flat_t = jnp.repeat(jnp.arange(T), k)
+    order = jnp.argsort(flat_e, stable=True)
+    se, st = flat_e[order], flat_t[order]
+    # rank within the expert group = index - first index of that expert
+    group_start = jnp.searchsorted(se, se, side="left")
+    slot = jnp.arange(A) - group_start
+    valid = slot < capacity
+    # destination buffer row of each sorted assignment; dropped assignments
+    # get an out-of-range sentinel so the int scatters can mode="drop" them
+    dest = jnp.where(valid, se * capacity + slot, num_experts * capacity)
+    # invert: which token feeds buffer row p (-1 = empty slot)
+    src = jnp.full((num_experts * capacity,), -1, jnp.int32)
+    src = src.at[dest].set(st.astype(jnp.int32), mode="drop")
+    filled = src >= 0
+    buffers = jnp.where(
+        filled[:, None], x[jnp.maximum(src, 0)], jnp.zeros((), x.dtype)
+    ).reshape(num_experts, capacity, H)
+    # per-original-assignment destination for the combine gather
+    dest_orig = jnp.zeros((A,), jnp.int32).at[order].set(dest.astype(jnp.int32))
+    valid_orig = jnp.zeros((A,), bool).at[order].set(valid)
+    return buffers, (
+        dest_orig.reshape(T, k), valid_orig.reshape(T, k), topk_gate
+    )
+
+
+def sort_combine(expert_outputs, combine_info):
+    """Gather expert outputs back to token order, gate-weighted sum over the
+    k assignments of each token. expert_outputs: [E, C, H] -> [T, H]."""
+    dest, valid, gate = combine_info
+    y_flat = expert_outputs.reshape(-1, expert_outputs.shape[-1])
+    vals = y_flat[jnp.where(valid, dest, 0)]  # [T, k, H]
+    w = (gate * valid).astype(vals.dtype)
+    return jnp.sum(vals * w[..., None], axis=1)
+
+
 def _moe_local(x, router_logits, expert_params, *, expert_fn, axis_name,
-               num_experts, capacity):
-    """Top-1 dispatch with capacity bounding. Runs inside shard_map when
+               num_experts, capacity, top_k):
+    """Top-k dispatch with capacity bounding. Runs inside shard_map when
     `axis_name` is set (expert_params then hold only this device's experts).
 
-    x: [T, H]; router_logits: [T, E]; returns [T, H] (over-capacity tokens
-    pass through as zeros, Switch-Transformer drop semantics)."""
+    x: [T, H]; router_logits: [T, E]; returns [T, H] (over-capacity
+    assignments drop; the caller's residual path carries those tokens)."""
     e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
     n_tokens, h = x.shape
 
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    gate, expert_idx = jax.lax.top_k(probs, top_k)  # [T, k]
 
-    # slot of each token within its expert's capacity buffer
-    one_hot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)
-    slot = (jnp.cumsum(one_hot, axis=0) * one_hot).sum(axis=-1) - 1  # [T], 0-based
-    valid = (slot >= 0) & (slot < capacity)
-    # dispatch [T, E, C]: token t -> (expert e, slot c)
-    dispatch = (
-        jax.nn.one_hot(expert_idx, num_experts, dtype=x.dtype)[:, :, None]
-        * jax.nn.one_hot(slot, capacity, dtype=x.dtype)[:, None, :]
-        * valid[:, None, None].astype(x.dtype)
+    expert_inputs, info = sort_dispatch(
+        x, expert_idx, gate, num_experts, capacity
     )
-    expert_inputs = jnp.einsum("tec,th->ech", dispatch, x)  # [E, C, H]
 
     if axis_name is not None:
-        # route each expert's buffer to its owner device and back
-        n_dev = num_experts // e_local
-        buffers = expert_inputs.reshape(n_dev, e_local, capacity, h)
-        buffers = jax.lax.all_to_all(
-            buffers, axis_name, split_axis=0, concat_axis=0, tiled=False
-        )  # [n_dev, e_local, C, H]: every device's tokens for MY experts
-        local_in = buffers.transpose(1, 0, 2, 3).reshape(e_local, n_dev * capacity, h)
+        # x/logits arrive replicated, so every device already holds the full
+        # [E, C, H] buffer: slice MY experts' rows, compute only those, and
+        # one all_gather reassembles the outputs — no all_to_all, and each
+        # device runs e_local*C rows instead of all E*C
+        idx = jax.lax.axis_index(axis_name)
+        local_in = jax.lax.dynamic_slice_in_dim(
+            expert_inputs, idx * e_local, e_local, axis=0
+        )  # [e_local, C, H]
         local_out = jax.vmap(expert_fn)(expert_params, local_in)
-        back = local_out.reshape(e_local, n_dev, capacity, h).transpose(1, 0, 2, 3)
-        back = jax.lax.all_to_all(
-            back, axis_name, split_axis=0, concat_axis=0, tiled=False
-        )
-        expert_outputs = back.reshape(num_experts, capacity, h)
+        expert_outputs = jax.lax.all_gather(
+            local_out, axis_name, axis=0, tiled=True
+        )  # [E, C, H]
     else:
         expert_outputs = jax.vmap(expert_fn)(expert_params, expert_inputs)
 
-    out = jnp.einsum("tec,ech->th", dispatch, expert_outputs)
-    return out * gate[:, None].astype(x.dtype)
+    return sort_combine(expert_outputs, info).astype(x.dtype)
 
 
 def expert_parallel_moe(
@@ -80,29 +126,32 @@ def expert_parallel_moe(
     mesh=None,
     axis_name: str = AXIS_EXPERT,
     capacity_factor: float = 1.25,
+    top_k: int = 1,
 ):
-    """Top-1 switch-style EP-MoE. x: [T, H] tokens, router_logits: [T, E],
-    expert_params leaves lead with dim E (sharded over `expert`)."""
+    """Top-k EP-MoE (k=1 gives Switch, k=2 Mixtral-style routing). x: [T, H]
+    tokens, router_logits: [T, E], expert_params leaves lead with dim E
+    (sharded over `expert`). Gates are the raw top-k softmax probabilities;
+    renormalize in the caller's router if desired."""
     if mesh is None:
         from ..state import PartialState
 
         mesh = PartialState().mesh
     num_experts = router_logits.shape[-1]
     n_dev = mesh.shape.get(axis_name, 1)
-    capacity = max(int(capacity_factor * x.shape[0] / num_experts), 1)
+    capacity = max(int(capacity_factor * top_k * x.shape[0] / num_experts), 1)
     if n_dev == 1:
         # single device: same math without the a2a
         return _moe_local(
             x, router_logits, expert_params,
             expert_fn=expert_fn, axis_name=None, num_experts=num_experts,
-            capacity=capacity,
+            capacity=capacity, top_k=top_k,
         )
     expert_spec = jax.tree_util.tree_map(
         lambda p: P(axis_name, *([None] * (p.ndim - 1))), expert_params
     )
     fn = partial(
         _moe_local, expert_fn=expert_fn, axis_name=axis_name,
-        num_experts=num_experts, capacity=capacity,
+        num_experts=num_experts, capacity=capacity, top_k=top_k,
     )
     return jax.shard_map(
         fn, mesh=mesh,

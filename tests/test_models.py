@@ -244,3 +244,36 @@ def test_sparse_moe_trains():
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
     assert float(loss_fn(params)) < l0
+
+
+def test_sparse_moe_sort_and_onehot_dispatch_agree(monkeypatch):
+    """Both sparse dispatch mechanisms produce identical outputs (same
+    assignment priority => same drops), so the size-based auto-selection
+    never changes results."""
+    from accelerate_tpu.models import mixtral
+
+    cfg = mixtral.MixtralConfig.tiny(moe_impl="sparse", capacity_factor=1.0)
+    params = mixtral.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    out_onehot, aux1 = mixtral.forward(cfg, params, ids)
+    monkeypatch.setattr(mixtral, "_ONEHOT_DISPATCH_MAX_ELEMENTS", 0)
+    out_sort, aux2 = mixtral.forward(cfg, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_onehot), np.asarray(out_sort), atol=1e-4)
+    np.testing.assert_allclose(float(aux1), float(aux2), rtol=1e-6)
+
+
+def test_sparse_moe_sort_path_matches_dense_at_full_capacity(monkeypatch):
+    """Sort dispatch == dense combine when capacity covers all assignments."""
+    from accelerate_tpu.models import mixtral
+
+    monkeypatch.setattr(mixtral, "_ONEHOT_DISPATCH_MAX_ELEMENTS", 0)
+    dense_cfg = mixtral.MixtralConfig.tiny(moe_impl="dense")
+    sparse_cfg = mixtral.MixtralConfig.tiny(
+        moe_impl="sparse", capacity_factor=float(mixtral.MixtralConfig.tiny().num_local_experts))
+    params = mixtral.init_params(dense_cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, dense_cfg.vocab_size)
+    out_d, _ = mixtral.forward(dense_cfg, params, ids)
+    out_s, _ = mixtral.forward(sparse_cfg, params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_d), np.asarray(out_s), atol=1e-3)

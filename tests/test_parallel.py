@@ -443,3 +443,49 @@ def test_pipeline_value_and_grad_validates_schedule():
         pipeline_value_and_grad(
             _mlp_stage, _mse, {}, jnp.zeros((4, 8)), jnp.zeros((4, 8)), 2,
             mesh=mesh, schedule="2f2b")
+
+
+def test_ep_moe_top2_matches_replicated_reference():
+    """top_k=2 EP dispatch over the expert axis == single-device dispatch."""
+    E, H, T = 4, 8, 32
+    params = {"w": jax.random.normal(jax.random.key(0), (E, H, H)) * 0.5}
+    x = jax.random.normal(jax.random.key(1), (T, H))
+    logits = jax.random.normal(jax.random.key(2), (T, E))
+
+    mesh = MeshConfig(axes={"expert": 4, "data": 2}).build()
+    out = expert_parallel_moe(x, logits, params, _expert_fn, mesh=mesh,
+                              capacity_factor=8.0, top_k=2)
+    ref = expert_parallel_moe(x, logits, params, _expert_fn,
+                              mesh=MeshConfig(axes={"data": 8}).build(),
+                              axis_name="absent", capacity_factor=8.0,
+                              top_k=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # top-2 must differ from top-1 (second expert contributes)
+    ref1 = expert_parallel_moe(x, logits, params, _expert_fn,
+                               mesh=MeshConfig(axes={"data": 8}).build(),
+                               axis_name="absent", capacity_factor=8.0,
+                               top_k=1)
+    assert not np.allclose(np.asarray(ref), np.asarray(ref1), atol=1e-3)
+
+
+def test_ep_moe_top2_matches_manual_dense_reference():
+    """Sort-dispatch top-2 at ample capacity == explicit dense top-2 math."""
+    E, H, T = 4, 8, 16
+    params = {"w": jax.random.normal(jax.random.key(3), (E, H, H)) * 0.5}
+    x = jax.random.normal(jax.random.key(4), (T, H))
+    logits = jax.random.normal(jax.random.key(5), (T, E))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, 2)
+    y_all = jnp.einsum("th,ehf->tef", x, params["w"])
+    y_all = jnp.tanh(y_all)  # [T, E, H]
+    ref = sum(
+        jnp.take_along_axis(
+            y_all, idx[:, j][:, None, None].repeat(H, 2), axis=1
+        )[:, 0] * gates[:, j][:, None]
+        for j in range(2)
+    )
+    out = expert_parallel_moe(
+        x, logits, params, _expert_fn,
+        mesh=MeshConfig(axes={"data": 8}).build(), axis_name="absent",
+        capacity_factor=8.0, top_k=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
