@@ -1,0 +1,136 @@
+"""GPT-2 causal LM (the GPT-J/NeoX-class coverage of the reference's
+big-model-inference benchmark, ref benchmarks/README.md:25-36, toward
+arbitrary-architecture import parity).
+
+Same TPU-first layout as llama: layers stack on a leading L dim and the
+forward scans one compiled layer body. GPT-2 specifics: learned position
+embeddings, pre-LN with biases, fused qkv (`c_attn`), gelu_new MLP, and a
+word-embedding-tied LM head. HF stores these as Conv1D ([in, out] kernels
+— no transpose on import, unlike nn.Linear).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    dense,
+    dot_product_attention,
+    layer_norm,
+    normal_init,
+    token_nll,
+    cross_entropy_loss,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768          # n_embd
+    num_hidden_layers: int = 12     # n_layer
+    num_attention_heads: int = 12   # n_head
+    max_position_embeddings: int = 1024  # n_positions
+    layer_norm_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **overrides) -> "GPT2Config":
+        defaults = dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def init_params(config: GPT2Config, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 6)
+    h, L = config.hidden_size, config.num_hidden_layers
+
+    def lin(k, d_in, d_out):
+        return {
+            "kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype),
+            "bias": jnp.zeros((L, d_out), dtype),
+        }
+
+    def ln():
+        return {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)}
+
+    return {
+        "wte": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "wpe": {"embedding": normal_init(keys[1], (config.max_position_embeddings, h), 0.01, dtype)},
+        "layers": {
+            "ln_1": ln(),
+            "attn": {
+                "c_attn": lin(keys[2], h, 3 * h),
+                "c_proj": lin(keys[3], h, h),
+            },
+            "ln_2": ln(),
+            "mlp": {
+                "c_fc": lin(keys[4], h, 4 * h),
+                "c_proj": lin(keys[5], 4 * h, h),
+            },
+        },
+        "ln_f": {"scale": jnp.ones((h,), dtype), "bias": jnp.zeros((h,), dtype)},
+    }
+
+
+def _layer_body(config: GPT2Config, x, layer, mask):
+    b, s, h = x.shape
+    nh, hd = config.num_attention_heads, config.head_dim
+    eps = config.layer_norm_epsilon
+
+    y = layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"], eps)
+    qkv = dense(y, layer["attn"]["c_attn"]["kernel"], layer["attn"]["c_attn"]["bias"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, nh, hd)
+    k = k.reshape(b, s, nh, hd)
+    v = v.reshape(b, s, nh, hd)
+    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    attn = attn.reshape(b, s, h)
+    x = x + dense(attn, layer["attn"]["c_proj"]["kernel"],
+                  layer["attn"]["c_proj"]["bias"])
+
+    y = layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"], eps)
+    y = dense(y, layer["mlp"]["c_fc"]["kernel"], layer["mlp"]["c_fc"]["bias"])
+    y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
+    x = x + dense(y, layer["mlp"]["c_proj"]["kernel"],
+                  layer["mlp"]["c_proj"]["bias"])
+    return x
+
+
+def forward(
+    config: GPT2Config,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Logits [B, S, V]; LM head tied to wte (GPT-2 always ties)."""
+    positions = jnp.arange(input_ids.shape[1])
+    x = params["wte"]["embedding"][input_ids] + params["wpe"]["embedding"][positions]
+
+    def scan_body(carry, layer):
+        return _layer_body(config, carry, layer, attention_mask), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                   config.layer_norm_epsilon)
+    return jnp.einsum(
+        "bsh,vh->bsv", x, params["wte"]["embedding"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def causal_lm_loss(config: GPT2Config, params: dict, batch: dict) -> jax.Array:
+    input_ids = batch["input_ids"]
+    labels = input_ids[:, 1:]
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    logits = forward(config, params, input_ids[:, :-1])
+    return cross_entropy_loss(logits, labels, mask)

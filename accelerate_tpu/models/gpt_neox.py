@@ -1,0 +1,175 @@
+"""GPT-NeoX causal LM (the GPT-NeoX-20B row of the reference's
+big-model-inference benchmark, ref benchmarks/README.md:31-32).
+
+Same TPU-first scan-over-stacked-layers layout as llama/gpt2. NeoX
+specifics: parallel residual (attention and MLP both read the same layer
+input and add into it together), partial rotary embeddings (first
+`rotary_pct` of each head's dims rotate, the rest pass through), a fused
+per-head-interleaved qkv projection, LayerNorms with biases, and an untied
+`embed_out` LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    apply_rope,
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    layer_norm,
+    normal_init,
+    rope_frequencies,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoXConfig:
+    vocab_size: int = 50432
+    hidden_size: int = 6144
+    intermediate_size: int = 24576
+    num_hidden_layers: int = 44
+    num_attention_heads: int = 64
+    max_position_embeddings: int = 2048
+    rotary_pct: float = 0.25
+    rotary_emb_base: float = 10000.0
+    layer_norm_eps: float = 1e-5
+    use_parallel_residual: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rotary_ndims(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "GPTNeoXConfig":
+        defaults = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def init_params(config: GPTNeoXConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 7)
+    h, L, f = config.hidden_size, config.num_hidden_layers, config.intermediate_size
+
+    def lin(k, d_in, d_out):
+        return {
+            "kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype),
+            "bias": jnp.zeros((L, d_out), dtype),
+        }
+
+    def ln():
+        return {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)}
+
+    return {
+        "embed_in": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "layers": {
+            "input_layernorm": ln(),
+            "attn": {
+                "query_key_value": lin(keys[1], h, 3 * h),
+                "dense": lin(keys[2], h, h),
+            },
+            "post_attention_layernorm": ln(),
+            "mlp": {
+                "dense_h_to_4h": lin(keys[3], h, f),
+                "dense_4h_to_h": lin(keys[4], f, h),
+            },
+        },
+        "final_layer_norm": {
+            "scale": jnp.ones((h,), dtype), "bias": jnp.zeros((h,), dtype)
+        },
+        "embed_out": {"kernel": normal_init(keys[5], (h, config.vocab_size), 0.02, dtype)},
+    }
+
+
+def _partial_rope(x, cos, sin, positions, rotary_ndims: int):
+    """Rotate only the first `rotary_ndims` of each head's dims."""
+    rot, rest = x[..., :rotary_ndims], x[..., rotary_ndims:]
+    rot = apply_rope(rot, cos, sin, positions)
+    return jnp.concatenate([rot, rest], axis=-1)
+
+
+def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask):
+    b, s, h = x.shape
+    nh, hd = config.num_attention_heads, config.head_dim
+    eps = config.layer_norm_eps
+
+    attn_in = layer_norm(x, layer["input_layernorm"]["scale"],
+                         layer["input_layernorm"]["bias"], eps)
+    qkv = dense(attn_in, layer["attn"]["query_key_value"]["kernel"],
+                layer["attn"]["query_key_value"]["bias"])
+    # NeoX packs qkv per head: out dim layout is [head][q|k|v][head_dim]
+    qkv = qkv.reshape(b, s, nh, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    q = _partial_rope(q, cos, sin, positions, config.rotary_ndims)
+    k = _partial_rope(k, cos, sin, positions, config.rotary_ndims)
+    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    attn_out = dense(attn.reshape(b, s, h), layer["attn"]["dense"]["kernel"],
+                     layer["attn"]["dense"]["bias"])
+
+    mlp_in = (
+        layer_norm(x, layer["post_attention_layernorm"]["scale"],
+                   layer["post_attention_layernorm"]["bias"], eps)
+        if config.use_parallel_residual
+        else layer_norm(x + attn_out,
+                        layer["post_attention_layernorm"]["scale"],
+                        layer["post_attention_layernorm"]["bias"], eps)
+    )
+    y = dense(mlp_in, layer["mlp"]["dense_h_to_4h"]["kernel"],
+              layer["mlp"]["dense_h_to_4h"]["bias"])
+    y = jax.nn.gelu(y.astype(jnp.float32), approximate=False).astype(x.dtype)
+    mlp_out = dense(y, layer["mlp"]["dense_4h_to_h"]["kernel"],
+                    layer["mlp"]["dense_4h_to_h"]["bias"])
+
+    # both residual modes add the same three terms — the difference is
+    # entirely in what mlp_in read above (x alone vs x + attn_out)
+    return x + attn_out + mlp_out
+
+
+def forward(
+    config: GPTNeoXConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Logits [B, S, V] via the untied embed_out head."""
+    x = params["embed_in"]["embedding"][input_ids]
+    positions = jnp.broadcast_to(
+        jnp.arange(input_ids.shape[1]), input_ids.shape
+    )
+    cos, sin = rope_frequencies(
+        config.rotary_ndims, config.max_position_embeddings,
+        config.rotary_emb_base,
+    )
+
+    def scan_body(carry, layer):
+        return _layer_body(config, carry, layer, cos, sin, positions,
+                           attention_mask), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["final_layer_norm"]["scale"],
+                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["embed_out"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def causal_lm_loss(config: GPTNeoXConfig, params: dict, batch: dict) -> jax.Array:
+    input_ids = batch["input_ids"]
+    labels = input_ids[:, 1:]
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    logits = forward(config, params, input_ids[:, :-1])
+    return cross_entropy_loss(logits, labels, mask)

@@ -39,6 +39,13 @@ def _np(t) -> np.ndarray:
     return np.asarray(t)
 
 
+def _getter(hf_config):
+    """Uniform accessor over a transformers config object or a plain dict."""
+    if isinstance(hf_config, dict):
+        return lambda k, d=None: hf_config.get(k, d)
+    return lambda k, d=None: getattr(hf_config, k, d)
+
+
 def _stack(sd: Mapping[str, Any], template: str, n: int, transpose: bool) -> np.ndarray:
     """Stack per-layer tensors `template.format(i)` into [n, ...]."""
     rows = []
@@ -55,9 +62,15 @@ def _stack(sd: Mapping[str, Any], template: str, n: int, transpose: bool) -> np.
 
 def llama_config_from_hf(hf_config) -> LlamaConfig:
     """Build our config from a transformers LlamaConfig (object or dict)."""
-    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
-        hf_config, dict
-    ) else (lambda k, d=None: hf_config.get(k, d))
+    get = _getter(hf_config)
+    explicit_hd = get("head_dim")
+    derived_hd = get("hidden_size") // get("num_attention_heads")
+    if explicit_hd and explicit_hd != derived_hd:
+        raise ValueError(
+            f"unsupported: checkpoint sets head_dim={explicit_hd} but "
+            f"hidden_size/num_heads={derived_hd}; decoupled head dims "
+            "(e.g. Mistral-Nemo) are not implemented yet"
+        )
     return LlamaConfig(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -69,25 +82,63 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         rope_theta=get("rope_theta", 10000.0),
         rope_scaling=dict(get("rope_scaling")) if get("rope_scaling") else None,
         rms_norm_eps=get("rms_norm_eps", 1e-6),
+        attention_bias=bool(get("attention_bias", False)),
+        # Qwen2 ships sliding_window in every config but gates it off with
+        # use_sliding_window=False; only a window the reference model
+        # actually applies should restrict our forward
+        sliding_window=(
+            get("sliding_window")
+            if get("use_sliding_window", True) else None
+        ),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
     )
 
 
+def mistral_config_from_hf(hf_config) -> LlamaConfig:
+    """Mistral is llama-shaped; sliding-window attention is NOT applied, so
+    imports are exact for sequences up to `sliding_window` (4096 on the
+    published checkpoints — transformers itself only masks beyond it). The
+    window is recorded on the config and the forward refuses longer
+    sequences rather than silently attending globally."""
+    return llama_config_from_hf(hf_config)
+
+
+def qwen2_config_from_hf(hf_config) -> LlamaConfig:
+    """Qwen2 is llama-shaped with qkv projection biases."""
+    cfg = llama_config_from_hf(hf_config)
+    import dataclasses as _dc
+
+    return _dc.replace(cfg, attention_bias=True)
+
+
 def llama_params_from_hf(config: LlamaConfig, sd: Mapping[str, Any]) -> dict:
-    """Convert a `LlamaForCausalLM` state dict (HF names) to our pytree."""
+    """Convert a `LlamaForCausalLM`-shaped state dict (HF names) to our
+    pytree. Covers the whole llama family: Llama 1/2/3, Mistral, and Qwen2
+    (whose qkv biases import when `config.attention_bias`)."""
     L = config.num_hidden_layers
     p = "model."
     if f"{p}embed_tokens.weight" not in sd and "embed_tokens.weight" in sd:
         p = ""  # bare LlamaModel export
+
+    def attn_proj(name: str) -> dict:
+        out = {"kernel": _stack(
+            sd, p + "layers.{}.self_attn." + name + ".weight", L,
+            transpose=True)}
+        # follow the checkpoint exactly: HF llama's attention_bias puts a
+        # bias on all four projections, Qwen2 only on q/k/v
+        if p + "layers.0.self_attn." + name + ".bias" in sd:
+            out["bias"] = _stack(
+                sd, p + "layers.{}.self_attn." + name + ".bias", L,
+                transpose=False)
+        return out
+
     params = {
         "embed_tokens": {"embedding": _np(sd[f"{p}embed_tokens.weight"])},
         "layers": {
             "input_layernorm": {"scale": _stack(
                 sd, p + "layers.{}.input_layernorm.weight", L, transpose=False)},
             "attn": {
-                name: {"kernel": _stack(
-                    sd, p + "layers.{}.self_attn." + name + ".weight", L,
-                    transpose=True)}
+                name: attn_proj(name)
                 for name in ("q_proj", "k_proj", "v_proj", "o_proj")
             },
             "post_attention_layernorm": {"scale": _stack(
@@ -116,15 +167,7 @@ def llama_params_from_hf(config: LlamaConfig, sd: Mapping[str, Any]) -> dict:
 
 
 def mixtral_config_from_hf(hf_config) -> MixtralConfig:
-    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
-        hf_config, dict
-    ) else (lambda k, d=None: hf_config.get(k, d))
-    if get("rope_scaling"):
-        raise ValueError(
-            "this Mixtral checkpoint sets rope_scaling, which the mixtral "
-            "forward does not apply yet — importing it would silently degrade "
-            "long-context generation"
-        )
+    get = _getter(hf_config)
     return MixtralConfig(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -136,6 +179,7 @@ def mixtral_config_from_hf(hf_config) -> MixtralConfig:
         num_experts_per_tok=get("num_experts_per_tok", 2),
         max_position_embeddings=get("max_position_embeddings", 2048),
         rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=dict(get("rope_scaling")) if get("rope_scaling") else None,
         rms_norm_eps=get("rms_norm_eps", 1e-5),
     )
 
@@ -191,9 +235,7 @@ def mixtral_params_from_hf(config: MixtralConfig, sd: Mapping[str, Any]) -> dict
 
 
 def bert_config_from_hf(hf_config, num_labels: int | None = None) -> BertConfig:
-    get = (lambda k, d=None: getattr(hf_config, k, d)) if not isinstance(
-        hf_config, dict
-    ) else (lambda k, d=None: hf_config.get(k, d))
+    get = _getter(hf_config)
     return BertConfig(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -268,12 +310,141 @@ def bert_params_from_hf(config: BertConfig, sd: Mapping[str, Any]) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# GPT-2
+# ---------------------------------------------------------------------------
+
+
+def gpt2_config_from_hf(hf_config) -> "GPT2Config":
+    from .gpt2 import GPT2Config
+
+    get = _getter(hf_config)
+    return GPT2Config(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("n_embd") or get("hidden_size"),
+        num_hidden_layers=get("n_layer") or get("num_hidden_layers"),
+        num_attention_heads=get("n_head") or get("num_attention_heads"),
+        max_position_embeddings=get("n_positions") or get("max_position_embeddings", 1024),
+        layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gpt2_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
+    """Convert a `GPT2LMHeadModel` state dict. HF GPT-2 uses Conv1D layers
+    that already store kernels [in, out] — no transpose."""
+    L = config.num_hidden_layers
+    p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    hl = p + "h.{}."
+
+    def conv1d(template: str) -> dict:
+        return {
+            "kernel": _stack(sd, template + ".weight", L, transpose=False),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    def ln(template: str) -> dict:
+        return {
+            "scale": _stack(sd, template + ".weight", L, transpose=False),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    return {
+        "wte": {"embedding": _np(sd[p + "wte.weight"])},
+        "wpe": {"embedding": _np(sd[p + "wpe.weight"])},
+        "layers": {
+            "ln_1": ln(hl + "ln_1"),
+            "attn": {
+                "c_attn": conv1d(hl + "attn.c_attn"),
+                "c_proj": conv1d(hl + "attn.c_proj"),
+            },
+            "ln_2": ln(hl + "ln_2"),
+            "mlp": {
+                "c_fc": conv1d(hl + "mlp.c_fc"),
+                "c_proj": conv1d(hl + "mlp.c_proj"),
+            },
+        },
+        "ln_f": {
+            "scale": _np(sd[p + "ln_f.weight"]),
+            "bias": _np(sd[p + "ln_f.bias"]),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# GPT-NeoX
+# ---------------------------------------------------------------------------
+
+
+def gpt_neox_config_from_hf(hf_config) -> "GPTNeoXConfig":
+    from .gpt_neox import GPTNeoXConfig
+
+    get = _getter(hf_config)
+    return GPTNeoXConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        rotary_pct=get("rotary_pct", 0.25),
+        rotary_emb_base=get("rotary_emb_base", 10000.0),
+        layer_norm_eps=get("layer_norm_eps", 1e-5),
+        use_parallel_residual=bool(get("use_parallel_residual", True)),
+    )
+
+
+def gpt_neox_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
+    """Convert a `GPTNeoXForCausalLM` state dict. The fused qkv stays in
+    HF's per-head-interleaved out-dim layout ([head][q|k|v][head_dim]) —
+    the forward unpacks it the same way."""
+    L = config.num_hidden_layers
+    p = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+    hl = p + "layers.{}."
+
+    def lin(template: str) -> dict:
+        return {
+            "kernel": _stack(sd, template + ".weight", L, transpose=True),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    def ln(template: str) -> dict:
+        return {
+            "scale": _stack(sd, template + ".weight", L, transpose=False),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    return {
+        "embed_in": {"embedding": _np(sd[p + "embed_in.weight"])},
+        "layers": {
+            "input_layernorm": ln(hl + "input_layernorm"),
+            "attn": {
+                "query_key_value": lin(hl + "attention.query_key_value"),
+                "dense": lin(hl + "attention.dense"),
+            },
+            "post_attention_layernorm": ln(hl + "post_attention_layernorm"),
+            "mlp": {
+                "dense_h_to_4h": lin(hl + "mlp.dense_h_to_4h"),
+                "dense_4h_to_h": lin(hl + "mlp.dense_4h_to_h"),
+            },
+        },
+        "final_layer_norm": {
+            "scale": _np(sd[p + "final_layer_norm.weight"]),
+            "bias": _np(sd[p + "final_layer_norm.bias"]),
+        },
+        "embed_out": {"kernel": _np(sd["embed_out.weight"]).T},
+    }
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
 _FAMILIES = {
     "llama": (llama_config_from_hf, llama_params_from_hf),
+    "mistral": (mistral_config_from_hf, llama_params_from_hf),
+    "qwen2": (qwen2_config_from_hf, llama_params_from_hf),
     "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
+    "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "gpt_neox": (gpt_neox_config_from_hf, gpt_neox_params_from_hf),
     "bert": (bert_config_from_hf, bert_params_from_hf),
 }
 

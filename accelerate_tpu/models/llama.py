@@ -55,6 +55,15 @@ class LlamaConfig:
     # sorted item tuple so the config stays hashable (jit/lru_cache keys)
     rope_scaling: Any = None
     rms_norm_eps: float = 1e-6
+    # q/k/v projection biases, the Qwen2 layout (init_params mirrors it so
+    # init and HF-import trees match structurally); the forward applies
+    # whichever biases the param tree holds, so an HF-llama checkpoint with
+    # an o_proj bias still imports and runs exactly
+    attention_bias: bool = False
+    # Mistral-style sliding-window attention is NOT implemented; when set,
+    # the forward refuses sequences longer than the window instead of
+    # silently attending globally where HF would mask
+    sliding_window: int | None = None
     tie_word_embeddings: bool = False
     attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
     remat: bool = False
@@ -100,17 +109,21 @@ def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     h, kv = config.hidden_size, config.num_key_value_heads * config.head_dim
     L = config.num_hidden_layers
 
-    def stack(k, d_in, d_out):
-        return {"kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype)}
+    def stack(k, d_in, d_out, bias=False):
+        out = {"kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype)}
+        if bias:
+            out["bias"] = jnp.zeros((L, d_out), dtype)
+        return out
 
+    ab = config.attention_bias
     params = {
         "embed_tokens": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
         "layers": {
             "input_layernorm": {"scale": jnp.ones((L, h), dtype)},
             "attn": {
-                "q_proj": stack(keys[1], h, h),
-                "k_proj": stack(keys[2], h, kv),
-                "v_proj": stack(keys[3], h, kv),
+                "q_proj": stack(keys[1], h, h, bias=ab),
+                "k_proj": stack(keys[2], h, kv, bias=ab),
+                "v_proj": stack(keys[3], h, kv, bias=ab),
                 "o_proj": stack(keys[4], h, h),
             },
             "post_attention_layernorm": {"scale": jnp.ones((L, h), dtype)},
@@ -148,6 +161,12 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     q, mq = _dense_maybe_fp8(x, layer["attn"]["q_proj"]["kernel"], fa.get("q_proj"))
     k, mk = _dense_maybe_fp8(x, layer["attn"]["k_proj"]["kernel"], fa.get("k_proj"))
     v, mv = _dense_maybe_fp8(x, layer["attn"]["v_proj"]["kernel"], fa.get("v_proj"))
+    if "bias" in layer["attn"]["q_proj"]:
+        q = q + layer["attn"]["q_proj"]["bias"].astype(q.dtype)
+    if "bias" in layer["attn"]["k_proj"]:
+        k = k + layer["attn"]["k_proj"]["bias"].astype(k.dtype)
+    if "bias" in layer["attn"]["v_proj"]:
+        v = v + layer["attn"]["v_proj"]["bias"].astype(v.dtype)
     q = q.reshape(b, s, nh, hd)
     k = k.reshape(b, s, nkv, hd)
     v = v.reshape(b, s, nkv, hd)
@@ -203,6 +222,8 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     out = out.reshape(b, s, nh * hd)
     o, mo = _dense_maybe_fp8(out, layer["attn"]["o_proj"]["kernel"],
                              fa.get("o_proj"))
+    if "bias" in layer["attn"]["o_proj"]:
+        o = o + layer["attn"]["o_proj"]["bias"].astype(o.dtype)
     new_fp8 = (
         {"q_proj": mq, "k_proj": mk, "v_proj": mv, "o_proj": mo}
         if fp8 is not None else None
@@ -268,6 +289,21 @@ def forward(
     if fp8_state is not None and kv_caches is not None:
         raise ValueError("fp8 is a training-path feature; decode "
                          "(kv_caches) runs bf16")
+    if config.sliding_window is not None:
+        # the attention window must also cover decode: a kv cache longer
+        # than the window would let single-token steps attend globally past
+        # it, silently diverging from the reference model
+        reach = (
+            kv_caches[0][0].shape[1] if kv_caches is not None
+            else input_ids.shape[1]
+        )
+        if reach > config.sliding_window:
+            raise NotImplementedError(
+                f"attention reach {reach} exceeds this checkpoint's "
+                f"sliding_window={config.sliding_window}; sliding-window "
+                "attention is not implemented, and attending globally would "
+                "silently diverge from the reference model"
+            )
     x = params["embed_tokens"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(

@@ -43,12 +43,25 @@ class MixtralConfig:
     num_experts_per_tok: int = 2
     max_position_embeddings: int = 4096
     rope_theta: float = 1000000.0
+    # HF-style dict (e.g. {"rope_type": "linear", "factor": 2.0});
+    # normalized to a sorted item tuple so the config stays hashable
+    rope_scaling: object = None
     rms_norm_eps: float = 1e-5
     router_aux_loss_coef: float = 0.02
     remat: bool = False
     attention_backend: str = "auto"
     moe_impl: str = "dense"        # dense (exact) | sparse (capacity dispatch)
     capacity_factor: float = 1.25  # sparse mode: C = ceil(k*S/E * factor)
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(
+                self, "rope_scaling", tuple(sorted(self.rope_scaling.items()))
+            )
+
+    @property
+    def rope_scaling_dict(self) -> dict | None:
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     @property
     def head_dim(self) -> int:
@@ -77,7 +90,8 @@ class MixtralConfig:
             num_attention_heads=self.num_attention_heads,
             num_key_value_heads=self.num_key_value_heads,
             max_position_embeddings=self.max_position_embeddings,
-            rope_theta=self.rope_theta, rms_norm_eps=self.rms_norm_eps,
+            rope_theta=self.rope_theta, rope_scaling=self.rope_scaling_dict,
+            rms_norm_eps=self.rms_norm_eps,
             attention_backend=self.attention_backend,
         )
 
@@ -264,7 +278,8 @@ def forward(
     x = params["embed_tokens"]["embedding"][input_ids]
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     cos, sin = rope_frequencies(config.head_dim, config.max_position_embeddings,
-                                config.rope_theta)  # mixtral ships no rope_scaling
+                                config.rope_theta,
+                                scaling=config.rope_scaling_dict)
 
     def scan_body(carry, layer):
         x, aux_sum = carry

@@ -213,3 +213,171 @@ def test_linear_rope_scaling_parity():
         want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
     got = np.asarray(llama.forward(cfg, params, ids))
     np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_mistral_logit_parity():
+    from accelerate_tpu.models import hf_import, llama
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=4096,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(4)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("mistral", hf_cfg)
+    params = hf_import.params_from_hf("mistral", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(5).integers(0, 128, (2, 19)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(llama.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_logit_parity():
+    from accelerate_tpu.models import hf_import, llama
+
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=144,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(6)
+    hf_model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("qwen2", hf_cfg)
+    assert cfg.attention_bias  # qwen2 always carries qkv biases
+    params = hf_import.params_from_hf("qwen2", cfg, hf_model.state_dict())
+    assert "bias" in params["layers"]["attn"]["q_proj"]
+    ids = np.random.default_rng(7).integers(0, 128, (2, 23)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(llama.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_logit_parity():
+    from accelerate_tpu.models import gpt2, hf_import
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=160, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(8)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gpt2", hf_cfg)
+    params = hf_import.params_from_hf("gpt2", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(9).integers(0, 160, (2, 25)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(gpt2.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("parallel_residual", [True, False])
+def test_gpt_neox_logit_parity(parallel_residual):
+    from accelerate_tpu.models import gpt_neox, hf_import
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel_residual,
+        attention_dropout=0.0, hidden_dropout=0.0,
+    )
+    torch.manual_seed(10)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gpt_neox", hf_cfg)
+    params = hf_import.params_from_hf("gpt_neox", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(11).integers(0, 160, (2, 21)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(gpt_neox.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_mixtral_rope_scaling_importable():
+    """Mixtral + rope_scaling imports and applies the scaling (previously
+    refused outright)."""
+    from accelerate_tpu.models import hf_import, mixtral
+
+    cfg = hf_import.mixtral_config_from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "num_local_experts": 4,
+        "num_experts_per_tok": 2, "max_position_embeddings": 64,
+        "rope_scaling": {"rope_type": "linear", "factor": 2.0},
+    })
+    assert cfg.rope_scaling_dict == {"rope_type": "linear", "factor": 2.0}
+    params = mixtral.init_params(cfg, __import__("jax").random.key(0))
+    ids = np.arange(32, dtype=np.int32)[None, :]
+    out, _ = mixtral.forward(cfg, params, ids)
+    # scaling must actually change the logits vs the unscaled config
+    cfg0 = hf_import.mixtral_config_from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "num_local_experts": 4,
+        "num_experts_per_tok": 2, "max_position_embeddings": 64,
+    })
+    out0, _ = mixtral.forward(cfg0, params, ids)
+    assert not np.allclose(np.asarray(out), np.asarray(out0), atol=1e-4)
+
+
+def test_mistral_sliding_window_refused_beyond_window():
+    from accelerate_tpu.models import hf_import, llama
+
+    cfg = hf_import.config_from_hf("mistral", {
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "max_position_embeddings": 128,
+        "sliding_window": 16,
+    })
+    import jax
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    ids = np.zeros((1, 8), np.int32)
+    llama.forward(cfg, params, ids)  # within window: fine
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        llama.forward(cfg, params, np.zeros((1, 32), np.int32))
+
+
+def test_explicit_decoupled_head_dim_refused():
+    from accelerate_tpu.models import hf_import
+
+    with pytest.raises(ValueError, match="head_dim"):
+        hf_import.config_from_hf("mistral", {
+            "vocab_size": 64, "hidden_size": 5120, "intermediate_size": 64,
+            "num_hidden_layers": 1, "num_attention_heads": 32,
+            "head_dim": 128,
+        })
+
+
+def test_qwen2_unused_sliding_window_not_recorded():
+    from accelerate_tpu.models import hf_import
+
+    cfg = hf_import.config_from_hf("qwen2", {
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "max_position_embeddings": 128,
+        "sliding_window": 16, "use_sliding_window": False,
+    })
+    assert cfg.sliding_window is None
+
+
+def test_sliding_window_guard_covers_decode():
+    import jax
+
+    from accelerate_tpu.models import hf_import, llama
+
+    cfg = hf_import.config_from_hf("mistral", {
+        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_key_value_heads": 2, "max_position_embeddings": 128,
+        "sliding_window": 16,
+    })
+    params = llama.init_params(cfg, jax.random.key(0))
+    caches = llama.init_kv_caches(cfg, 1, 32)  # cache reach 32 > window 16
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        llama.forward(cfg, params, np.zeros((1, 8), np.int32),
+                      kv_caches=caches)
