@@ -796,10 +796,37 @@ class Accelerator:
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: bool | None = None):
-        """ref :1061-1146. GSPMD programs are globally scheduled, so uneven
-        inputs never deadlock; the loader's even_batches padding already
-        equalizes counts. Context kept for API parity."""
-        yield
+        """ref :1061-1146. Uneven inputs deadlock here only one way: hosts
+        running different LOOP counts (every collective is global). The data
+        layer's even_batches recycling already equalizes counts; this
+        context's `even_batches` kwarg (ref semantics) temporarily overrides
+        the flag on every prepared loader — so an even_batches=False loader
+        iterated inside `join_uneven_inputs(..., even_batches=True)` pads to
+        equal counts instead of desyncing the world."""
+        if even_batches is None:
+            yield
+            return
+        overridden = []
+
+        def _walk(obj, depth=0):
+            # prepared loaders nest (DataLoaderShard -> torch DataLoader ->
+            # BatchSamplerShard): override every even_batches along the
+            # chain — the sampler's flag is what decides iteration counts
+            if obj is None or depth > 4:
+                return
+            if hasattr(obj, "even_batches"):
+                overridden.append((obj, obj.even_batches))
+                obj.even_batches = even_batches
+            for attr in ("loader", "batch_sampler", "sampler"):
+                _walk(getattr(obj, attr, None), depth + 1)
+
+        for dl in self._dataloaders:
+            _walk(dl)
+        try:
+            yield
+        finally:
+            for obj, old in overridden:
+                obj.even_batches = old
 
     # ----------------------------------------------------------- lifecycle
     def free_memory(self, *objects):

@@ -41,6 +41,7 @@ class LaunchConfig:
     mesh_shape: str | None = None        # e.g. "data=-1" / "fsdp=8,model=4"
     gradient_accumulation_steps: int | None = None
     num_virtual_devices: int | None = None  # CPU-mesh debugging worlds
+    max_restarts: int | None = None      # relaunch a failed world N times
     use_cpu: bool = False
     debug: bool = False
     tpu_name: str | None = None

@@ -67,6 +67,11 @@ def add_launch_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
     parser.add_argument("--cpu", "--use_cpu", dest="cpu", action="store_true",
                         default=None, help="Force the CPU backend")
+    parser.add_argument("--max_restarts", type=int, default=None,
+                        help="Relaunch the whole world up to N times after a "
+                             "worker failure (scripts resume from their last "
+                             "checkpoint — torchrun-style elasticity, ref "
+                             "utils/constants.py:46-71)")
     parser.add_argument("--debug", action="store_true", default=None,
                         help="Collective shape-checking debug mode")
     # pod
@@ -93,7 +98,7 @@ def _merge_config(args: argparse.Namespace) -> argparse.Namespace:
     for field_name in (
         "num_machines", "machine_rank", "main_process_ip", "main_process_port",
         "mixed_precision", "mesh_shape", "gradient_accumulation_steps",
-        "num_virtual_devices", "debug", "tpu_name", "tpu_zone", "tpu_project",
+        "num_virtual_devices", "debug", "max_restarts", "tpu_name", "tpu_zone", "tpu_project",
     ):
         if getattr(args, field_name, None) is None:
             setattr(args, field_name, getattr(config, field_name, None))
@@ -112,7 +117,9 @@ def simple_launcher(args: argparse.Namespace) -> int:
 
 def local_world_launcher(args: argparse.Namespace) -> int:
     """N host processes on localhost rendezvousing via the JAX coordinator —
-    the reference's single-node torchrun/debug path, minus torchrun."""
+    the reference's single-node torchrun/debug path, minus torchrun. Each
+    invocation is one world attempt; ``--max_restarts`` retries live in
+    `launch_command` so every launch mode gets them."""
     import socket
 
     num = args.num_processes
@@ -179,11 +186,30 @@ def launch_command(args: argparse.Namespace) -> int:
         from ..utils.rich import install_pretty_traceback
 
         install_pretty_traceback()
-    if args.tpu_name:
-        return tpu_pod_launcher(args)
-    if args.num_processes and args.num_processes > 1:
-        return local_world_launcher(args)
-    return simple_launcher(args)
+
+    def run_once() -> int:
+        if args.tpu_name:
+            return tpu_pod_launcher(args)
+        if args.num_processes and args.num_processes > 1:
+            return local_world_launcher(args)
+        return simple_launcher(args)
+
+    # torchrun-style elasticity for EVERY launch mode (ref
+    # utils/constants.py:46-71 max_restarts): a failed world relaunches in
+    # full up to N times; scripts resume from their last checkpoint
+    max_restarts = getattr(args, "max_restarts", None) or 0
+    rc = 1
+    for attempt in range(max_restarts + 1):
+        if attempt:
+            print(
+                f"accelerate-tpu launch: world failed (exit {rc}); "
+                f"restart {attempt}/{max_restarts}",
+                file=sys.stderr,
+            )
+        rc = run_once()
+        if rc == 0:
+            return 0
+    return rc
 
 
 def main() -> int:

@@ -294,7 +294,7 @@ def forward(
         # than the window would let single-token steps attend globally past
         # it, silently diverging from the reference model
         reach = (
-            kv_caches[0][0].shape[1] if kv_caches is not None
+            kv_caches[0].shape[2] if kv_caches is not None
             else input_ids.shape[1]
         )
         if reach > config.sliding_window:
@@ -310,24 +310,32 @@ def forward(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
     max_len = (
-        kv_caches[0][0].shape[1] if kv_caches is not None
+        kv_caches[0].shape[2] if kv_caches is not None
         else config.max_position_embeddings
     )
     cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta,
                                 scaling=config.rope_scaling_dict)
 
     if kv_caches is not None:
-        # decode path: python loop over per-layer caches (stacked scan would
-        # need stacked caches; decode favors simplicity)
-        new_caches = []
-        for i in range(config.num_hidden_layers):
-            layer = jax.tree_util.tree_map(lambda p: p[i], params["layers"])
-            x, cache, _ = _layer_body(config, x, layer, cos, sin, positions,
-                                      attention_mask, kv_caches[i])
-            new_caches.append(cache)
+        # decode path: caches stack on a leading layer dim and ride the same
+        # lax.scan as training — ONE compiled layer body at any depth (the
+        # old per-layer python loop compiled L bodies per decode program)
+        ck, cv, cache_len = kv_caches
+
+        def decode_body(carry, xs):
+            layer, ck_l, cv_l = xs
+            y, cache, _ = _layer_body(config, carry, layer, cos, sin,
+                                      positions, attention_mask,
+                                      (ck_l, cv_l, cache_len))
+            nk, nv, _ = cache
+            return y, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(
+            decode_body, x, (params["layers"], ck, cv)
+        )
         x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
         logits = _project_out(config, params, x)
-        return logits, new_caches
+        return logits, (nk, nv, cache_len + input_ids.shape[1])
 
     body = partial(_layer_body, config)
 
@@ -529,16 +537,18 @@ def init_fp8_state(config: LlamaConfig, history_len: int = 16) -> dict:
 
 
 def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16):
+    """Stacked decode caches: (k [L, B, M, KV, D], v [L, B, M, KV, D],
+    cache_len scalar). The leading layer dim lets decode scan the layer body
+    (program size independent of depth); cache_len is a traced scalar so
+    decode steps never retrigger tracing."""
     kv_heads = config.num_key_value_heads
-    # cache_len is a traced scalar so decode steps never retrigger tracing
-    return [
-        (
-            jnp.zeros((batch, max_len, kv_heads, config.head_dim), dtype),
-            jnp.zeros((batch, max_len, kv_heads, config.head_dim), dtype),
-            jnp.zeros((), jnp.int32),
-        )
-        for _ in range(config.num_hidden_layers)
-    ]
+    L = config.num_hidden_layers
+    shape = (L, batch, max_len, kv_heads, config.head_dim)
+    return (
+        jnp.zeros(shape, dtype),
+        jnp.zeros(shape, dtype),
+        jnp.zeros((), jnp.int32),
+    )
 
 
 @functools.lru_cache(maxsize=32)

