@@ -807,13 +807,18 @@ class Accelerator:
             yield
             return
         overridden = []
+        seen: set[int] = set()
 
         def _walk(obj, depth=0):
             # prepared loaders nest (DataLoaderShard -> torch DataLoader ->
             # BatchSamplerShard): override every even_batches along the
-            # chain — the sampler's flag is what decides iteration counts
-            if obj is None or depth > 4:
+            # chain — the sampler's flag is what decides iteration counts.
+            # The seen-set keeps an object reachable twice (e.g. via a
+            # re-prepared loader) from recording its overridden value as
+            # "original", which would make the restore stick
+            if obj is None or depth > 4 or id(obj) in seen:
                 return
+            seen.add(id(obj))
             if hasattr(obj, "even_batches"):
                 overridden.append((obj, obj.even_batches))
                 obj.even_batches = even_batches

@@ -188,8 +188,6 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         causal = False
     else:
         causal = True
-    k = repeat_kv(k, nh // nkv)
-    v = repeat_kv(v, nh // nkv)
     backend = config.attention_backend
     if backend == "auto":
         # the einsum path materializes [B,H,S,S] f32 scores in HBM and is
@@ -203,22 +201,27 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         )
     # flash takes key-padding masks ([B, S]) natively; ring/ulysses still
     # require mask-free batches
-    if backend == "flash" and kv_cache is None and (
-        mask is None or getattr(mask, "ndim", 0) == 2
-    ):
-        from ..ops.flash_attention import flash_attention
-
-        out = flash_attention(q, k, v, causal=True, mask=mask)
-    elif backend == "ring" and kv_cache is None and mask is None:
+    if backend == "ring" and kv_cache is None and mask is None:
+        # ring handles GQA itself: un-repeated K/V chunks ride the ring (the
+        # repeat factor never touches ICI)
         from ..parallel.ring_attention import ring_attention
 
         out = ring_attention(q, k, v, causal=True)
-    elif backend == "ulysses" and kv_cache is None and mask is None:
-        from ..parallel.ulysses import ulysses_attention
-
-        out = ulysses_attention(q, k, v, causal=True)
     else:
-        out = dot_product_attention(q, k, v, mask=mask, causal=causal)
+        k = repeat_kv(k, nh // nkv)
+        v = repeat_kv(v, nh // nkv)
+        if backend == "flash" and kv_cache is None and (
+            mask is None or getattr(mask, "ndim", 0) == 2
+        ):
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True, mask=mask)
+        elif backend == "ulysses" and kv_cache is None and mask is None:
+            from ..parallel.ulysses import ulysses_attention
+
+            out = ulysses_attention(q, k, v, causal=True)
+        else:
+            out = dot_product_attention(q, k, v, mask=mask, causal=causal)
     out = out.reshape(b, s, nh * hd)
     o, mo = _dense_maybe_fp8(out, layer["attn"]["o_proj"]["kernel"],
                              fa.get("o_proj"))

@@ -1,16 +1,26 @@
 """Ring attention: sequence/context parallelism over the mesh `seq` axis.
 
 The reference has NO context parallelism (SURVEY.md §2.2 — grep-verified
-absent); this exceeds parity and is the long-context answer. Each device holds
-a sequence chunk of Q/K/V; K/V chunks rotate around the ring via
-`lax.ppermute` (XLA collective-permute over ICI) while a running online
-softmax (max/sum accumulators, flash-attention style) folds in each chunk's
-contribution. Peak memory is O(S_local) per device; the S x S score matrix is
-never materialized globally.
+absent); this exceeds parity and is the long-context answer. Each device
+holds a sequence chunk of Q/K/V; K/V chunks rotate around the ring via
+`lax.ppermute` (XLA collective-permute over ICI) while per-chunk outputs
+fold through a log-sum-exp combine. Peak memory is O(S_local) per device;
+the S x S score matrix is never materialized globally.
 
-Implementation is `shard_map` inside jit — compiler-visible collectives, so
-XLA overlaps the permute with the block computation. Differentiable end to
-end (ppermute has a transpose rule), so it works for training.
+Compute path: each ring step runs the pallas flash kernel
+(ops/flash_attention.py — bf16 MXU dots, O(block) VMEM), so long-context
+throughput is flash-rate, not einsum-rate. The backward is the ring form
+of FlashAttention-2 (Liu et al.'s ring attention): the saved GLOBAL
+logsumexp makes every chunk's recomputed probabilities exact, dQ
+accumulates locally, and dK/dV accumulators ride the rotating K/V buffers
+until a full rotation returns them to their owner device.
+
+GQA: K/V ring un-repeated (kv heads only — the repeat factor never
+touches ICI); heads repeat per chunk right before the kernel, and dK/dV
+reduce back over the repeat groups.
+
+Chunks too small for the kernel (under one 16-row block) fall back to the
+einsum ring, same math at einsum rate.
 """
 
 from __future__ import annotations
@@ -23,14 +33,179 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import AXIS_SEQ
+from ..models.common import repeat_kv as _repeat_heads
+from ..ops.flash_attention import (
+    _flash_backward,
+    _flash_forward,
+    _pow2_floor,
+)
 
 NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int, causal: bool):
-    """Runs INSIDE shard_map. q,k,v: [B, S_local, H, D] (this device's chunk).
-    `axis_size` is static (from mesh.shape) so the ring permutation and scan
-    length are compile-time constants."""
+# ---------------------------------------------------------------------------
+# flash-kernel chunk helpers ([B, S, H, D] <-> kernel's [BH, S, D])
+# ---------------------------------------------------------------------------
+
+
+def _chunk_blocks(s_local: int) -> int:
+    return _pow2_floor(min(512, s_local))
+
+
+def _chunk_fwd(q, k, v, causal: bool, interpret: bool):
+    """One chunk pair through the flash kernel; returns (o, lse[B,H,S])."""
+    b, s, h, d = q.shape
+    blk = _chunk_blocks(s)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    o, lse = _flash_forward(qf, kf, vf, causal, blk, blk, interpret,
+                            save_residuals=True)
+    o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return o, lse[..., 0].reshape(b, h, s)
+
+
+def _chunk_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool):
+    """Flash backward for one chunk pair using the GLOBAL lse — exactly the
+    ring-attention backward: p = exp(s - lse_global) are the true
+    (unnormalized-by-chunk) probabilities, delta = rowsum(do * o_global)."""
+    b, s, h, d = q.shape
+    blk = _chunk_blocks(s)
+    to_f = lambda t: t.transpose(0, 2, 1, 3).reshape(b * h, s, d)  # noqa: E731
+    dq, dk, dv = _flash_backward(
+        to_f(q), to_f(k), to_f(v), to_f(o),
+        lse.reshape(b * h, s), to_f(do),
+        causal, blk, blk, interpret,
+    )
+    back = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return back(dq), back(dk), back(dv)
+
+
+def _reduce_heads(full, n_rep: int):
+    """Sum gradients over the repeat groups back to kv heads."""
+    if n_rep == 1:
+        return full
+    b, s, h, d = full.shape
+    return full.reshape(b, s, h // n_rep, n_rep, d).sum(axis=3)
+
+
+# ---------------------------------------------------------------------------
+# ring forward/backward (runs INSIDE shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _fold(out, lse, o_i, lse_i, visible):
+    """Streaming log-sum-exp combine of per-chunk normalized outputs."""
+    lse_i = jnp.where(visible, lse_i, NEG_INF)
+    new_lse = jnp.logaddexp(lse, lse_i)
+    safe = jnp.maximum(new_lse, NEG_INF / 2)
+    w_old = jnp.exp(lse - safe)[..., None]
+    w_new = jnp.exp(lse_i - safe)[..., None]
+    # [B,H,S] weights onto [B,S,H,D] outputs
+    w_old = w_old.transpose(0, 2, 1, 3)
+    w_new = w_new.transpose(0, 2, 1, 3)
+    return out * w_old + o_i * w_new, new_lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
+    return _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep,
+                           interpret)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
+    my = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    # step 0: the diagonal chunk (causal within the chunk)
+    o0, lse0 = _chunk_fwd(q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep),
+                          causal, interpret)
+    out, lse = o0.astype(jnp.float32), lse0
+
+    def step(carry, t):
+        out, lse, k_cur, v_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (my - t) % axis_size
+        visible = (src < my) if causal else jnp.bool_(True)
+        o_i, lse_i = _chunk_fwd(
+            q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
+            False, interpret,
+        )
+        out, lse = _fold(out, lse, o_i.astype(jnp.float32), lse_i, visible)
+        return (out, lse, k_cur, v_cur), None
+
+    if axis_size > 1:
+        (out, lse, _, _), _ = jax.lax.scan(
+            step, (out, lse, k, v), jnp.arange(1, axis_size)
+        )
+    out = out.astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
+    q, k, v, o, lse = res
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    lse_f = lse  # [B,H,S] global logsumexp
+
+    # diagonal chunk
+    dq, dk0, dv0 = _chunk_bwd(
+        q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep), o, lse_f, g,
+        causal, interpret,
+    )
+    dq = dq.astype(jnp.float32)
+    dk_cur = _reduce_heads(dk0.astype(jnp.float32), n_rep)
+    dv_cur = _reduce_heads(dv0.astype(jnp.float32), n_rep)
+
+    def step(carry, t):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        src = (my - t) % axis_size
+        visible = (src < my) if causal else jnp.bool_(True)
+        w = jnp.where(visible, 1.0, 0.0).astype(jnp.float32)
+        dq_i, dk_i, dv_i = _chunk_bwd(
+            q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
+            o, lse_f, g, False, interpret,
+        )
+        dq = dq + dq_i.astype(jnp.float32) * w
+        dk_cur = dk_cur + _reduce_heads(dk_i.astype(jnp.float32), n_rep) * w
+        dv_cur = dv_cur + _reduce_heads(dv_i.astype(jnp.float32), n_rep) * w
+        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+
+    if axis_size > 1:
+        (dq, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
+            step, (dq, k, v, dk_cur, dv_cur), jnp.arange(1, axis_size)
+        )
+        # the accumulators have rotated axis_size-1 times; one more rotation
+        # brings each chunk's dK/dV home to its owner
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+    return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                          causal: bool, n_rep: int, interpret: bool):
+    """Runs INSIDE shard_map. q: [B, S_local, H, D]; k/v may carry fewer
+    (kv) heads — they ring un-repeated."""
+    return _ring_flash(q, k, v, axis_name, axis_size, causal, n_rep,
+                       interpret)
+
+
+# ---------------------------------------------------------------------------
+# einsum fallback ring (tiny chunks / no kernel)
+# ---------------------------------------------------------------------------
+
+
+def _ring_attention_local_einsum(q, k, v, *, axis_name: str, axis_size: int,
+                                 causal: bool, n_rep: int):
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -42,8 +217,8 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int, causal: bo
     row_sum = jnp.zeros((b, h, s_local), jnp.float32)
 
     def fold_chunk(acc, row_max, row_sum, k_cur, v_cur, src):
-        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
+        kf = _repeat_heads(k_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
+        vf = _repeat_heads(v_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
         if causal:
             q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
@@ -90,9 +265,10 @@ def ring_attention(
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over the mesh `seq` axis.
 
-    Call from inside a jitted model forward: wraps itself in `shard_map` over
-    the provided (or ambient) mesh. Falls back to plain attention when the
-    mesh has no seq axis. GQA heads must be pre-repeated.
+    Call from inside a jitted model forward: wraps itself in `shard_map`
+    over the provided (or ambient) mesh. Falls back to plain attention when
+    the mesh has no seq axis. K/V may carry fewer heads (GQA) — they ring
+    un-repeated and the repeat happens per chunk at the kernel boundary.
     """
     if mesh is None:
         from ..state import PartialState
@@ -110,13 +286,28 @@ def ring_attention(
         # S-1 tokens of a causal-LM loss): plain attention
         from ..models.common import dot_product_attention
 
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, _repeat_heads(k, q.shape[2] // k.shape[2]),
+                                     _repeat_heads(v, q.shape[2] // v.shape[2]),
+                                     causal=causal)
+
+    axis_size = mesh.shape[axis_name]
+    n_rep = q.shape[2] // k.shape[2]
+    s_local = q.shape[1] // axis_size
+    interpret = jax.devices()[0].platform != "tpu"
+    blk = _chunk_blocks(s_local)
+    use_kernel = blk >= 16 and s_local % blk == 0
 
     seq_spec = P(None, axis_name, None, None)
-    fn = partial(
-        _ring_attention_local, axis_name=axis_name,
-        axis_size=mesh.shape[axis_name], causal=causal,
-    )
+    if use_kernel:
+        fn = partial(
+            _ring_attention_local, axis_name=axis_name, axis_size=axis_size,
+            causal=causal, n_rep=n_rep, interpret=interpret,
+        )
+    else:
+        fn = partial(
+            _ring_attention_local_einsum, axis_name=axis_name,
+            axis_size=axis_size, causal=causal, n_rep=n_rep,
+        )
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
