@@ -128,12 +128,25 @@ def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         src = (my - t) % axis_size
-        visible = (src < my) if causal else jnp.bool_(True)
-        o_i, lse_i = _chunk_fwd(
-            q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
-            False, interpret,
-        )
-        out, lse = _fold(out, lse, o_i.astype(jnp.float32), lse_i, visible)
+
+        def live(_):
+            o_i, lse_i = _chunk_fwd(
+                q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
+                False, interpret,
+            )
+            return o_i.astype(jnp.float32), lse_i
+
+        def dead(_):
+            # chunk invisible under causality: skip the kernel entirely
+            # (folding an unmasked chunk's exp(s - lse_global) could
+            # overflow, and its compute would be discarded anyway)
+            return jnp.zeros_like(out), jnp.full_like(lse, NEG_INF)
+
+        if causal:
+            o_i, lse_i = jax.lax.cond(src < my, live, dead, None)
+        else:
+            o_i, lse_i = live(None)
+        out, lse = _fold(out, lse, o_i, lse_i, jnp.bool_(True))
         return (out, lse, k_cur, v_cur), None
 
     if axis_size > 1:
@@ -159,6 +172,8 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
     dk_cur = _reduce_heads(dk0.astype(jnp.float32), n_rep)
     dv_cur = _reduce_heads(dv0.astype(jnp.float32), n_rep)
 
+    h_full = q.shape[2]
+
     def step(carry, t):
         dq, k_cur, v_cur, dk_cur, dv_cur = carry
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -166,15 +181,31 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
         dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
         src = (my - t) % axis_size
-        visible = (src < my) if causal else jnp.bool_(True)
-        w = jnp.where(visible, 1.0, 0.0).astype(jnp.float32)
-        dq_i, dk_i, dv_i = _chunk_bwd(
-            q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
-            o, lse_f, g, False, interpret,
-        )
-        dq = dq + dq_i.astype(jnp.float32) * w
-        dk_cur = dk_cur + _reduce_heads(dk_i.astype(jnp.float32), n_rep) * w
-        dv_cur = dv_cur + _reduce_heads(dv_i.astype(jnp.float32), n_rep) * w
+
+        def live(_):
+            return _chunk_bwd(
+                q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
+                o, lse_f, g, False, interpret,
+            )
+
+        def dead(_):
+            # invisible chunk: no contribution; skipping the kernel avoids
+            # exp(s - lse_global) overflow (NaN via inf * 0) and the wasted
+            # backward FLOPs
+            b, s_l, _, d = q.shape
+            return (
+                jnp.zeros_like(q),
+                jnp.zeros((b, s_l, h_full, d), k_cur.dtype),
+                jnp.zeros((b, s_l, h_full, d), v_cur.dtype),
+            )
+
+        if causal:
+            dq_i, dk_i, dv_i = jax.lax.cond(src < my, live, dead, None)
+        else:
+            dq_i, dk_i, dv_i = live(None)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur + _reduce_heads(dk_i.astype(jnp.float32), n_rep)
+        dv_cur = dv_cur + _reduce_heads(dv_i.astype(jnp.float32), n_rep)
         return (dq, k_cur, v_cur, dk_cur, dv_cur), None
 
     if axis_size > 1:

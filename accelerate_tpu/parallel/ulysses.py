@@ -58,9 +58,17 @@ def ulysses_attention(
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over the mesh `seq` axis via
-    head-scatter all-to-all. GQA heads must be pre-repeated (same contract as
-    `ring_attention`). Falls back to plain attention when no seq axis exists
-    or shapes don't divide."""
+    head-scatter all-to-all. K/V may carry fewer (GQA) heads — they repeat
+    to the full head count here, matching `ring_attention`'s accepted
+    inputs (the ring keeps them un-repeated on the wire; ulysses scatters
+    full heads). Falls back to plain attention when no seq axis exists or
+    shapes don't divide."""
+    if k.shape[2] != q.shape[2]:
+        from ..models.common import repeat_kv
+
+        rep = q.shape[2] // k.shape[2]
+        k = repeat_kv(k, rep)
+        v = repeat_kv(v, rep)
     if mesh is None:
         from ..state import PartialState
 

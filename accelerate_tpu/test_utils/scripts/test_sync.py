@@ -7,7 +7,11 @@ Every rank asserts:
 - k accumulated micro-batches produce the same update as one k-times-larger
   batch (the functional analogue of the reference's DDP no_sync grad-equality
   check);
-- after a sync step every process holds bitwise-identical parameters.
+- after a sync step every process holds bitwise-identical parameters;
+- the eager chain (compute_gradients -> backward -> clip_grad_norm_ ->
+  step) produces the SAME parameters as the fused train_step, on every
+  rank — the reference-style migration path is semantically pinned to the
+  well-tested fused program.
 """
 
 from __future__ import annotations
@@ -130,6 +134,54 @@ def check_params_identical_across_ranks():
     assert len(set(everyone)) == 1, f"params diverged across ranks: {everyone}"
 
 
+def check_eager_chain_matches_fused():
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.test_utils import host_values
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        regression_loss,
+        regression_params,
+    )
+    from accelerate_tpu.utils.operations import gather_object
+
+    ds = RegressionDataset(length=32, seed=7)
+    raw = [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 32, 8)]
+
+    # fused reference
+    PartialState._reset_state()
+    acc = Accelerator(gradient_clipping=0.5)
+    loader = acc.prepare(raw)
+    ts = acc.prepare(TrainState.create(
+        apply_fn=None, params=regression_params(), tx=optax.sgd(0.1)))
+    step = acc.train_step(regression_loss)
+    for batch in loader:
+        ts, _ = step(ts, batch)
+    fused = {k: float(host_values(v)) for k, v in ts.params.items()}
+
+    # eager chain, same hyperparameters
+    PartialState._reset_state()
+    acc = Accelerator()
+    loader = acc.prepare(raw)
+    opt = acc.prepare_optimizer(optax.sgd(0.1), params=acc.prepare(regression_params()))
+    for batch in loader:
+        with acc.accumulate():
+            _, grads = acc.compute_gradients(regression_loss, opt.params, batch)
+            acc.backward(grads)
+            acc.clip_grad_norm_(max_norm=0.5)
+            opt.step()
+            opt.zero_grad()
+    eager = {k: float(host_values(v)) for k, v in opt.params.items()}
+
+    for k in fused:
+        assert abs(fused[k] - eager[k]) < 1e-5, (k, fused[k], eager[k])
+    everyone = gather_object(tuple(sorted(eager.items())))
+    assert len(set(everyone)) == 1, f"eager params diverged: {everyone}"
+
+
 def main() -> None:
     from accelerate_tpu.state import PartialState
 
@@ -138,6 +190,7 @@ def main() -> None:
     check_sync_flag_schedule()
     check_accumulation_equivalence()
     check_params_identical_across_ranks()
+    check_eager_chain_matches_fused()
     state = PartialState()
     if state.is_main_process:
         print(f"test_sync: ALL CHECKS PASSED ({world} process(es))")

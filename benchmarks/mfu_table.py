@@ -1,0 +1,82 @@
+"""MFU table: the single-chip Llama bench at increasing model scale.
+
+BASELINE.md phrases the target as Llama-3-8B on v5p-64; one v5e chip
+(16 GB) can't hold that, so this table quantifies how MFU trends as the
+proxy grows toward it — larger hidden sizes make bigger MXU matmuls, so
+per-chip MFU at 8B/v5p should sit at or above the largest row here.
+
+Run: python benchmarks/mfu_table.py [name ...]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.models.common import count_params
+from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
+
+CONFIGS = {
+    # name: (hidden, ffn, layers, heads, kv_heads, batch, seq, remat_policy,
+    #        bf16_moments) — the 16 GB chip fits the larger rows only with
+    #        bf16 adam moments (a standard large-model recipe) and, at 1B,
+    #        full remat
+    "400M": (1536, 4096, 12, 12, 4, 8, 2048, "dots", False),
+    "700M": (2048, 5504, 12, 16, 8, 4, 2048, "dots", True),
+    "1B": (2048, 5504, 20, 16, 8, 4, 2048, "full", True),
+}
+
+
+def run(name: str, steps: int = 15) -> None:
+    import jax.numpy as jnp
+
+    h, f, L, nh, nkv, batch, seq, policy, bf16_m = CONFIGS[name]
+    cfg = llama.LlamaConfig(
+        vocab_size=32000, hidden_size=h, intermediate_size=f,
+        num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nkv,
+        max_position_embeddings=seq, remat=True, remat_policy=policy,
+    )
+    acc = Accelerator(mixed_precision="bf16", gradient_clipping=1.0)
+    params = llama.init_params(cfg, jax.random.key(0))
+    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16 if bf16_m else None)
+    ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=tx))
+    n_params = count_params(ts.params)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32)
+    loader = acc.prepare([{"input_ids": ids}])
+    (b,) = list(loader)
+    step = acc.train_step(lambda p, bb: llama.causal_lm_loss(cfg, p, bb))
+    try:
+        ts, m = step(ts, b)
+        float(m["loss"])
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:5s}: FAILED {type(e).__name__}: {str(e)[:100]}", flush=True)
+        return
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            ts, m = step(ts, b)
+        float(m["loss"])
+        best = min(best, time.perf_counter() - t0)
+    tok_s = batch * seq * steps / best
+    attn = 12 * L * h * seq
+    flops_tok = 6 * n_params + attn
+    device_kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    peak = next((v for k, v in TPU_PEAK_FLOPS.items() if k in device_kind), 197e12)
+    mfu = flops_tok * tok_s / peak
+    print(f"{name:5s}: {n_params/1e6:7.1f}M params  b={batch} s={seq}  "
+          f"{tok_s:9.1f} tok/s  mfu={mfu:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(CONFIGS)
+    for n in names:
+        run(n)

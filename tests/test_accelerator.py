@@ -409,3 +409,35 @@ def test_join_uneven_inputs_overrides_nested_sampler():
     with acc.join_uneven_inputs([None], even_batches=True):
         assert sampler.even_batches is True
     assert sampler.even_batches is False
+
+
+def test_clip_grad_norm_semantics():
+    """Returned value is the pre-clip global norm; post-clip norm is
+    min(norm, max_norm) across ALL prepared optimizers as one group."""
+    import optax
+
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator()
+    apply_fn, params = make_model()
+    opt = acc.prepare_optimizer(optax.sgd(0.1), params=acc.prepare(params))
+    loss_fn = loss_fn_for(apply_fn)
+    x, y = make_regression_data(16)
+    with acc.accumulate():
+        _, grads = acc.compute_gradients(loss_fn, opt.params, {"x": x, "y": y})
+        acc.backward(grads)
+        pre_norm = float(optax.global_norm(opt.gradients))
+        returned = float(acc.clip_grad_norm_(max_norm=pre_norm / 2))
+        post_norm = float(optax.global_norm(opt.gradients))
+    assert abs(returned - pre_norm) < 1e-5 * max(1.0, pre_norm)
+    assert post_norm <= pre_norm / 2 * 1.001
+    # a max_norm above the actual norm must leave gradients untouched
+    with acc.accumulate():
+        _, grads = acc.compute_gradients(loss_fn, opt.params, {"x": x, "y": y})
+        opt.zero_grad()
+        acc.backward(grads)
+        pre = np.asarray(opt.gradients["dense"]["kernel"])
+        acc.clip_grad_norm_(max_norm=1e9)
+        np.testing.assert_allclose(
+            np.asarray(opt.gradients["dense"]["kernel"]), pre, rtol=1e-6)
