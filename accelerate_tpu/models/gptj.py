@@ -1,0 +1,164 @@
+"""GPT-J causal LM (the GPT-J-6B rows of the reference's big-model-inference
+benchmark, ref benchmarks/README.md:29-30).
+
+Same TPU-first scan-over-stacked-layers layout as the other families.
+GPT-J specifics: a SINGLE LayerNorm per layer feeding both attention and
+MLP (parallel residual), partial rotary embeddings in the interleaved
+"rotate every two" convention (unlike llama/NeoX's rotate-half), no
+attention biases, and an untied LM head WITH bias.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import (
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    layer_norm,
+    normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTJConfig:
+    vocab_size: int = 50400
+    hidden_size: int = 4096          # n_embd
+    num_hidden_layers: int = 28      # n_layer
+    num_attention_heads: int = 16    # n_head
+    max_position_embeddings: int = 2048  # n_positions
+    rotary_dim: int = 64
+    layer_norm_epsilon: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **overrides) -> "GPTJConfig":
+        defaults = dict(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=128, rotary_dim=8,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def init_params(config: GPTJConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 8)
+    h, L = config.hidden_size, config.num_hidden_layers
+
+    def lin(k, d_in, d_out, bias=True):
+        out = {"kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype)}
+        if bias:
+            out["bias"] = jnp.zeros((L, d_out), dtype)
+        return out
+
+    return {
+        "wte": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "layers": {
+            "ln_1": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
+            "attn": {
+                "q_proj": lin(keys[1], h, h, bias=False),
+                "k_proj": lin(keys[2], h, h, bias=False),
+                "v_proj": lin(keys[3], h, h, bias=False),
+                "out_proj": lin(keys[4], h, h, bias=False),
+            },
+            "mlp": {
+                "fc_in": lin(keys[5], h, 4 * h),
+                "fc_out": lin(keys[6], 4 * h, h),
+            },
+        },
+        "ln_f": {"scale": jnp.ones((h,), dtype), "bias": jnp.zeros((h,), dtype)},
+        "lm_head": {
+            "kernel": normal_init(keys[7], (h, config.vocab_size), 0.02, dtype),
+            "bias": jnp.zeros((config.vocab_size,), dtype),
+        },
+    }
+
+
+def _interleaved_rope_tables(rotary_dim: int, max_len: int, dtype=jnp.float32):
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, rotary_dim, 2) / rotary_dim))
+    t = np.arange(max_len)
+    freqs = np.einsum("i,j->ij", t, inv_freq)          # [T, rot/2]
+    return jnp.asarray(np.sin(freqs), dtype), jnp.asarray(np.cos(freqs), dtype)
+
+
+def _rotate_every_two(x):
+    x1 = x[..., ::2]
+    x2 = x[..., 1::2]
+    return jnp.stack((-x2, x1), axis=-1).reshape(x.shape)
+
+
+def _apply_interleaved_rope(x, sin, cos, positions):
+    """GPT-J rotary: pairs are interleaved (dims 0&1, 2&3, ...) rather than
+    split-half; sin/cos repeat per pair."""
+    sin_p = jnp.repeat(sin[positions], 2, axis=-1)[:, :, None, :]
+    cos_p = jnp.repeat(cos[positions], 2, axis=-1)[:, :, None, :]
+    return x * cos_p + _rotate_every_two(x) * sin_p
+
+
+def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask):
+    b, s, h = x.shape
+    nh, hd, rot = config.num_attention_heads, config.head_dim, config.rotary_dim
+    eps = config.layer_norm_epsilon
+
+    y = layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"], eps)
+    q = dense(y, layer["attn"]["q_proj"]["kernel"]).reshape(b, s, nh, hd)
+    k = dense(y, layer["attn"]["k_proj"]["kernel"]).reshape(b, s, nh, hd)
+    v = dense(y, layer["attn"]["v_proj"]["kernel"]).reshape(b, s, nh, hd)
+    q = jnp.concatenate([
+        _apply_interleaved_rope(q[..., :rot], sin, cos, positions),
+        q[..., rot:],
+    ], axis=-1)
+    k = jnp.concatenate([
+        _apply_interleaved_rope(k[..., :rot], sin, cos, positions),
+        k[..., rot:],
+    ], axis=-1)
+    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    attn_out = dense(attn.reshape(b, s, h), layer["attn"]["out_proj"]["kernel"])
+
+    # parallel residual off the SAME ln_1 output
+    m = dense(y, layer["mlp"]["fc_in"]["kernel"], layer["mlp"]["fc_in"]["bias"])
+    m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(x.dtype)
+    mlp_out = dense(m, layer["mlp"]["fc_out"]["kernel"], layer["mlp"]["fc_out"]["bias"])
+    return x + attn_out + mlp_out
+
+
+def forward(
+    config: GPTJConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    x = params["wte"]["embedding"][input_ids]
+    positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    sin, cos = _interleaved_rope_tables(
+        config.rotary_dim, config.max_position_embeddings
+    )
+
+    def scan_body(carry, layer):
+        return _layer_body(config, carry, layer, sin, cos, positions,
+                           attention_mask), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                   config.layer_norm_epsilon)
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    ) + params["lm_head"]["bias"].astype(jnp.float32)
+
+
+def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict) -> jax.Array:
+    input_ids = batch["input_ids"]
+    labels = input_ids[:, 1:]
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    logits = forward(config, params, input_ids[:, :-1])
+    return cross_entropy_loss(logits, labels, mask)

@@ -435,6 +435,235 @@ def gpt_neox_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# GPT-J
+# ---------------------------------------------------------------------------
+
+
+def gptj_config_from_hf(hf_config) -> "GPTJConfig":
+    from .gptj import GPTJConfig
+
+    get = _getter(hf_config)
+    return GPTJConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("n_embd") or get("hidden_size"),
+        num_hidden_layers=get("n_layer") or get("num_hidden_layers"),
+        num_attention_heads=get("n_head") or get("num_attention_heads"),
+        max_position_embeddings=get("n_positions") or get("max_position_embeddings", 2048),
+        # HF allows rotary_dim=None meaning rotate the full head dim
+        rotary_dim=(
+            get("rotary_dim", 64)
+            if get("rotary_dim", 64) is not None
+            else (get("n_embd") or get("hidden_size"))
+            // (get("n_head") or get("num_attention_heads"))
+        ),
+        layer_norm_epsilon=get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gptj_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
+    """Convert a `GPTJForCausalLM` state dict (nn.Linear -> transpose)."""
+    L = config.num_hidden_layers
+    p = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    hl = p + "h.{}."
+
+    def lin(template: str, bias: bool = True) -> dict:
+        out = {"kernel": _stack(sd, template + ".weight", L, transpose=True)}
+        if bias:
+            out["bias"] = _stack(sd, template + ".bias", L, transpose=False)
+        return out
+
+    return {
+        "wte": {"embedding": _np(sd[p + "wte.weight"])},
+        "layers": {
+            "ln_1": {
+                "scale": _stack(sd, hl + "ln_1.weight", L, transpose=False),
+                "bias": _stack(sd, hl + "ln_1.bias", L, transpose=False),
+            },
+            "attn": {
+                "q_proj": lin(hl + "attn.q_proj", bias=False),
+                "k_proj": lin(hl + "attn.k_proj", bias=False),
+                "v_proj": lin(hl + "attn.v_proj", bias=False),
+                "out_proj": lin(hl + "attn.out_proj", bias=False),
+            },
+            "mlp": {
+                "fc_in": lin(hl + "mlp.fc_in"),
+                "fc_out": lin(hl + "mlp.fc_out"),
+            },
+        },
+        "ln_f": {
+            "scale": _np(sd[p + "ln_f.weight"]),
+            "bias": _np(sd[p + "ln_f.bias"]),
+        },
+        "lm_head": {
+            "kernel": _np(sd["lm_head.weight"]).T,
+            "bias": _np(sd["lm_head.bias"]),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# OPT
+# ---------------------------------------------------------------------------
+
+
+def opt_config_from_hf(hf_config) -> "OPTConfig":
+    from .opt import OPTConfig
+
+    get = _getter(hf_config)
+    if get("do_layer_norm_before") is False:
+        raise ValueError(
+            "unsupported: OPT-350M-style post-LN (do_layer_norm_before="
+            "False); all other published OPT sizes are pre-LN and import"
+        )
+    if get("word_embed_proj_dim") and get("word_embed_proj_dim") != get("hidden_size"):
+        raise ValueError(
+            "unsupported: OPT word_embed_proj_dim != hidden_size "
+            "(projection layers of the 350M checkpoint)"
+        )
+    return OPTConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        ffn_dim=get("ffn_dim"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+    )
+
+
+def opt_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
+    """Convert an `OPTForCausalLM` state dict."""
+    L = config.num_hidden_layers
+    p = "model.decoder." if any(k.startswith("model.decoder.") for k in sd) else "decoder."
+    hl = p + "layers.{}."
+
+    def lin(template: str) -> dict:
+        return {
+            "kernel": _stack(sd, template + ".weight", L, transpose=True),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    def ln(template: str) -> dict:
+        return {
+            "scale": _stack(sd, template + ".weight", L, transpose=False),
+            "bias": _stack(sd, template + ".bias", L, transpose=False),
+        }
+
+    return {
+        "embed_tokens": {"embedding": _np(sd[p + "embed_tokens.weight"])},
+        "embed_positions": {"embedding": _np(sd[p + "embed_positions.weight"])},
+        "layers": {
+            "self_attn_layer_norm": ln(hl + "self_attn_layer_norm"),
+            "attn": {
+                name: lin(hl + "self_attn." + name)
+                for name in ("q_proj", "k_proj", "v_proj", "out_proj")
+            },
+            "final_layer_norm": ln(hl + "final_layer_norm"),
+            "mlp": {"fc1": lin(hl + "fc1"), "fc2": lin(hl + "fc2")},
+        },
+        "final_layer_norm": {
+            "scale": _np(sd[p + "final_layer_norm.weight"]),
+            "bias": _np(sd[p + "final_layer_norm.bias"]),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# T5
+# ---------------------------------------------------------------------------
+
+
+def t5_config_from_hf(hf_config) -> "T5Config":
+    from .t5 import T5Config
+
+    get = _getter(hf_config)
+    ff_proj = get("feed_forward_proj", "relu") or "relu"
+    if ff_proj not in ("relu", "gated-gelu"):
+        raise ValueError(
+            f"unsupported T5 feed_forward_proj={ff_proj!r}; only 'relu' "
+            "(t5) and 'gated-gelu' (v1.1/T0) are implemented — importing "
+            "would silently run the wrong activation"
+        )
+    return T5Config(
+        vocab_size=get("vocab_size"),
+        d_model=get("d_model"),
+        d_kv=get("d_kv", 64),
+        d_ff=get("d_ff"),
+        num_layers=get("num_layers"),
+        num_decoder_layers=get("num_decoder_layers") or get("num_layers"),
+        num_heads=get("num_heads"),
+        relative_attention_num_buckets=get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=get("relative_attention_max_distance", 128),
+        layer_norm_epsilon=get("layer_norm_epsilon", 1e-6),
+        is_gated_act=("gated" in ff_proj) or bool(get("is_gated_act", False)),
+        tie_word_embeddings=bool(get("tie_word_embeddings", True)),
+    )
+
+
+def t5_params_from_hf(config, sd: Mapping[str, Any]) -> dict:
+    """Convert a `T5ForConditionalGeneration` state dict."""
+
+    def lin(template: str, n: int) -> dict:
+        return {"kernel": _stack(sd, template + ".weight", n, transpose=True)}
+
+    def ln_scale(template: str, n: int):
+        return {"scale": _stack(sd, template + ".weight", n, transpose=False)}
+
+    def mlp(prefix: str, n: int) -> dict:
+        out = {"wo": lin(prefix + ".DenseReluDense.wo", n)}
+        if config.is_gated_act:
+            out["wi_0"] = lin(prefix + ".DenseReluDense.wi_0", n)
+            out["wi_1"] = lin(prefix + ".DenseReluDense.wi_1", n)
+        else:
+            out["wi"] = lin(prefix + ".DenseReluDense.wi", n)
+        return out
+
+    Le, Ld = config.num_layers, config.num_decoder_layers
+    e = "encoder.block.{}.layer."
+    d = "decoder.block.{}.layer."
+    params = {
+        "shared": {"embedding": _np(sd["shared.weight"])},
+        "encoder": {
+            "rel_bias": {"embedding": _np(
+                sd["encoder.block.0.layer.0.SelfAttention"
+                   ".relative_attention_bias.weight"])},
+            "layers": {
+                "ln_attn": ln_scale(e + "0.layer_norm", Le),
+                "attn": {
+                    n: lin(e + "0.SelfAttention." + n, Le)
+                    for n in ("q", "k", "v", "o")
+                },
+                "ln_mlp": ln_scale(e + "1.layer_norm", Le),
+                "mlp": mlp(e + "1", Le),
+            },
+            "final_ln": {"scale": _np(sd["encoder.final_layer_norm.weight"])},
+        },
+        "decoder": {
+            "rel_bias": {"embedding": _np(
+                sd["decoder.block.0.layer.0.SelfAttention"
+                   ".relative_attention_bias.weight"])},
+            "layers": {
+                "ln_self": ln_scale(d + "0.layer_norm", Ld),
+                "self_attn": {
+                    n: lin(d + "0.SelfAttention." + n, Ld)
+                    for n in ("q", "k", "v", "o")
+                },
+                "ln_cross": ln_scale(d + "1.layer_norm", Ld),
+                "cross_attn": {
+                    n: lin(d + "1.EncDecAttention." + n, Ld)
+                    for n in ("q", "k", "v", "o")
+                },
+                "ln_mlp": ln_scale(d + "2.layer_norm", Ld),
+                "mlp": mlp(d + "2", Ld),
+            },
+            "final_ln": {"scale": _np(sd["decoder.final_layer_norm.weight"])},
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T}
+    return params
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -444,7 +673,10 @@ _FAMILIES = {
     "qwen2": (qwen2_config_from_hf, llama_params_from_hf),
     "mixtral": (mixtral_config_from_hf, mixtral_params_from_hf),
     "gpt2": (gpt2_config_from_hf, gpt2_params_from_hf),
+    "gptj": (gptj_config_from_hf, gptj_params_from_hf),
     "gpt_neox": (gpt_neox_config_from_hf, gpt_neox_params_from_hf),
+    "opt": (opt_config_from_hf, opt_params_from_hf),
+    "t5": (t5_config_from_hf, t5_params_from_hf),
     "bert": (bert_config_from_hf, bert_params_from_hf),
 }
 

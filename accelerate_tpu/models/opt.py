@@ -1,0 +1,149 @@
+"""OPT causal LM (the OPT-30B rows of the reference's big-model-inference
+benchmark, ref benchmarks/README.md:34-35).
+
+Same TPU-first scan-over-stacked-layers layout. OPT specifics: learned
+position embeddings with a +2 offset (an artifact of fairseq's padding
+convention that every OPT checkpoint bakes in), pre-LN decoder layers
+(do_layer_norm_before=True on all published sizes >= 350M), ReLU MLP,
+biases everywhere, and an LM head tied to the token embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .common import (
+    cross_entropy_loss,
+    dense,
+    dot_product_attention,
+    layer_norm,
+    normal_init,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    hidden_size: int = 7168
+    ffn_dim: int = 28672
+    num_hidden_layers: int = 48
+    num_attention_heads: int = 56
+    max_position_embeddings: int = 2048
+    layer_norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **overrides) -> "OPTConfig":
+        defaults = dict(
+            vocab_size=256, hidden_size=64, ffn_dim=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=128,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+_POSITION_OFFSET = 2  # fairseq convention baked into every OPT checkpoint
+
+
+def init_params(config: OPTConfig, key: jax.Array, dtype=jnp.float32) -> dict:
+    keys = jax.random.split(key, 8)
+    h, L, f = config.hidden_size, config.num_hidden_layers, config.ffn_dim
+
+    def lin(k, d_in, d_out):
+        return {
+            "kernel": normal_init(k, (L, d_in, d_out), 0.02, dtype),
+            "bias": jnp.zeros((L, d_out), dtype),
+        }
+
+    def ln():
+        return {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)}
+
+    return {
+        "embed_tokens": {"embedding": normal_init(keys[0], (config.vocab_size, h), 0.02, dtype)},
+        "embed_positions": {"embedding": normal_init(
+            keys[1], (config.max_position_embeddings + _POSITION_OFFSET, h), 0.02, dtype)},
+        "layers": {
+            "self_attn_layer_norm": ln(),
+            "attn": {
+                "q_proj": lin(keys[2], h, h),
+                "k_proj": lin(keys[3], h, h),
+                "v_proj": lin(keys[4], h, h),
+                "out_proj": lin(keys[5], h, h),
+            },
+            "final_layer_norm": ln(),
+            "mlp": {
+                "fc1": lin(keys[6], h, f),
+                "fc2": lin(keys[7], f, h),
+            },
+        },
+        "final_layer_norm": {
+            "scale": jnp.ones((h,), dtype), "bias": jnp.zeros((h,), dtype)
+        },
+    }
+
+
+def _layer_body(config: OPTConfig, x, layer, mask):
+    b, s, h = x.shape
+    nh, hd = config.num_attention_heads, config.head_dim
+    eps = config.layer_norm_eps
+
+    y = layer_norm(x, layer["self_attn_layer_norm"]["scale"],
+                   layer["self_attn_layer_norm"]["bias"], eps)
+    a = layer["attn"]
+    q = dense(y, a["q_proj"]["kernel"], a["q_proj"]["bias"]).reshape(b, s, nh, hd)
+    k = dense(y, a["k_proj"]["kernel"], a["k_proj"]["bias"]).reshape(b, s, nh, hd)
+    v = dense(y, a["v_proj"]["kernel"], a["v_proj"]["bias"]).reshape(b, s, nh, hd)
+    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    x = x + dense(attn.reshape(b, s, h), a["out_proj"]["kernel"],
+                  a["out_proj"]["bias"])
+
+    y = layer_norm(x, layer["final_layer_norm"]["scale"],
+                   layer["final_layer_norm"]["bias"], eps)
+    y = jax.nn.relu(dense(y, layer["mlp"]["fc1"]["kernel"],
+                          layer["mlp"]["fc1"]["bias"]))
+    return x + dense(y, layer["mlp"]["fc2"]["kernel"], layer["mlp"]["fc2"]["bias"])
+
+
+def forward(
+    config: OPTConfig,
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    if attention_mask is not None:
+        # HF OPT derives positions from the mask cumsum, so left-padded
+        # batches start real tokens at position 0 (+offset)
+        m = attention_mask.astype(jnp.int32)
+        positions = (jnp.cumsum(m, axis=1) * m - 1) + _POSITION_OFFSET
+        positions = jnp.maximum(positions, 0)
+    else:
+        positions = jnp.arange(input_ids.shape[1])[None, :] + _POSITION_OFFSET
+    x = (params["embed_tokens"]["embedding"][input_ids]
+         + params["embed_positions"]["embedding"][positions])
+
+    def scan_body(carry, layer):
+        return _layer_body(config, carry, layer, attention_mask), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = layer_norm(x, params["final_layer_norm"]["scale"],
+                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
+    return jnp.einsum(
+        "bsh,vh->bsv", x, params["embed_tokens"]["embedding"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def causal_lm_loss(config: OPTConfig, params: dict, batch: dict) -> jax.Array:
+    input_ids = batch["input_ids"]
+    labels = input_ids[:, 1:]
+    mask = batch.get("attention_mask")
+    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    logits = forward(config, params, input_ids[:, :-1])
+    return cross_entropy_loss(logits, labels, mask)

@@ -1,0 +1,268 @@
+"""T5 encoder-decoder LM (the T0pp-11B row of the reference's
+big-model-inference benchmark, ref benchmarks/README.md:33 — T0pp is
+T5-v1.1 trained further).
+
+Same TPU-first scan-over-stacked-layers layout, twice (encoder + decoder
+stacks). T5 specifics: RMSNorm (no bias), NO attention score scaling (the
+1/sqrt(d) is folded into initialization), bias-free linears, relative
+position buckets added to attention scores (owned by layer 0 of each
+self-attention stack, shared by the rest; cross-attention has none),
+ReLU or gated-GELU MLP (v1.1/T0), and a tied-scaled or untied LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import (
+    dense,
+    normal_init,
+    rms_norm,
+    token_nll,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 4096
+    d_kv: int = 64
+    d_ff: int = 10240
+    num_layers: int = 24            # encoder
+    num_decoder_layers: int = 24
+    num_heads: int = 64
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    is_gated_act: bool = True       # v1.1/T0 gated-gelu; False = relu (t5)
+    tie_word_embeddings: bool = False  # v1.1/T0 untie
+
+    @classmethod
+    def tiny(cls, **overrides) -> "T5Config":
+        defaults = dict(
+            vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+            num_decoder_layers=2, num_heads=4,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+
+def init_params(config: T5Config, key: jax.Array, dtype=jnp.float32) -> dict:
+    h, kv = config.d_model, config.num_heads * config.d_kv
+    ks = iter(jax.random.split(key, 24))
+
+    def attn(L):
+        return {
+            "q": {"kernel": normal_init(next(ks), (L, h, kv), 0.02, dtype)},
+            "k": {"kernel": normal_init(next(ks), (L, h, kv), 0.02, dtype)},
+            "v": {"kernel": normal_init(next(ks), (L, h, kv), 0.02, dtype)},
+            "o": {"kernel": normal_init(next(ks), (L, kv, h), 0.02, dtype)},
+        }
+
+    def mlp(L):
+        out = {"wo": {"kernel": normal_init(next(ks), (L, config.d_ff, h), 0.02, dtype)}}
+        if config.is_gated_act:
+            out["wi_0"] = {"kernel": normal_init(next(ks), (L, h, config.d_ff), 0.02, dtype)}
+            out["wi_1"] = {"kernel": normal_init(next(ks), (L, h, config.d_ff), 0.02, dtype)}
+        else:
+            out["wi"] = {"kernel": normal_init(next(ks), (L, h, config.d_ff), 0.02, dtype)}
+        return out
+
+    Le, Ld = config.num_layers, config.num_decoder_layers
+    params = {
+        "shared": {"embedding": normal_init(next(ks), (config.vocab_size, h), 0.02, dtype)},
+        "encoder": {
+            "rel_bias": {"embedding": normal_init(
+                next(ks), (config.relative_attention_num_buckets, config.num_heads),
+                0.02, dtype)},
+            "layers": {
+                "ln_attn": {"scale": jnp.ones((Le, h), dtype)},
+                "attn": attn(Le),
+                "ln_mlp": {"scale": jnp.ones((Le, h), dtype)},
+                "mlp": mlp(Le),
+            },
+            "final_ln": {"scale": jnp.ones((h,), dtype)},
+        },
+        "decoder": {
+            "rel_bias": {"embedding": normal_init(
+                next(ks), (config.relative_attention_num_buckets, config.num_heads),
+                0.02, dtype)},
+            "layers": {
+                "ln_self": {"scale": jnp.ones((Ld, h), dtype)},
+                "self_attn": attn(Ld),
+                "ln_cross": {"scale": jnp.ones((Ld, h), dtype)},
+                "cross_attn": attn(Ld),
+                "ln_mlp": {"scale": jnp.ones((Ld, h), dtype)},
+                "mlp": mlp(Ld),
+            },
+            "final_ln": {"scale": jnp.ones((h,), dtype)},
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"kernel": normal_init(next(ks), (h, config.vocab_size), 0.02, dtype)}
+    return params
+
+
+def _relative_buckets(rel_pos, bidirectional: bool, num_buckets: int,
+                      max_distance: int):
+    """HF T5's relative_position_bucket, in jnp."""
+    ret = jnp.zeros_like(rel_pos)
+    n = -rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + jnp.where(n < 0, num_buckets, 0)
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+def _position_bias(rel_embedding, q_len: int, k_len: int, bidirectional: bool,
+                   num_buckets: int, max_distance: int):
+    """[H, q_len, k_len] additive attention bias."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _relative_buckets(mem - ctx, bidirectional, num_buckets,
+                                max_distance)
+    return rel_embedding[buckets].transpose(2, 0, 1)  # [H, q, k]
+
+
+def _t5_attention(config: T5Config, proj, x, kv_src, bias, mask):
+    """T5 attention: NO 1/sqrt(d) scaling; additive position bias."""
+    b, sq, _ = x.shape
+    sk = kv_src.shape[1]
+    nh, dk = config.num_heads, config.d_kv
+    q = dense(x, proj["q"]["kernel"]).reshape(b, sq, nh, dk)
+    k = dense(kv_src, proj["k"]["kernel"]).reshape(b, sk, nh, dk)
+    v = dense(kv_src, proj["v"]["kernel"]).reshape(b, sk, nh, dk)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        scores = scores + bias[None].astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return dense(out.reshape(b, sq, nh * dk), proj["o"]["kernel"])
+
+
+def _t5_mlp(config: T5Config, layer, x):
+    if config.is_gated_act:
+        g = jax.nn.gelu(
+            dense(x, layer["wi_0"]["kernel"]).astype(jnp.float32),
+            approximate=True,
+        ).astype(x.dtype)
+        y = g * dense(x, layer["wi_1"]["kernel"])
+    else:
+        y = jax.nn.relu(dense(x, layer["wi"]["kernel"]))
+    return dense(y, layer["wo"]["kernel"])
+
+
+def _encoder(config: T5Config, params, input_ids, enc_mask):
+    eps = config.layer_norm_epsilon
+    x = params["shared"]["embedding"][input_ids]
+    s = input_ids.shape[1]
+    bias = _position_bias(
+        params["encoder"]["rel_bias"]["embedding"], s, s, True,
+        config.relative_attention_num_buckets,
+        config.relative_attention_max_distance,
+    )
+    pad = enc_mask[:, None, None, :] if enc_mask is not None else None
+
+    def body(carry, layer):
+        x = carry
+        h = rms_norm(x, layer["ln_attn"]["scale"], eps)
+        x = x + _t5_attention(config, layer["attn"], h, h, bias, pad)
+        x = x + _t5_mlp(config, layer["mlp"],
+                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["encoder"]["layers"])
+    return rms_norm(x, params["encoder"]["final_ln"]["scale"], eps)
+
+
+def forward(
+    config: T5Config,
+    params: dict,
+    input_ids: jax.Array,
+    decoder_input_ids: jax.Array,
+    attention_mask: jax.Array | None = None,
+) -> jax.Array:
+    """Logits [B, S_dec, V] of the decoder given encoder inputs.
+
+    Runs under float32 matmul precision: T5's unscaled attention and
+    large activation magnitudes (the same property behind torch-side fp16
+    T5 overflow) amplify the TPU's default bf16-input matmul rounding to
+    ~0.15 absolute logit error; full f32 restores HF parity to ~3e-4."""
+    with jax.default_matmul_precision("float32"):
+        return _forward_f32(config, params, input_ids, decoder_input_ids,
+                            attention_mask)
+
+
+def _forward_f32(config, params, input_ids, decoder_input_ids,
+                 attention_mask):
+    eps = config.layer_norm_epsilon
+    enc = _encoder(config, params, input_ids, attention_mask)
+
+    x = params["shared"]["embedding"][decoder_input_ids]
+    sd = decoder_input_ids.shape[1]
+    self_bias = _position_bias(
+        params["decoder"]["rel_bias"]["embedding"], sd, sd, False,
+        config.relative_attention_num_buckets,
+        config.relative_attention_max_distance,
+    )
+    causal = jnp.tril(jnp.ones((sd, sd), bool))[None, None]
+    cross_mask = (
+        attention_mask[:, None, None, :] if attention_mask is not None else None
+    )
+
+    def body(carry, layer):
+        x = carry
+        h = rms_norm(x, layer["ln_self"]["scale"], eps)
+        x = x + _t5_attention(config, layer["self_attn"], h, h, self_bias,
+                              causal)
+        h = rms_norm(x, layer["ln_cross"]["scale"], eps)
+        x = x + _t5_attention(config, layer["cross_attn"], h, enc, None,
+                              cross_mask)
+        x = x + _t5_mlp(config, layer["mlp"],
+                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["decoder"]["layers"])
+    x = rms_norm(x, params["decoder"]["final_ln"]["scale"], eps)
+    if config.tie_word_embeddings:
+        # tied head scales hidden by d_model^-0.5 (HF T5 convention)
+        x = x * (config.d_model ** -0.5)
+        return jnp.einsum(
+            "bsh,vh->bsv", x, params["shared"]["embedding"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def seq2seq_loss(config: T5Config, params: dict, batch: dict) -> jax.Array:
+    """batch: input_ids, decoder_input_ids, labels, attention_mask?"""
+    logits = forward(config, params, batch["input_ids"],
+                     batch["decoder_input_ids"],
+                     batch.get("attention_mask"))
+    nll = token_nll(logits, batch["labels"])
+    mask = batch.get("labels_mask")
+    if mask is None:
+        return jnp.mean(nll)
+    m = mask.astype(jnp.float32)
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)

@@ -27,8 +27,10 @@ from ..utils.constants import AXIS_SEQ
 def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     """Runs INSIDE shard_map. q,k,v: [B, S_local, H, D] — this device's
     sequence chunk with ALL heads. all_to_all trades the head dim for the
-    sequence dim so attention sees the full sequence."""
-    from ..models.common import dot_product_attention
+    sequence dim so attention sees the full sequence. The local full-
+    sequence attention runs the pallas flash kernel (which itself falls
+    back to einsum for shapes under one block)."""
+    from ..ops.flash_attention import flash_attention
 
     # [B, S/P, H, D] -> [B, S, H/P, D]: split heads (axis 2) across the axis,
     # concatenate sequence chunks (axis 1).
@@ -45,7 +47,7 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
     q_full = scatter_heads(q)
     k_full = scatter_heads(k)
     v_full = scatter_heads(v)
-    out = dot_product_attention(q_full, k_full, v_full, causal=causal)
+    out = flash_attention(q_full, k_full, v_full, causal=causal)
     return gather_heads(out)
 
 

@@ -441,3 +441,84 @@ def test_clip_grad_norm_semantics():
         acc.clip_grad_norm_(max_norm=1e9)
         np.testing.assert_allclose(
             np.asarray(opt.gradients["dense"]["kernel"]), pre, rtol=1e-6)
+
+
+# --- fp16 dynamic loss scale (GradScaler parity) -----------------------------
+
+
+def test_loss_scale_overflow_skips_step_and_backs_off():
+    """Non-finite grads: the optimizer apply is skipped and the scale halves
+    (torch GradScaler backoff semantics, ref accelerator.py:455-479)."""
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.training import DynamicLossScale
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="fp16")
+
+    def loss_fn(params, batch):
+        # huge loss -> scaled loss overflows fp16-ish range -> inf grads
+        return jnp.sum(params["w"] * batch["x"]) * 1e38
+
+    ts = acc.prepare(TrainState.create(
+        apply_fn=None, params={"w": jnp.ones((4,), jnp.float32)},
+        tx=optax.sgd(0.1)))
+    assert isinstance(ts.loss_scale, DynamicLossScale)
+    s0 = float(ts.loss_scale.scale)
+    step = acc.train_step(loss_fn)
+    ts, m = step(ts, {"x": jnp.ones((4,), jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(ts.params["w"]), np.ones(4))
+    assert float(ts.loss_scale.scale) == s0 * 0.5  # backoff
+
+
+def test_loss_scale_grows_after_interval():
+    from accelerate_tpu.state import PartialState
+    from accelerate_tpu.training import DynamicLossScale
+
+    PartialState._reset_state()
+    scale = DynamicLossScale.create(init_scale=1024.0)
+    scale = dataclasses.replace(scale, growth_interval=3)
+    for _ in range(2):
+        scale = scale.update(jnp.bool_(True))
+        assert float(scale.scale) == 1024.0  # not yet
+    scale = scale.update(jnp.bool_(True))
+    assert float(scale.scale) == 2048.0  # growth at the interval
+    assert int(scale.growth_tracker) == 0  # tracker reset
+    scale = scale.update(jnp.bool_(False))
+    assert float(scale.scale) == 1024.0  # overflow halves again
+
+
+def test_fp16_fused_step_trains_with_scaling():
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="fp16")
+    ts, losses = train(acc, num_epochs=5)
+    assert losses[-1] < losses[0] * 0.3
+    assert ts.loss_scale is not None
+
+
+def test_fp16_accumulation_zeroes_overflowed_micro_batch():
+    """An overflowed micro-batch must not poison the accumulation buffer:
+    its contribution is zeroed, the others still apply (GradScaler-style
+    per-micro skip)."""
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    acc = Accelerator(mixed_precision="fp16", gradient_accumulation_steps=2)
+
+    def loss_fn(params, batch):
+        return jnp.sum(params["w"] * batch["x"]) * batch["boost"]
+
+    ts = acc.prepare(TrainState.create(
+        apply_fn=None, params={"w": jnp.ones((4,), jnp.float32)},
+        tx=optax.sgd(1.0), use_grad_accum_buffer=True))
+    step = acc.train_step(loss_fn)
+    # micro 1: overflow (boost blows the scaled grads to inf)
+    ts, _ = step(ts, {"x": jnp.ones((4,), jnp.float32),
+                      "boost": jnp.float32(1e38)})
+    # micro 2: finite; boundary -> apply
+    ts, _ = step(ts, {"x": jnp.ones((4,), jnp.float32),
+                      "boost": jnp.float32(1.0)})
+    w = np.asarray(ts.params["w"])
+    # only the finite micro contributed: grad = x * 1.0 / k = 0.5
+    np.testing.assert_allclose(w, np.ones(4) - 0.5, rtol=1e-5)

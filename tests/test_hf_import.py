@@ -381,3 +381,158 @@ def test_sliding_window_guard_covers_decode():
     with pytest.raises(NotImplementedError, match="sliding_window"):
         llama.forward(cfg, params, np.zeros((1, 8), np.int32),
                       kv_caches=caches)
+
+
+def test_gptj_logit_parity():
+    from accelerate_tpu.models import gptj, hf_import
+
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=160, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(12)
+    hf_model = transformers.GPTJForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gptj", hf_cfg)
+    params = hf_import.params_from_hf("gptj", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(13).integers(0, 160, (2, 19)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(gptj.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_opt_logit_parity():
+    from accelerate_tpu.models import hf_import, opt
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=160, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
+        word_embed_proj_dim=64,
+    )
+    torch.manual_seed(14)
+    hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("opt", hf_cfg)
+    params = hf_import.params_from_hf("opt", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(15).integers(0, 160, (2, 23)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(opt.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_opt_postln_refused():
+    from accelerate_tpu.models import hf_import
+
+    with pytest.raises(ValueError, match="post-LN"):
+        hf_import.config_from_hf("opt", {
+            "vocab_size": 64, "hidden_size": 32, "ffn_dim": 64,
+            "num_hidden_layers": 1, "num_attention_heads": 2,
+            "do_layer_norm_before": False,
+        })
+
+
+@pytest.mark.parametrize("gated,tied", [(False, True), (True, False)])
+def test_t5_logit_parity(gated, tied):
+    """t5-style (relu, tied head) and v1.1/T0-style (gated-gelu, untied)."""
+    from accelerate_tpu.models import hf_import, t5
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=160, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        tie_word_embeddings=tied, decoder_start_token_id=0,
+    )
+    torch.manual_seed(16)
+    hf_model = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("t5", hf_cfg)
+    assert cfg.is_gated_act == gated and cfg.tie_word_embeddings == tied
+    params = hf_import.params_from_hf("t5", cfg, hf_model.state_dict())
+    rng = np.random.default_rng(17)
+    enc_ids = rng.integers(0, 160, (2, 21)).astype(np.int32)
+    dec_ids = rng.integers(0, 160, (2, 9)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.tensor(enc_ids, dtype=torch.long),
+            decoder_input_ids=torch.tensor(dec_ids, dtype=torch.long),
+        ).logits.numpy()
+    got = np.asarray(t5.forward(cfg, params, enc_ids, dec_ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_t5_encoder_padding_mask_parity():
+    from accelerate_tpu.models import hf_import, t5
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=120, d_model=48, d_kv=12, d_ff=96, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        feed_forward_proj="relu", tie_word_embeddings=True,
+        decoder_start_token_id=0,
+    )
+    torch.manual_seed(18)
+    hf_model = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("t5", hf_cfg)
+    params = hf_import.params_from_hf("t5", cfg, hf_model.state_dict())
+    rng = np.random.default_rng(19)
+    enc_ids = rng.integers(0, 120, (2, 16)).astype(np.int32)
+    mask = (np.arange(16)[None, :] < np.asarray([10, 16])[:, None])
+    dec_ids = rng.integers(0, 120, (2, 7)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(
+            input_ids=torch.tensor(enc_ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            decoder_input_ids=torch.tensor(dec_ids, dtype=torch.long),
+        ).logits.numpy()
+    got = np.asarray(t5.forward(cfg, params, enc_ids, dec_ids,
+                                attention_mask=mask))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+
+def test_opt_left_padding_parity():
+    """HF OPT derives positions from the attention-mask cumsum; left-padded
+    batches must match (code-review r2 finding)."""
+    from accelerate_tpu.models import hf_import, opt
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=120, hidden_size=48, ffn_dim=96, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
+        word_embed_proj_dim=48,
+    )
+    torch.manual_seed(20)
+    hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("opt", hf_cfg)
+    params = hf_import.params_from_hf("opt", cfg, hf_model.state_dict())
+    rng = np.random.default_rng(21)
+    ids = rng.integers(0, 120, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int64)
+    mask[0, :4] = 0  # left padding on row 0
+    with torch.no_grad():
+        want = hf_model(
+            torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask),
+        ).logits.numpy()
+    got = np.asarray(opt.forward(cfg, params, ids, attention_mask=mask))
+    keep = mask[:, :, None].astype(bool)
+    np.testing.assert_allclose(got[keep[..., 0]], want[keep[..., 0]],
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_t5_unknown_activation_refused():
+    from accelerate_tpu.models import hf_import
+
+    with pytest.raises(ValueError, match="feed_forward_proj"):
+        hf_import.config_from_hf("t5", {
+            "vocab_size": 64, "d_model": 32, "d_ff": 64, "num_layers": 1,
+            "num_heads": 2, "feed_forward_proj": "gated-silu",
+        })
+
+
+def test_gptj_full_head_rotary_dim_none():
+    from accelerate_tpu.models import hf_import
+
+    cfg = hf_import.config_from_hf("gptj", {
+        "vocab_size": 64, "n_embd": 32, "n_layer": 1, "n_head": 2,
+        "n_positions": 32, "rotary_dim": None,
+    })
+    assert cfg.rotary_dim == 16  # full head dim
