@@ -59,6 +59,16 @@ PRESETS = {
     "mixtral-8x7b": ("mixtral", dict(hidden_size=4096, intermediate_size=14336,
                                      num_hidden_layers=32, num_attention_heads=32,
                                      num_key_value_heads=8, num_local_experts=8)),
+    "gpt2": ("gpt2", dict()),
+    "gpt2-xl": ("gpt2", dict(hidden_size=1600, num_hidden_layers=48,
+                             num_attention_heads=25)),
+    "gptj-6b": ("gptj", dict()),
+    "gpt-neox-20b": ("gpt_neox", dict()),
+    "opt-30b": ("opt", dict()),
+    "t5-11b": ("t5", dict(d_model=1024, d_ff=65536, d_kv=128, num_layers=24,
+                          num_heads=128, is_gated_act=False,
+                          tie_word_embeddings=True)),
+    "t0pp": ("t5", dict()),
 }
 
 
@@ -76,6 +86,21 @@ def _family_param_tree(family: str, overrides: dict):
     elif family == "mixtral":
         from ..models import mixtral as mod
         config = mod.MixtralConfig(**overrides) if overrides else mod.MixtralConfig()
+    elif family == "gpt2":
+        from ..models import gpt2 as mod
+        config = mod.GPT2Config(**overrides) if overrides else mod.GPT2Config()
+    elif family == "gptj":
+        from ..models import gptj as mod
+        config = mod.GPTJConfig(**overrides) if overrides else mod.GPTJConfig()
+    elif family == "gpt_neox":
+        from ..models import gpt_neox as mod
+        config = mod.GPTNeoXConfig(**overrides) if overrides else mod.GPTNeoXConfig()
+    elif family == "opt":
+        from ..models import opt as mod
+        config = mod.OPTConfig(**overrides) if overrides else mod.OPTConfig()
+    elif family == "t5":
+        from ..models import t5 as mod
+        config = mod.T5Config(**overrides) if overrides else mod.T5Config()
     else:
         raise ValueError(f"unknown family {family}")
     return jax.eval_shape(lambda: mod.init_params(config, jax.random.key(0)))
