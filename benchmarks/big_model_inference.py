@@ -22,7 +22,7 @@ import time
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--preset", default="tiny", choices=["tiny", "1b"])
+    parser.add_argument("--preset", default="tiny", choices=["tiny", "1b", "6b"])
     parser.add_argument("--offload", action="store_true",
                         help="force host-offload of half the layers")
     parser.add_argument("--new_tokens", type=int, default=32)
@@ -38,7 +38,16 @@ def main() -> None:
     from accelerate_tpu.models import llama
     from accelerate_tpu.models.common import count_params
 
-    if args.preset == "1b":
+    if args.preset == "6b":
+        # GPT-J-6B-scale causal LM (the reference table's headline row,
+        # benchmarks/README.md:29: 8.7 s load / 0.05 s/token fp16 on
+        # 2x Titan RTX). bf16 checkpoint so the 6B fits one 16 GB chip.
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=28, num_attention_heads=32, num_key_value_heads=32,
+            max_position_embeddings=2048,
+        )
+    elif args.preset == "1b":
         cfg = llama.LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5504,
             num_hidden_layers=22, num_attention_heads=16, num_key_value_heads=16,
@@ -51,12 +60,25 @@ def main() -> None:
             max_position_embeddings=512,
         )
 
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if args.preset == "6b" else jnp.float32
     ckpt = args.checkpoint
     tmp = None
     if ckpt is None:
         tmp = tempfile.mkdtemp()
         ckpt = os.path.join(tmp, "model")
-        params = llama.init_params(cfg, jax.random.key(0))
+        # synthesize HOST-side (numpy from eval_shape): initializing on a
+        # remote/tunneled device and pulling the weights back would time the
+        # tunnel, not the load path this benchmark measures
+        shapes = jax.eval_shape(
+            lambda: llama.init_params(cfg, jax.random.key(0), dtype=dtype)
+        )
+        # zeros: value-independent timing (generation FLOPs/bytes identical),
+        # and writing GBs of zeros is instant vs sampling billions of normals
+        params = jax.tree_util.tree_map(
+            lambda l: np.zeros(l.shape, l.dtype), shapes
+        )
         save_model(params, ckpt, max_shard_size="512MB")
         del params
 
