@@ -289,6 +289,126 @@ def _module_rowgroups(params_mod: dict) -> bool:
     )
 
 
+def _fetch_leaf(leaf, device, dtype):
+    if isinstance(leaf, jax.Array):
+        # cast device-resident leaves too: mixed tiers must execute at one
+        # dtype or the jit'd layer body recompiles per tier boundary
+        return leaf.astype(dtype) if dtype is not None else leaf
+    arr = np.asarray(leaf)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return jax.device_put(arr, device)
+
+
+def fetch_resident(params: Any, stacked_module: str, device, dtype) -> dict:
+    """Bring every non-stacked module (embeddings, final norm, head) fully
+    onto the device once — they are touched every step and are small next to
+    the stacked layers."""
+    return {
+        k: jax.tree_util.tree_map(lambda l: _fetch_leaf(l, device, dtype), v)
+        for k, v in params.items()
+        if k != stacked_module
+    }
+
+
+def make_layer_slicer(stacked: Any, device, dtype):
+    """(n_layers, slice_fn) where slice_fn(i) fetches layer i's params from
+    wherever they live (device array / host RAM / disk memmap —
+    ``RowGroups.row``) as an async device_put, so fetching layer i+1 overlaps
+    layer i's compute."""
+    flat_stacked = flatten_dict(stacked)
+    n_layers = min(leaf.shape[0] for leaf in flat_stacked.values())
+
+    def _layer_slice(i: int):
+        def get(leaf):
+            row = leaf.row(i) if isinstance(leaf, RowGroups) else leaf[i]
+            if isinstance(row, jax.Array):
+                return row.astype(dtype) if dtype is not None else row
+            row = np.asarray(row)
+            if dtype is not None:
+                row = row.astype(dtype)
+            return jax.device_put(row, device)
+
+        return jax.tree_util.tree_map(
+            get, stacked, is_leaf=lambda x: isinstance(x, RowGroups)
+        )
+
+    return n_layers, _layer_slice
+
+
+def streamed_generate(
+    params: Any,
+    input_ids,
+    *,
+    embed_fn: Callable[[Any, Any, Any], Any],
+    layer_step_fn: Callable[[Any, Any, Any, tuple], tuple],
+    project_fn: Callable[[Any, Any], Any],
+    init_layer_cache: Callable[[int, int], tuple],
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    key=None,
+    stacked_module: str = "layers",
+    device=None,
+    dtype=None,
+):
+    """KV-cache greedy/temperature decode with (partly) offloaded stacked
+    layers — the reference benchmark's cpu/disk-offload rows
+    (ref benchmarks/README.md:27-36 "with cpu offload", ref
+    big_modeling.py:305-495 dispatch + hooks path).
+
+    Per decode step, each layer's params stream host→device double-buffered
+    around a single jit'd ``layer_step_fn(layer, x, positions, (k, v,
+    cache_len)) -> (x, new_cache)``; per-layer KV caches stay device-resident
+    between steps (they are tiny next to the weights). ``embed_fn(resident,
+    ids, positions)`` and ``project_fn(resident, x)`` run on the resident
+    (non-stacked) modules.
+    """
+    import jax.numpy as jnp
+
+    device = device or jax.local_devices()[0]
+    resident = fetch_resident(params, stacked_module, device, dtype)
+    n_layers, layer_slice = make_layer_slicer(
+        params[stacked_module], device, dtype)
+
+    b, prompt_len = input_ids.shape
+    total = prompt_len + max_new_tokens
+    caches = [init_layer_cache(b, total) for _ in range(n_layers)]
+    cache_len = jnp.zeros((), jnp.int32)
+    if key is None:
+        key = jax.random.key(0)
+
+    def run_stack(ids, positions, cache_len):
+        x = embed_fn(resident, ids, positions)
+        nxt = layer_slice(0)
+        new_len = None
+        for i in range(n_layers):
+            cur = nxt
+            if i + 1 < n_layers:
+                nxt = layer_slice(i + 1)  # async H2D overlaps compute
+            x, (nk, nv, new_len) = layer_step_fn(
+                cur, x, positions, (caches[i][0], caches[i][1], cache_len))
+            caches[i] = (nk, nv)
+        return project_fn(resident, x), new_len
+
+    def select(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits[:, -1], axis=-1)
+        return jax.random.categorical(k, logits[:, -1] / temperature)
+
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    ids = jnp.asarray(input_ids)
+    logits, cache_len = run_stack(ids, positions, cache_len)
+    key, sub = jax.random.split(key)
+    tokens = [select(logits, sub)]
+    for t in range(prompt_len, total - 1):
+        pos = jnp.broadcast_to(jnp.int32(t), (b, 1))
+        logits, cache_len = run_stack(tokens[-1][:, None], pos, cache_len)
+        key, sub = jax.random.split(key)
+        tokens.append(select(logits, sub))
+    new = jnp.stack(tokens, axis=1)
+    return jnp.concatenate([ids, new], axis=1)
+
+
 def streamed_forward(
     params: Any,
     inputs: Any,
@@ -308,39 +428,9 @@ def streamed_forward(
     Non-stacked modules are fetched to the device once up front.
     """
     device = device or jax.local_devices()[0]
-
-    def _fetch(leaf):
-        if isinstance(leaf, jax.Array):
-            # cast device-resident leaves too: mixed tiers must execute at one
-            # dtype or layer_fn recompiles per tier boundary
-            return leaf.astype(dtype) if dtype is not None else leaf
-        arr = np.asarray(leaf)
-        if dtype is not None:
-            arr = arr.astype(dtype)
-        return jax.device_put(arr, device)
-
-    resident = {
-        k: jax.tree_util.tree_map(_fetch, v)
-        for k, v in params.items()
-        if k != stacked_module
-    }
-    stacked = params[stacked_module]
-    flat_stacked = flatten_dict(stacked)
-    n_layers = min(leaf.shape[0] for leaf in flat_stacked.values())
-
-    def _layer_slice(i: int):
-        def get(leaf):
-            row = leaf.row(i) if isinstance(leaf, RowGroups) else leaf[i]
-            if isinstance(row, jax.Array):
-                return row.astype(dtype) if dtype is not None else row
-            row = np.asarray(row)
-            if dtype is not None:
-                row = row.astype(dtype)
-            return jax.device_put(row, device)
-
-        return jax.tree_util.tree_map(
-            get, stacked, is_leaf=lambda x: isinstance(x, RowGroups)
-        )
+    resident = fetch_resident(params, stacked_module, device, dtype)
+    n_layers, _layer_slice = make_layer_slicer(
+        params[stacked_module], device, dtype)
 
     x = embed_fn(resident, inputs)
     nxt = _layer_slice(0)  # double buffer: prefetch layer 0

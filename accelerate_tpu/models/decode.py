@@ -1,0 +1,172 @@
+"""Shared KV-cache decode + generate driver for the model zoo.
+
+The reference's only published benchmark is load + *generate* time for
+GPT-J-6B / GPT-NeoX-20B / OPT-30B / T0pp (ref benchmarks/README.md:25-36,
+benchmarks/big_model_inference.py) — so decode is a first-class path for
+every causal family here, not just the flagship.
+
+Design (TPU-first):
+- caches stack on a leading layer dim ([L, B, M, H, D]) and ride the same
+  `lax.scan` over layers as training — ONE compiled layer body at any depth.
+- `cache_len` is a traced scalar: decode steps at any position share one
+  compiled program (no per-position retracing).
+- the whole decode loop is ONE compiled program (`lax.scan` over steps with
+  (last_token, caches) as carry) — a single dispatch for all tokens instead
+  of a host round-trip per token, which dominates on remote/tunneled devices.
+- each family keeps its own `forward(config, params, ids, positions=...,
+  kv_caches=...) -> (logits, new_caches)`; `build_generate` turns that
+  uniform signature into a compiled prefill + fused-decode pair, cached per
+  (config, temperature) so repeat calls never recompile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def make_kv_caches(num_layers: int, batch: int, max_len: int,
+                   num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+    """Stacked decode caches: (k [L, B, M, H, D], v [L, B, M, H, D],
+    cache_len scalar)."""
+    shape = (num_layers, batch, max_len, num_kv_heads, head_dim)
+    return (
+        jnp.zeros(shape, dtype),
+        jnp.zeros(shape, dtype),
+        jnp.zeros((), jnp.int32),
+    )
+
+
+def extend_cache(kv_cache, k, v):
+    """Write this step's K/V [B, S, H, D] at cache_len.
+
+    Returns (k_full, v_full, new_cache) where k_full/v_full are the whole
+    [B, M, H, D] buffers (attend over them with a position mask — see
+    `cached_attention_mask`) and new_cache has cache_len advanced by S.
+    """
+    ck, cv, cache_len = kv_cache
+    zero = jnp.zeros((), jnp.int32)
+    k_full = jax.lax.dynamic_update_slice(
+        ck, k.astype(ck.dtype), (zero, cache_len, zero, zero))
+    v_full = jax.lax.dynamic_update_slice(
+        cv, v.astype(cv.dtype), (zero, cache_len, zero, zero))
+    return k_full, v_full, (k_full, v_full, cache_len + k.shape[1])
+
+
+def cached_attention_mask(k_len: int, positions, mask=None):
+    """[B, S_q, S_k] decode mask: query at position p attends to cached
+    positions <= p (causality holds within the prefill chunk too). An
+    optional [B, S_k] key-padding mask over the WHOLE cache ANDs in."""
+    if mask is not None and mask.shape[-1] != k_len:
+        raise ValueError(
+            f"attention_mask covers {mask.shape[-1]} positions but the KV "
+            f"cache holds {k_len}; on the decode path the mask must span the "
+            "whole cache — pad it to the cache length (1 = attend)"
+        )
+    kv_mask = jnp.arange(k_len)[None, None, :] <= positions[:, :, None]
+    return kv_mask if mask is None else mask[:, None, :] & kv_mask
+
+
+def build_generate(forward, init_caches):
+    """Greedy/temperature `generate` for a causal family.
+
+    `forward(config, params, input_ids, positions=..., kv_caches=...)` must
+    return (logits, new_caches) on the cached path; `init_caches(config,
+    batch, max_len, dtype=...)` builds the stacked caches. The returned
+    generate() mirrors the reference's big-model-inference usage
+    (ref benchmarks/big_model_inference.py:94-108): prompt in, prompt+new
+    tokens out.
+    """
+
+    @functools.lru_cache(maxsize=32)
+    def _programs(config, temperature: float):
+        def select(logits, k):
+            if temperature == 0.0:
+                return jnp.argmax(logits[:, -1], axis=-1)
+            return jax.random.categorical(k, logits[:, -1] / temperature)
+
+        @jax.jit
+        def prefill(params, input_ids, caches, k):
+            logits, caches = forward(config, params, input_ids,
+                                     kv_caches=caches)
+            return select(logits, k), caches
+
+        @jax.jit
+        def decode_all(params, last, caches, steps, keys):
+            b = last.shape[0]
+
+            def body(carry, xs):
+                last, caches = carry
+                pos, k = xs
+                positions = jnp.broadcast_to(pos, (b, 1))
+                logits, caches = forward(
+                    config, params, last[:, None], positions=positions,
+                    kv_caches=caches,
+                )
+                return (select(logits, k), caches), last
+
+            (final, _), emitted = jax.lax.scan(body, (last, caches),
+                                               (steps, keys))
+            # emitted[i] is the token fed at step i ([T, B]); final is last
+            return jnp.concatenate([emitted.T, final[:, None]], axis=1)
+
+        return prefill, decode_all
+
+    def generate(config, params, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, key=None):
+        b, prompt_len = input_ids.shape
+        total = prompt_len + max_new_tokens
+        caches = init_caches(config, b, total)
+        if key is None:
+            key = jax.random.key(0)
+        prefill, decode_all = _programs(config, float(temperature))
+        key, sub = jax.random.split(key)
+        last, caches = prefill(params, input_ids, caches, sub)
+        if max_new_tokens == 1:
+            return jnp.concatenate([input_ids, last[:, None]], axis=1)
+        keys = jax.random.split(key, max_new_tokens - 1)
+        steps = jnp.arange(prompt_len, prompt_len + max_new_tokens - 1,
+                           dtype=jnp.int32)
+        new_tokens = decode_all(params, last, caches, steps, keys)
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+
+    return generate
+
+
+def build_streamed_generate(make_layer_step, embed_fn, project_fn,
+                            cache_dims):
+    """Offloaded-weights `streamed_generate` for a causal family (the
+    reference benchmark's cpu-offload rows, ref benchmarks/README.md:27-36):
+    weights stream host→device double-buffered around the family's jit'd
+    layer body while per-layer KV caches stay device-resident.
+
+    - `make_layer_step(config)` -> jit'd `(layer, x, positions, (k, v,
+      cache_len)) -> (x, new_cache)` (lru_cache it so warm calls reuse the
+      compiled program);
+    - `embed_fn(config, resident, ids, positions)` / `project_fn(config,
+      resident, x)` run on the resident (non-stacked) modules — project_fn
+      must INCLUDE the final norm (the full forwards apply it before their
+      head);
+    - `cache_dims(config)` -> (num_kv_heads, head_dim) for the cache shape.
+    """
+
+    def streamed_generate(config, params, input_ids,
+                          max_new_tokens: int = 32, **kw):
+        from ..big_modeling import streamed_generate as _sg
+
+        kw.setdefault("dtype", jnp.bfloat16)
+        cdt = kw["dtype"] or jnp.bfloat16
+        nh, hd = cache_dims(config)
+        return _sg(
+            params, input_ids,
+            embed_fn=lambda res, ids, pos: embed_fn(config, res, ids, pos),
+            layer_step_fn=make_layer_step(config),
+            project_fn=lambda res, x: project_fn(config, res, x),
+            init_layer_cache=lambda b, m: (jnp.zeros((b, m, nh, hd), cdt),
+                                           jnp.zeros((b, m, nh, hd), cdt)),
+            max_new_tokens=max_new_tokens, **kw,
+        )
+
+    return streamed_generate

@@ -12,6 +12,7 @@ word-embedding-tied LM head. HF stores these as Conv1D ([in, out] kernels
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -23,6 +24,13 @@ from .common import (
     normal_init,
     token_nll,
     cross_entropy_loss,
+)
+from .decode import (
+    build_generate,
+    build_streamed_generate,
+    cached_attention_mask,
+    extend_cache,
+    make_kv_caches,
 )
 
 
@@ -81,7 +89,8 @@ def init_params(config: GPT2Config, key: jax.Array, dtype=jnp.float32) -> dict:
     }
 
 
-def _layer_body(config: GPT2Config, x, layer, mask):
+def _layer_body(config: GPT2Config, x, layer, mask, positions=None,
+                kv_cache=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_epsilon
@@ -92,7 +101,13 @@ def _layer_body(config: GPT2Config, x, layer, mask):
     q = q.reshape(b, s, nh, hd)
     k = k.reshape(b, s, nh, hd)
     v = v.reshape(b, s, nh, hd)
-    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    new_cache = None
+    if kv_cache is not None:
+        k, v, new_cache = extend_cache(kv_cache, k, v)
+        mask = cached_attention_mask(k.shape[1], positions, mask)
+        attn = dot_product_attention(q, k, v, mask=mask, causal=False)
+    else:
+        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     attn = attn.reshape(b, s, h)
     x = x + dense(attn, layer["attn"]["c_proj"]["kernel"],
                   layer["attn"]["c_proj"]["bias"])
@@ -102,7 +117,7 @@ def _layer_body(config: GPT2Config, x, layer, mask):
     y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
     x = x + dense(y, layer["mlp"]["c_proj"]["kernel"],
                   layer["mlp"]["c_proj"]["bias"])
-    return x
+    return x, new_cache
 
 
 def forward(
@@ -110,13 +125,39 @@ def forward(
     params: dict,
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> jax.Array:
-    """Logits [B, S, V]; LM head tied to wte (GPT-2 always ties)."""
-    positions = jnp.arange(input_ids.shape[1])
+    positions: jax.Array | None = None,
+    kv_caches=None,
+) -> jax.Array | tuple:
+    """Logits [B, S, V]; LM head tied to wte (GPT-2 always ties).
+    With `kv_caches` (see `init_kv_caches`), returns (logits, new_caches) —
+    the incremental-decode path behind `generate`."""
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1]), input_ids.shape
+        )
     x = params["wte"]["embedding"][input_ids] + params["wpe"]["embedding"][positions]
 
+    if kv_caches is not None:
+        ck, cv, cache_len = kv_caches
+
+        def decode_body(carry, xs):
+            layer, ck_l, cv_l = xs
+            y, cache = _layer_body(config, carry, layer, attention_mask,
+                                   positions, (ck_l, cv_l, cache_len))
+            nk, nv, _ = cache
+            return y, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
+        x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                       config.layer_norm_epsilon)
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x, params["wte"]["embedding"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits, (nk, nv, cache_len + input_ids.shape[1])
+
     def scan_body(carry, layer):
-        return _layer_body(config, carry, layer, attention_mask), None
+        return _layer_body(config, carry, layer, attention_mask)[0], None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
@@ -127,6 +168,15 @@ def forward(
     )
 
 
+def init_kv_caches(config: GPT2Config, batch: int, max_len: int,
+                   dtype=jnp.bfloat16):
+    return make_kv_caches(config.num_hidden_layers, batch, max_len,
+                          config.num_attention_heads, config.head_dim, dtype)
+
+
+generate = build_generate(forward, init_kv_caches)
+
+
 def causal_lm_loss(config: GPT2Config, params: dict, batch: dict) -> jax.Array:
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
@@ -134,3 +184,34 @@ def causal_lm_loss(config: GPT2Config, params: dict, batch: dict) -> jax.Array:
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     logits = forward(config, params, input_ids[:, :-1])
     return cross_entropy_loss(logits, labels, mask)
+
+
+@functools.lru_cache(maxsize=8)
+def make_decode_layer_step(config: GPT2Config):
+    """jit'd single-layer decode body for `streamed_generate` (offloaded
+    weights)."""
+
+    @jax.jit
+    def step(layer, x, positions, kv_cache):
+        return _layer_body(config, x, layer, None, positions, kv_cache)
+
+    return step
+
+
+def _project_decode(config: GPT2Config, res: dict, x):
+    # includes the final ln_f + tied-wte head (what forward applies)
+    x = layer_norm(x, res["ln_f"]["scale"], res["ln_f"]["bias"],
+                   config.layer_norm_epsilon)
+    return jnp.einsum(
+        "bsh,vh->bsv", x, res["wte"]["embedding"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
+streamed_generate = build_streamed_generate(
+    make_decode_layer_step,
+    embed_fn=lambda config, res, ids, pos: (
+        res["wte"]["embedding"][ids] + res["wpe"]["embedding"][pos]),
+    project_fn=_project_decode,
+    cache_dims=lambda c: (c.num_attention_heads, c.head_dim),
+)

@@ -12,6 +12,7 @@ per-head-interleaved qkv projection, LayerNorms with biases, and an untied
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +25,13 @@ from .common import (
     layer_norm,
     normal_init,
     rope_frequencies,
+)
+from .decode import (
+    build_generate,
+    build_streamed_generate,
+    cached_attention_mask,
+    extend_cache,
+    make_kv_caches,
 )
 
 
@@ -100,7 +108,8 @@ def _partial_rope(x, cos, sin, positions, rotary_ndims: int):
     return jnp.concatenate([rot, rest], axis=-1)
 
 
-def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask):
+def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask,
+                kv_cache=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_eps
@@ -114,7 +123,13 @@ def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask):
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
     q = _partial_rope(q, cos, sin, positions, config.rotary_ndims)
     k = _partial_rope(k, cos, sin, positions, config.rotary_ndims)
-    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    new_cache = None
+    if kv_cache is not None:
+        k, v, new_cache = extend_cache(kv_cache, k, v)
+        mask = cached_attention_mask(k.shape[1], positions, mask)
+        attn = dot_product_attention(q, k, v, mask=mask, causal=False)
+    else:
+        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     attn_out = dense(attn.reshape(b, s, h), layer["attn"]["dense"]["kernel"],
                      layer["attn"]["dense"]["bias"])
 
@@ -134,7 +149,16 @@ def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask):
 
     # both residual modes add the same three terms — the difference is
     # entirely in what mlp_in read above (x alone vs x + attn_out)
-    return x + attn_out + mlp_out
+    return x + attn_out + mlp_out, new_cache
+
+
+def _project_out(config: GPTNeoXConfig, params: dict, x):
+    x = layer_norm(x, params["final_layer_norm"]["scale"],
+                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["embed_out"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def forward(
@@ -142,28 +166,51 @@ def forward(
     params: dict,
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> jax.Array:
-    """Logits [B, S, V] via the untied embed_out head."""
+    positions: jax.Array | None = None,
+    kv_caches=None,
+) -> jax.Array | tuple:
+    """Logits [B, S, V] via the untied embed_out head; with `kv_caches`
+    (see `init_kv_caches`), returns (logits, new_caches) — the
+    incremental-decode path behind `generate`."""
     x = params["embed_in"]["embedding"][input_ids]
-    positions = jnp.broadcast_to(
-        jnp.arange(input_ids.shape[1]), input_ids.shape
-    )
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1]), input_ids.shape
+        )
     cos, sin = rope_frequencies(
         config.rotary_ndims, config.max_position_embeddings,
         config.rotary_emb_base,
     )
 
+    if kv_caches is not None:
+        ck, cv, cache_len = kv_caches
+
+        def decode_body(carry, xs):
+            layer, ck_l, cv_l = xs
+            y, cache = _layer_body(config, carry, layer, cos, sin, positions,
+                                   attention_mask, (ck_l, cv_l, cache_len))
+            nk, nv, _ = cache
+            return y, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
+        return (_project_out(config, params, x),
+                (nk, nv, cache_len + input_ids.shape[1]))
+
     def scan_body(carry, layer):
         return _layer_body(config, carry, layer, cos, sin, positions,
-                           attention_mask), None
+                           attention_mask)[0], None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = layer_norm(x, params["final_layer_norm"]["scale"],
-                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
-    return jnp.einsum(
-        "bsh,hv->bsv", x, params["embed_out"]["kernel"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    return _project_out(config, params, x)
+
+
+def init_kv_caches(config: GPTNeoXConfig, batch: int, max_len: int,
+                   dtype=jnp.bfloat16):
+    return make_kv_caches(config.num_hidden_layers, batch, max_len,
+                          config.num_attention_heads, config.head_dim, dtype)
+
+
+generate = build_generate(forward, init_kv_caches)
 
 
 def causal_lm_loss(config: GPTNeoXConfig, params: dict, batch: dict) -> jax.Array:
@@ -173,3 +220,30 @@ def causal_lm_loss(config: GPTNeoXConfig, params: dict, batch: dict) -> jax.Arra
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     logits = forward(config, params, input_ids[:, :-1])
     return cross_entropy_loss(logits, labels, mask)
+
+
+@functools.lru_cache(maxsize=8)
+def make_decode_layer_step(config: GPTNeoXConfig):
+    """jit'd single-layer decode body for `streamed_generate` (offloaded
+    weights — the reference's GPT-NeoX-20B cpu-offload benchmark rows)."""
+
+    @jax.jit
+    def step(layer, x, positions, kv_cache):
+        cos, sin = rope_frequencies(
+            config.rotary_ndims, config.max_position_embeddings,
+            config.rotary_emb_base,
+        )
+        return _layer_body(config, x, layer, cos, sin, positions, None,
+                           kv_cache)
+
+    return step
+
+
+# _project_out includes the final layer norm, so it is directly the
+# streamed path's projection
+streamed_generate = build_streamed_generate(
+    make_decode_layer_step,
+    embed_fn=lambda config, res, ids, pos: res["embed_in"]["embedding"][ids],
+    project_fn=lambda config, res, x: _project_out(config, res, x),
+    cache_dims=lambda c: (c.num_attention_heads, c.head_dim),
+)

@@ -11,6 +11,7 @@ attention biases, and an untied LM head WITH bias.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +23,13 @@ from .common import (
     dot_product_attention,
     layer_norm,
     normal_init,
+)
+from .decode import (
+    build_generate,
+    build_streamed_generate,
+    cached_attention_mask,
+    extend_cache,
+    make_kv_caches,
 )
 
 
@@ -103,7 +111,8 @@ def _apply_interleaved_rope(x, sin, cos, positions):
     return x * cos_p + _rotate_every_two(x) * sin_p
 
 
-def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask):
+def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask,
+                kv_cache=None):
     b, s, h = x.shape
     nh, hd, rot = config.num_attention_heads, config.head_dim, config.rotary_dim
     eps = config.layer_norm_epsilon
@@ -120,14 +129,29 @@ def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask):
         _apply_interleaved_rope(k[..., :rot], sin, cos, positions),
         k[..., rot:],
     ], axis=-1)
-    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    new_cache = None
+    if kv_cache is not None:
+        k, v, new_cache = extend_cache(kv_cache, k, v)
+        mask = cached_attention_mask(k.shape[1], positions, mask)
+        attn = dot_product_attention(q, k, v, mask=mask, causal=False)
+    else:
+        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     attn_out = dense(attn.reshape(b, s, h), layer["attn"]["out_proj"]["kernel"])
 
     # parallel residual off the SAME ln_1 output
     m = dense(y, layer["mlp"]["fc_in"]["kernel"], layer["mlp"]["fc_in"]["bias"])
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(x.dtype)
     mlp_out = dense(m, layer["mlp"]["fc_out"]["kernel"], layer["mlp"]["fc_out"]["bias"])
-    return x + attn_out + mlp_out
+    return x + attn_out + mlp_out, new_cache
+
+
+def _project_out(config: GPTJConfig, params: dict, x):
+    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
+                   config.layer_norm_epsilon)
+    return jnp.einsum(
+        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    ) + params["lm_head"]["bias"].astype(jnp.float32)
 
 
 def forward(
@@ -135,24 +159,49 @@ def forward(
     params: dict,
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> jax.Array:
+    positions: jax.Array | None = None,
+    kv_caches=None,
+) -> jax.Array | tuple:
+    """Logits [B, S, V]; with `kv_caches` (see `init_kv_caches`), returns
+    (logits, new_caches) — the incremental-decode path behind `generate`."""
     x = params["wte"]["embedding"][input_ids]
-    positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(input_ids.shape[1]), input_ids.shape
+        )
     sin, cos = _interleaved_rope_tables(
         config.rotary_dim, config.max_position_embeddings
     )
 
+    if kv_caches is not None:
+        ck, cv, cache_len = kv_caches
+
+        def decode_body(carry, xs):
+            layer, ck_l, cv_l = xs
+            y, cache = _layer_body(config, carry, layer, sin, cos, positions,
+                                   attention_mask, (ck_l, cv_l, cache_len))
+            nk, nv, _ = cache
+            return y, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
+        return (_project_out(config, params, x),
+                (nk, nv, cache_len + input_ids.shape[1]))
+
     def scan_body(carry, layer):
         return _layer_body(config, carry, layer, sin, cos, positions,
-                           attention_mask), None
+                           attention_mask)[0], None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
-                   config.layer_norm_epsilon)
-    return jnp.einsum(
-        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    ) + params["lm_head"]["bias"].astype(jnp.float32)
+    return _project_out(config, params, x)
+
+
+def init_kv_caches(config: GPTJConfig, batch: int, max_len: int,
+                   dtype=jnp.bfloat16):
+    return make_kv_caches(config.num_hidden_layers, batch, max_len,
+                          config.num_attention_heads, config.head_dim, dtype)
+
+
+generate = build_generate(forward, init_kv_caches)
 
 
 def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict) -> jax.Array:
@@ -162,3 +211,28 @@ def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict) -> jax.Array:
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     logits = forward(config, params, input_ids[:, :-1])
     return cross_entropy_loss(logits, labels, mask)
+
+
+@functools.lru_cache(maxsize=8)
+def make_decode_layer_step(config: GPTJConfig):
+    """jit'd single-layer decode body for `streamed_generate` (offloaded
+    weights — the reference's GPT-J-6B cpu-offload benchmark rows)."""
+
+    @jax.jit
+    def step(layer, x, positions, kv_cache):
+        max_len = max(config.max_position_embeddings, kv_cache[0].shape[1])
+        sin, cos = _interleaved_rope_tables(config.rotary_dim, max_len)
+        return _layer_body(config, x, layer, sin, cos, positions, None,
+                           kv_cache)
+
+    return step
+
+
+# _project_out includes the final layer norm, so it is directly the
+# streamed path's projection
+streamed_generate = build_streamed_generate(
+    make_decode_layer_step,
+    embed_fn=lambda config, res, ids, pos: res["wte"]["embedding"][ids],
+    project_fn=lambda config, res, x: _project_out(config, res, x),
+    cache_dims=lambda c: (c.num_attention_heads, c.head_dim),
+)

@@ -27,6 +27,13 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from .decode import (
+    build_generate,
+    build_streamed_generate,
+    cached_attention_mask,
+    extend_cache,
+    make_kv_caches,
+)
 from .common import (
     apply_rope,
     cross_entropy_loss,
@@ -174,17 +181,8 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     k = apply_rope(k, cos, sin, positions)
     new_cache = None
     if kv_cache is not None:
-        ck, cv, cache_len = kv_cache
-        zero = jnp.zeros((), jnp.int32)
-        k = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (zero, cache_len, zero, zero))
-        v = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (zero, cache_len, zero, zero))
-        new_cache = (k, v, cache_len + s)
-        # each query position p attends to cached positions <= p (causality
-        # holds within the prefill chunk too)
-        kv_mask = (
-            jnp.arange(k.shape[1])[None, None, :] <= positions[:, :, None]
-        )  # [B, S_q, S_k]
-        mask = kv_mask if mask is None else mask[:, None, :] & kv_mask
+        k, v, new_cache = extend_cache(kv_cache, k, v)
+        mask = cached_attention_mask(k.shape[1], positions, mask)
         causal = False
     else:
         causal = True
@@ -544,79 +542,44 @@ def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bflo
     cache_len scalar). The leading layer dim lets decode scan the layer body
     (program size independent of depth); cache_len is a traced scalar so
     decode steps never retrigger tracing."""
-    kv_heads = config.num_key_value_heads
-    L = config.num_hidden_layers
-    shape = (L, batch, max_len, kv_heads, config.head_dim)
-    return (
-        jnp.zeros(shape, dtype),
-        jnp.zeros(shape, dtype),
-        jnp.zeros((), jnp.int32),
-    )
+    return make_kv_caches(config.num_hidden_layers, batch, max_len,
+                          config.num_key_value_heads, config.head_dim, dtype)
 
 
-@functools.lru_cache(maxsize=32)
-def _generate_programs(config: LlamaConfig, temperature: float):
-    """Compiled prefill + fused-decode programs, cached per (config,
-    temperature). Shapes (prompt length, token budget, batch) are ordinary
-    traced-array shapes: jit retraces on genuinely new shapes and keeps the
-    old entries — fresh closures per generate() call would instead recompile
-    every single time."""
+# Greedy/temperature decode with a KV cache (big-model-inference path;
+# benchmark analogue of ref benchmarks/big_model_inference.py). Shared
+# driver: one compiled prefill + one fused decode scan per (config, temp).
+generate = build_generate(forward, init_kv_caches)
 
-    def select(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits[:, -1], axis=-1)
-        return jax.random.categorical(k, logits[:, -1] / temperature)
+
+@functools.lru_cache(maxsize=8)
+def make_decode_layer_step(config: LlamaConfig):
+    """jit'd single-layer decode body for `streamed_generate` (offloaded
+    weights). Cached per config so warm benchmark runs reuse the program."""
 
     @jax.jit
-    def prefill(params, input_ids, caches, k):
-        logits, caches = forward(config, params, input_ids, kv_caches=caches)
-        return select(logits, k), caches
+    def step(layer, x, positions, kv_cache):
+        cos, sin = rope_frequencies(
+            config.head_dim, kv_cache[0].shape[1], config.rope_theta,
+            scaling=config.rope_scaling_dict,
+        )
+        y, cache, _ = _layer_body(config, x, layer, cos, sin, positions,
+                                  None, kv_cache)
+        return y, cache
 
-    # the whole decode is ONE compiled program: lax.scan over steps with
-    # (last, caches) as carry — a single dispatch for all tokens instead of a
-    # host round-trip per token (which dominates on remote/tunneled devices)
-    @jax.jit
-    def decode_all(params, last, caches, steps, keys):
-        b = last.shape[0]
-
-        def body(carry, xs):
-            last, caches = carry
-            pos, k = xs
-            positions = jnp.broadcast_to(pos, (b, 1))
-            logits, caches = forward(
-                config, params, last[:, None], positions=positions,
-                kv_caches=caches,
-            )
-            return (select(logits, k), caches), last
-
-        (final, _), emitted = jax.lax.scan(body, (last, caches), (steps, keys))
-        # emitted[i] is the token fed at step i ([T, B]); final is the last
-        return jnp.concatenate([emitted.T, final[:, None]], axis=1)
-
-    return prefill, decode_all
+    return step
 
 
-def generate(
-    config: LlamaConfig,
-    params: dict,
-    input_ids: jax.Array,
-    max_new_tokens: int = 32,
-    temperature: float = 0.0,
-    key: jax.Array | None = None,
-) -> jax.Array:
-    """Greedy/temperature decode with a KV cache (big-model-inference path;
-    benchmark analogue of ref benchmarks/big_model_inference.py)."""
-    b, prompt_len = input_ids.shape
-    total = prompt_len + max_new_tokens
-    caches = init_kv_caches(config, b, total)
-    if key is None:
-        key = jax.random.key(0)
-    prefill, decode_all = _generate_programs(config, float(temperature))
-    key, sub = jax.random.split(key)
-    last, caches = prefill(params, input_ids, caches, sub)
-    if max_new_tokens == 1:
-        return jnp.concatenate([input_ids, last[:, None]], axis=1)
-    keys = jax.random.split(key, max_new_tokens - 1)
-    steps = jnp.arange(prompt_len, prompt_len + max_new_tokens - 1, dtype=jnp.int32)
-    new_tokens = decode_all(params, last, caches, steps, keys)
-    return jnp.concatenate([input_ids, new_tokens], axis=1)
+def _project_decode(config: LlamaConfig, resident: dict, x):
+    # the full forward norms before projecting (forward():377); the streamed
+    # path must too or real checkpoints (norm.scale != 1) decode wrong
+    x = rms_norm(x, resident["norm"]["scale"], config.rms_norm_eps)
+    return _project_out(config, resident, x)
+
+
+streamed_generate = build_streamed_generate(
+    make_decode_layer_step,
+    embed_fn=lambda config, res, ids, pos: res["embed_tokens"]["embedding"][ids],
+    project_fn=_project_decode,
+    cache_dims=lambda c: (c.num_key_value_heads, c.head_dim),
+)

@@ -11,6 +11,7 @@ biases everywhere, and an LM head tied to the token embedding.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +22,13 @@ from .common import (
     dot_product_attention,
     layer_norm,
     normal_init,
+)
+from .decode import (
+    build_generate,
+    build_streamed_generate,
+    cached_attention_mask,
+    extend_cache,
+    make_kv_caches,
 )
 
 
@@ -89,7 +97,8 @@ def init_params(config: OPTConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     }
 
 
-def _layer_body(config: OPTConfig, x, layer, mask):
+def _layer_body(config: OPTConfig, x, layer, mask, positions=None,
+                kv_cache=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_eps
@@ -100,7 +109,13 @@ def _layer_body(config: OPTConfig, x, layer, mask):
     q = dense(y, a["q_proj"]["kernel"], a["q_proj"]["bias"]).reshape(b, s, nh, hd)
     k = dense(y, a["k_proj"]["kernel"], a["k_proj"]["bias"]).reshape(b, s, nh, hd)
     v = dense(y, a["v_proj"]["kernel"], a["v_proj"]["bias"]).reshape(b, s, nh, hd)
-    attn = dot_product_attention(q, k, v, mask=mask, causal=True)
+    new_cache = None
+    if kv_cache is not None:
+        k, v, new_cache = extend_cache(kv_cache, k, v)
+        mask = cached_attention_mask(k.shape[1], positions, mask)
+        attn = dot_product_attention(q, k, v, mask=mask, causal=False)
+    else:
+        attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     x = x + dense(attn.reshape(b, s, h), a["out_proj"]["kernel"],
                   a["out_proj"]["bias"])
 
@@ -108,7 +123,17 @@ def _layer_body(config: OPTConfig, x, layer, mask):
                    layer["final_layer_norm"]["bias"], eps)
     y = jax.nn.relu(dense(y, layer["mlp"]["fc1"]["kernel"],
                           layer["mlp"]["fc1"]["bias"]))
-    return x + dense(y, layer["mlp"]["fc2"]["kernel"], layer["mlp"]["fc2"]["bias"])
+    x = x + dense(y, layer["mlp"]["fc2"]["kernel"], layer["mlp"]["fc2"]["bias"])
+    return x, new_cache
+
+
+def _project_out(config: OPTConfig, params: dict, x):
+    x = layer_norm(x, params["final_layer_norm"]["scale"],
+                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
+    return jnp.einsum(
+        "bsh,vh->bsv", x, params["embed_tokens"]["embedding"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def forward(
@@ -116,28 +141,55 @@ def forward(
     params: dict,
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> jax.Array:
-    if attention_mask is not None:
-        # HF OPT derives positions from the mask cumsum, so left-padded
-        # batches start real tokens at position 0 (+offset)
-        m = attention_mask.astype(jnp.int32)
-        positions = (jnp.cumsum(m, axis=1) * m - 1) + _POSITION_OFFSET
-        positions = jnp.maximum(positions, 0)
-    else:
-        positions = jnp.arange(input_ids.shape[1])[None, :] + _POSITION_OFFSET
+    positions: jax.Array | None = None,
+    kv_caches=None,
+) -> jax.Array | tuple:
+    """Logits [B, S, V] (LM head tied to embed_tokens); with `kv_caches`
+    (see `init_kv_caches`), returns (logits, new_caches). `positions` are
+    logical 0-based token positions — the fairseq +2 offset is applied
+    internally at the embedding lookup."""
+    if positions is None:
+        if attention_mask is not None and kv_caches is None:
+            # HF OPT derives positions from the mask cumsum, so left-padded
+            # batches start real tokens at position 0; pads sit at -1, which
+            # lands on the fairseq padding_idx row (1) after the +2 offset
+            m = attention_mask.astype(jnp.int32)
+            positions = jnp.cumsum(m, axis=1) * m - 1
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(input_ids.shape[1]), input_ids.shape
+            )
     x = (params["embed_tokens"]["embedding"][input_ids]
-         + params["embed_positions"]["embedding"][positions])
+         + params["embed_positions"]["embedding"][positions + _POSITION_OFFSET])
+
+    if kv_caches is not None:
+        ck, cv, cache_len = kv_caches
+
+        def decode_body(carry, xs):
+            layer, ck_l, cv_l = xs
+            y, cache = _layer_body(config, carry, layer, attention_mask,
+                                   positions, (ck_l, cv_l, cache_len))
+            nk, nv, _ = cache
+            return y, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
+        return (_project_out(config, params, x),
+                (nk, nv, cache_len + input_ids.shape[1]))
 
     def scan_body(carry, layer):
-        return _layer_body(config, carry, layer, attention_mask), None
+        return _layer_body(config, carry, layer, attention_mask)[0], None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = layer_norm(x, params["final_layer_norm"]["scale"],
-                   params["final_layer_norm"]["bias"], config.layer_norm_eps)
-    return jnp.einsum(
-        "bsh,vh->bsv", x, params["embed_tokens"]["embedding"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    return _project_out(config, params, x)
+
+
+def init_kv_caches(config: OPTConfig, batch: int, max_len: int,
+                   dtype=jnp.bfloat16):
+    return make_kv_caches(config.num_hidden_layers, batch, max_len,
+                          config.num_attention_heads, config.head_dim, dtype)
+
+
+generate = build_generate(forward, init_kv_caches)
 
 
 def causal_lm_loss(config: OPTConfig, params: dict, batch: dict) -> jax.Array:
@@ -147,3 +199,30 @@ def causal_lm_loss(config: OPTConfig, params: dict, batch: dict) -> jax.Array:
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     logits = forward(config, params, input_ids[:, :-1])
     return cross_entropy_loss(logits, labels, mask)
+
+
+@functools.lru_cache(maxsize=8)
+def make_decode_layer_step(config: OPTConfig):
+    """jit'd single-layer decode body for `streamed_generate` (offloaded
+    weights — the reference's OPT-30B cpu-offload benchmark rows)."""
+
+    @jax.jit
+    def step(layer, x, positions, kv_cache):
+        return _layer_body(config, x, layer, None, positions, kv_cache)
+
+    return step
+
+
+def _embed_decode(config: OPTConfig, res: dict, ids, pos):
+    return (res["embed_tokens"]["embedding"][ids]
+            + res["embed_positions"]["embedding"][pos + _POSITION_OFFSET])
+
+
+# _project_out includes the final layer norm, so it is directly the
+# streamed path's projection
+streamed_generate = build_streamed_generate(
+    make_decode_layer_step,
+    embed_fn=_embed_decode,
+    project_fn=lambda config, res, x: _project_out(config, res, x),
+    cache_dims=lambda c: (c.num_attention_heads, c.head_dim),
+)

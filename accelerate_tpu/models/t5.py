@@ -13,6 +13,7 @@ ReLU or gated-GELU MLP (v1.1/T0), and a tied-scaled or untied LM head.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -253,6 +254,287 @@ def _forward_f32(config, params, input_ids, decoder_input_ids,
         "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
+
+
+# --- incremental decode (the T0pp row of the reference's benchmark, ref
+# benchmarks/README.md:33, big_model_inference.py) ---------------------------
+
+
+def _position_bias_at(rel_embedding, positions, k_len: int,
+                      num_buckets: int, max_distance: int):
+    """Decoder self-attention bias for queries at traced `positions` [B, S_q]
+    over cached keys 0..k_len-1 → [B, H, S_q, k_len]. Unlike
+    `_position_bias`, query positions are runtime values so single-token
+    decode steps at any position share one compiled program."""
+    mem = jnp.arange(k_len)[None, None, :]
+    buckets = _relative_buckets(mem - positions[:, :, None], False,
+                                num_buckets, max_distance)
+    return rel_embedding[buckets].transpose(0, 3, 1, 2)  # [B, H, q, k]
+
+
+def _qo_attention(config: T5Config, proj, x, k, v, mask, bias=None):
+    """T5 attention against precomputed/cached K,V [B, S_k, H, D]: only the
+    q and o projections run. No 1/sqrt(d) scaling (T5 convention)."""
+    b, sq, _ = x.shape
+    nh, dk = config.num_heads, config.d_kv
+    q = dense(x, proj["q"]["kernel"]).reshape(b, sq, nh, dk)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    return dense(out.reshape(b, sq, nh * dk), proj["o"]["kernel"])
+
+
+def init_decode_state(config: T5Config, params: dict, input_ids: jax.Array,
+                      max_new_tokens: int,
+                      attention_mask: jax.Array | None = None,
+                      dtype=jnp.float32) -> dict:
+    """Run the encoder ONCE and precompute every decoder layer's
+    cross-attention K/V from it (they never change during decode — the
+    encoder is never touched again). Self-attention caches stack on the
+    layer dim like the causal families (models/decode.py)."""
+    with jax.default_matmul_precision("float32"):
+        enc = _encoder(config, params, input_ids, attention_mask)
+    return _state_from_encoded(config, params, enc, max_new_tokens,
+                               attention_mask, dtype)
+
+
+def _state_from_encoded(config: T5Config, params: dict, enc: jax.Array,
+                        max_new_tokens: int, attention_mask, dtype) -> dict:
+    from .decode import make_kv_caches
+
+    nh, dk = config.num_heads, config.d_kv
+    Ld = config.num_decoder_layers
+    b, s_enc = enc.shape[:2]
+    with jax.default_matmul_precision("float32"):
+        cross = params["decoder"]["layers"]["cross_attn"]
+        # one einsum over the stacked layer dim projects all layers at once
+        cross_k = jnp.einsum("bsh,lhf->lbsf", enc, cross["k"]["kernel"]
+                             ).reshape(Ld, b, s_enc, nh, dk).astype(dtype)
+        cross_v = jnp.einsum("bsh,lhf->lbsf", enc, cross["v"]["kernel"]
+                             ).reshape(Ld, b, s_enc, nh, dk).astype(dtype)
+    self_k, self_v, cache_len = make_kv_caches(
+        Ld, b, 1 + max_new_tokens, nh, dk, dtype)
+    return {
+        "cross_k": cross_k, "cross_v": cross_v,
+        "self_k": self_k, "self_v": self_v, "cache_len": cache_len,
+        "enc_mask": attention_mask,
+    }
+
+
+def decode_step(config: T5Config, params: dict, decoder_ids: jax.Array,
+                positions: jax.Array, state: dict):
+    """One incremental decoder step: logits [B, S, V] + updated state.
+    `decoder_ids`/`positions` are [B, S] (S=1 in the generate loop)."""
+    with jax.default_matmul_precision("float32"):
+        return _decode_step_f32(config, params, decoder_ids, positions, state)
+
+
+def _decode_step_f32(config, params, decoder_ids, positions, state):
+    from .decode import cached_attention_mask, extend_cache
+
+    eps = config.layer_norm_epsilon
+    x = params["shared"]["embedding"][decoder_ids]
+    m = state["self_k"].shape[2]
+    self_bias = _position_bias_at(
+        params["decoder"]["rel_bias"]["embedding"], positions, m,
+        config.relative_attention_num_buckets,
+        config.relative_attention_max_distance,
+    )
+    self_mask = cached_attention_mask(m, positions)[:, None]  # [B,1,q,k]
+    cross_mask = (
+        state["enc_mask"][:, None, None, :]
+        if state["enc_mask"] is not None else None
+    )
+    cache_len = state["cache_len"]
+
+    def body(carry, xs):
+        x = carry
+        layer, ck_l, cv_l, xk_l, xv_l = xs
+        h = rms_norm(x, layer["ln_self"]["scale"], eps)
+        nh, dk = config.num_heads, config.d_kv
+        b, sq, _ = h.shape
+        k = dense(h, layer["self_attn"]["k"]["kernel"]).reshape(b, sq, nh, dk)
+        v = dense(h, layer["self_attn"]["v"]["kernel"]).reshape(b, sq, nh, dk)
+        k_full, v_full, (nk, nv, _) = extend_cache((ck_l, cv_l, cache_len), k, v)
+        x = x + _qo_attention(config, layer["self_attn"], h, k_full, v_full,
+                              self_mask, self_bias)
+        h = rms_norm(x, layer["ln_cross"]["scale"], eps)
+        x = x + _qo_attention(config, layer["cross_attn"], h,
+                              xk_l.astype(h.dtype), xv_l.astype(h.dtype),
+                              cross_mask)
+        x = x + _t5_mlp(config, layer["mlp"],
+                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
+        return x, (nk, nv)
+
+    xs = (params["decoder"]["layers"], state["self_k"], state["self_v"],
+          state["cross_k"], state["cross_v"])
+    x, (nk, nv) = jax.lax.scan(body, x, xs)
+    x = rms_norm(x, params["decoder"]["final_ln"]["scale"], eps)
+    if config.tie_word_embeddings:
+        x = x * (config.d_model ** -0.5)
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x, params["shared"]["embedding"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    new_state = dict(state, self_k=nk, self_v=nv,
+                     cache_len=cache_len + decoder_ids.shape[1])
+    return logits, new_state
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_programs(config: T5Config, temperature: float):
+    def select(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits[:, -1], axis=-1)
+        return jax.random.categorical(k, logits[:, -1] / temperature)
+
+    # the whole decode is ONE compiled program (models/decode.py rationale):
+    # lax.scan over steps, (last_token, caches) carry, single dispatch
+    @jax.jit
+    def decode_all(params, state, last, steps, keys):
+        b = last.shape[0]
+        const = {k: state[k] for k in ("cross_k", "cross_v", "enc_mask")}
+
+        def body(carry, xs):
+            last, sk, sv, clen = carry
+            pos, k = xs
+            st = dict(const, self_k=sk, self_v=sv, cache_len=clen)
+            logits, st = decode_step(
+                config, params, last[:, None],
+                jnp.broadcast_to(pos, (b, 1)), st,
+            )
+            return (select(logits, k), st["self_k"], st["self_v"],
+                    st["cache_len"]), last
+
+        carry = (last, state["self_k"], state["self_v"], state["cache_len"])
+        (final, *_), emitted = jax.lax.scan(body, carry, (steps, keys))
+        return jnp.concatenate([emitted.T, final[:, None]], axis=1)
+
+    return decode_all
+
+
+def generate(
+    config: T5Config,
+    params: dict,
+    input_ids: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    attention_mask: jax.Array | None = None,
+    decoder_start_token_id: int = 0,
+) -> jax.Array:
+    """Encoder-decoder greedy/temperature decode. Returns the decoder ids
+    INCLUDING the start token [B, 1 + n_generated] (HF generate layout)."""
+    b = input_ids.shape[0]
+    state = init_decode_state(config, params, input_ids, max_new_tokens,
+                              attention_mask)
+    if key is None:
+        key = jax.random.key(0)
+    decode_all = _generate_programs(config, float(temperature))
+    start = jnp.full((b,), decoder_start_token_id, jnp.int32)
+    keys = jax.random.split(key, max_new_tokens)
+    steps = jnp.arange(max_new_tokens, dtype=jnp.int32)
+    out = decode_all(params, state, start, steps, keys)
+    return out
+
+
+@functools.lru_cache(maxsize=8)
+def _enc_layer_program(config: T5Config):
+    """jit'd encoder layer body for the streamed path, cached per config —
+    bias/pad ride as traced arguments so warm calls reuse the program
+    instead of constant-folding fresh closures every generate."""
+    eps = config.layer_norm_epsilon
+
+    @jax.jit
+    def enc_layer(layer, x, bias, pad):
+        with jax.default_matmul_precision("float32"):
+            h = rms_norm(x, layer["ln_attn"]["scale"], eps)
+            x = x + _t5_attention(config, layer["attn"], h, h, bias, pad)
+            x = x + _t5_mlp(config, layer["mlp"],
+                            rms_norm(x, layer["ln_mlp"]["scale"], eps))
+        return x
+
+    return enc_layer
+
+
+def streamed_generate(config: T5Config, params: dict, input_ids,
+                      max_new_tokens: int = 32, temperature: float = 0.0,
+                      key=None, attention_mask=None,
+                      decoder_start_token_id: int = 0,
+                      dtype=jnp.bfloat16, device=None):
+    """Hybrid big-model decode for checkpoints larger than device memory
+    (the T0pp row of ref benchmarks/README.md:33): ENCODER layers stream
+    host→device once (the encoder runs a single time per prompt), while the
+    decoder half — which runs every token — is fetched resident, along with
+    the precomputed cross-attention K/V. TPU-first split: pay the streaming
+    cost where compute happens once, keep the token loop at HBM rate."""
+    import numpy as np
+
+    from ..big_modeling import (
+        _fetch_leaf,
+        fetch_resident,
+        make_layer_slicer,
+    )
+
+    device = device or jax.local_devices()[0]
+    b, s_enc = np.shape(input_ids)
+    input_ids = jnp.asarray(input_ids)
+    eps = config.layer_norm_epsilon
+
+    # --- streamed encoder (runs once) ---
+    enc_res = fetch_resident(
+        {"shared": params["shared"],
+         "rel_bias": params["encoder"]["rel_bias"],
+         "final_ln": params["encoder"]["final_ln"]},
+        stacked_module="", device=device, dtype=dtype)
+    n_layers, layer_slice = make_layer_slicer(
+        params["encoder"]["layers"], device, dtype)
+    bias = _position_bias(
+        enc_res["rel_bias"]["embedding"].astype(jnp.float32), s_enc, s_enc,
+        True, config.relative_attention_num_buckets,
+        config.relative_attention_max_distance,
+    )
+    pad = attention_mask[:, None, None, :] if attention_mask is not None else None
+
+    enc_layer = _enc_layer_program(config)
+    x = enc_res["shared"]["embedding"][input_ids]
+    nxt = layer_slice(0)
+    for i in range(n_layers):
+        cur = nxt
+        if i + 1 < n_layers:
+            nxt = layer_slice(i + 1)  # async H2D overlaps compute
+        x = enc_layer(cur, x, bias, pad)
+    enc = rms_norm(x, enc_res["final_ln"]["scale"], eps)
+
+    # --- resident decoder token loop ---
+    dec_params = {
+        "shared": enc_res["shared"],
+        "decoder": jax.tree_util.tree_map(
+            lambda l: _fetch_leaf(l, device, dtype), params["decoder"]),
+    }
+    if "lm_head" in params:
+        dec_params["lm_head"] = jax.tree_util.tree_map(
+            lambda l: _fetch_leaf(l, device, dtype), params["lm_head"])
+    state = _state_from_encoded(config, dec_params, enc, max_new_tokens,
+                                attention_mask, dtype)
+    if key is None:
+        key = jax.random.key(0)
+    decode_all = _generate_programs(config, float(temperature))
+    start = jnp.full((b,), decoder_start_token_id, jnp.int32)
+    keys = jax.random.split(key, max_new_tokens)
+    steps = jnp.arange(max_new_tokens, dtype=jnp.int32)
+    return decode_all(dec_params, state, start, steps, keys)
 
 
 def seq2seq_loss(config: T5Config, params: dict, batch: dict) -> jax.Array:

@@ -536,3 +536,131 @@ def test_gptj_full_head_rotary_dim_none():
         "n_positions": 32, "rotary_dim": None,
     })
     assert cfg.rotary_dim == 16  # full head dim
+
+
+# --- greedy generate parity (the reference's benchmark operation, ref
+# benchmarks/big_model_inference.py:94-108) ----------------------------------
+
+
+def _assert_greedy_match(hf_model, ids, n, got, prompt_len):
+    """Require token-exact greedy agreement, except where HF's own top-2
+    logit gap is below float tolerance — there a 3e-4 logit wiggle
+    legitimately flips argmax and the sequences fork (stop comparing that
+    row). At least one full row must match end-to-end."""
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor(ids, dtype=torch.long), max_new_tokens=n,
+            do_sample=False, pad_token_id=0, output_scores=True,
+            return_dict_in_generate=True,
+        )
+    want = out.sequences.numpy()
+    np.testing.assert_array_equal(got[:, :prompt_len], want[:, :prompt_len])
+    full_rows = 0
+    for r in range(want.shape[0]):
+        forked = False
+        for step, scores in enumerate(out.scores):
+            col = prompt_len + step
+            if col >= got.shape[1]:
+                break
+            if got[r, col] == want[r, col]:
+                continue
+            top2 = torch.topk(scores[r], 2).values
+            gap = float(top2[0] - top2[1])
+            assert gap < 1e-2, (
+                f"row {r} diverged at step {step} with decisive HF logit "
+                f"gap {gap:.4f}: got {got[r, col]}, want {want[r, col]}"
+            )
+            forked = True
+            break
+        full_rows += not forked
+    assert full_rows >= 1, "every row forked on ties — suspicious"
+
+
+def test_gpt2_generate_parity():
+    from accelerate_tpu.models import gpt2, hf_import
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=160, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(30)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gpt2", hf_cfg)
+    params = hf_import.params_from_hf("gpt2", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(31).integers(0, 160, (2, 7)).astype(np.int32)
+    got = np.asarray(gpt2.generate(cfg, params, ids, max_new_tokens=8))
+    _assert_greedy_match(hf_model, ids, 8, got, prompt_len=7)
+
+
+def test_gptj_generate_parity():
+    from accelerate_tpu.models import gptj, hf_import
+
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=160, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(32)
+    hf_model = transformers.GPTJForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gptj", hf_cfg)
+    params = hf_import.params_from_hf("gptj", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(33).integers(0, 160, (2, 7)).astype(np.int32)
+    got = np.asarray(gptj.generate(cfg, params, ids, max_new_tokens=8))
+    _assert_greedy_match(hf_model, ids, 8, got, prompt_len=7)
+
+
+def test_gpt_neox_generate_parity():
+    from accelerate_tpu.models import gpt_neox, hf_import
+
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=160, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        use_parallel_residual=True,
+    )
+    torch.manual_seed(34)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("gpt_neox", hf_cfg)
+    params = hf_import.params_from_hf("gpt_neox", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(35).integers(0, 160, (2, 7)).astype(np.int32)
+    got = np.asarray(gpt_neox.generate(cfg, params, ids, max_new_tokens=8))
+    _assert_greedy_match(hf_model, ids, 8, got, prompt_len=7)
+
+
+def test_opt_generate_parity():
+    from accelerate_tpu.models import hf_import, opt
+
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=160, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, dropout=0.0, attention_dropout=0.0,
+        word_embed_proj_dim=64,
+    )
+    torch.manual_seed(36)
+    hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("opt", hf_cfg)
+    params = hf_import.params_from_hf("opt", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(37).integers(2, 160, (2, 7)).astype(np.int32)
+    got = np.asarray(opt.generate(cfg, params, ids, max_new_tokens=8))
+    _assert_greedy_match(hf_model, ids, 8, got, prompt_len=7)
+
+
+@pytest.mark.parametrize("gated,tied", [(False, True), (True, False)])
+def test_t5_generate_parity(gated, tied):
+    from accelerate_tpu.models import hf_import, t5
+
+    hf_cfg = transformers.T5Config(
+        vocab_size=160, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_decoder_layers=2, num_heads=4, dropout_rate=0.0,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        tie_word_embeddings=tied, decoder_start_token_id=0,
+        eos_token_id=None, pad_token_id=0,
+    )
+    torch.manual_seed(38)
+    hf_model = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("t5", hf_cfg)
+    params = hf_import.params_from_hf("t5", cfg, hf_model.state_dict())
+    enc_ids = np.random.default_rng(39).integers(0, 160, (2, 9)).astype(np.int32)
+    got = np.asarray(t5.generate(cfg, params, enc_ids, max_new_tokens=8))
+    # decoder output: start token + 8 generated, so prompt_len=1
+    _assert_greedy_match(hf_model, enc_ids, 8, got, prompt_len=1)

@@ -277,3 +277,110 @@ def test_sparse_moe_sort_path_matches_dense_at_full_capacity(monkeypatch):
     out_s, _ = mixtral.forward(sparse_cfg, params, ids)
     np.testing.assert_allclose(
         np.asarray(out_d), np.asarray(out_s), atol=1e-3)
+
+
+# --- zoo-wide decode (ref benchmarks/big_model_inference.py families) -------
+
+
+def _zoo_member(name):
+    from accelerate_tpu.models import gpt2, gpt_neox, gptj, opt
+
+    mod = {"gpt2": gpt2, "gptj": gptj, "gpt_neox": gpt_neox, "opt": opt}[name]
+    cfg_cls = {
+        "gpt2": gpt2.GPT2Config, "gptj": gptj.GPTJConfig,
+        "gpt_neox": gpt_neox.GPTNeoXConfig, "opt": opt.OPTConfig,
+    }[name]
+    return mod, cfg_cls.tiny()
+
+
+@pytest.mark.parametrize("name", ["gpt2", "gptj", "gpt_neox", "opt"])
+def test_zoo_decode_matches_forward(name):
+    """Every causal family's KV-cache decode must reproduce its own
+    full-forward logits (prefill chunk + per-token steps)."""
+    mod, cfg = _zoo_member(name)
+    params = mod.init_params(cfg, jax.random.key(3))
+    ids = jax.random.randint(jax.random.key(4), (2, 10), 0, cfg.vocab_size)
+    full = mod.forward(cfg, params, ids)
+    caches = mod.init_kv_caches(cfg, 2, 16, dtype=jnp.float32)
+    prefix, caches = mod.forward(cfg, params, ids[:, :5], kv_caches=caches)
+    np.testing.assert_allclose(np.asarray(prefix), np.asarray(full[:, :5]),
+                               atol=2e-2)
+    outs = []
+    for t in range(5, 10):
+        step_logits, caches = mod.forward(
+            cfg, params, ids[:, t : t + 1],
+            positions=jnp.full((2, 1), t), kv_caches=caches,
+        )
+        outs.append(step_logits)
+    decoded = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(decoded), np.asarray(full[:, 5:]),
+                               atol=2e-2)
+
+
+@pytest.mark.parametrize("name", ["gpt2", "gptj", "gpt_neox", "opt"])
+def test_zoo_generate_greedy_deterministic(name):
+    mod, cfg = _zoo_member(name)
+    params = mod.init_params(cfg, jax.random.key(5))
+    ids = jnp.ones((1, 4), jnp.int32)
+    out1 = mod.generate(cfg, params, ids, max_new_tokens=6)
+    out2 = mod.generate(cfg, params, ids, max_new_tokens=6)
+    assert out1.shape == (1, 10)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_t5_decode_matches_forward():
+    """Incremental enc-dec decode (self cache + precomputed cross K/V) must
+    match the teacher-forced full decoder forward."""
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny()
+    params = t5.init_params(cfg, jax.random.key(6))
+    rng = np.random.default_rng(7)
+    enc_ids = rng.integers(0, cfg.vocab_size, (2, 9)).astype(np.int32)
+    dec_ids = np.concatenate(
+        [np.zeros((2, 1), np.int32),
+         rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)], axis=1)
+    full = t5.forward(cfg, params, enc_ids, dec_ids)
+    state = t5.init_decode_state(cfg, params, enc_ids, max_new_tokens=7)
+    outs = []
+    for t in range(7):
+        logits, state = t5.decode_step(
+            cfg, params, dec_ids[:, t : t + 1], jnp.full((2, 1), t), state)
+        outs.append(logits)
+    decoded = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(decoded), np.asarray(full),
+                               atol=1e-4)
+
+
+def test_t5_decode_respects_encoder_padding():
+    """Cross-attention in decode must honor the encoder padding mask: row 0's
+    padded tail, if attended, would change its logits."""
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny()
+    params = t5.init_params(cfg, jax.random.key(8))
+    rng = np.random.default_rng(9)
+    enc_ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    mask = np.ones((2, 8), bool)
+    mask[0, 5:] = False
+    dec_ids = np.zeros((2, 1), np.int32)
+    full = t5.forward(cfg, params, enc_ids, dec_ids, attention_mask=mask)
+    state = t5.init_decode_state(cfg, params, enc_ids, max_new_tokens=1,
+                                 attention_mask=jnp.asarray(mask))
+    logits, _ = t5.decode_step(cfg, params, dec_ids, jnp.zeros((2, 1),
+                               jnp.int32), state)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               atol=1e-4)
+
+
+def test_t5_generate_shapes_and_determinism():
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny()
+    params = t5.init_params(cfg, jax.random.key(10))
+    enc_ids = jnp.ones((2, 5), jnp.int32)
+    out1 = t5.generate(cfg, params, enc_ids, max_new_tokens=4)
+    out2 = t5.generate(cfg, params, enc_ids, max_new_tokens=4)
+    assert out1.shape == (2, 5)  # start token + 4 generated
+    assert np.asarray(out1[:, 0]).tolist() == [0, 0]
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
