@@ -336,6 +336,20 @@ def make_layer_slicer(stacked: Any, device, dtype):
     return n_layers, _layer_slice
 
 
+def stream_layers(layer_slice, n_layers: int, step_fn, x):
+    """Drive the double-buffered layer loop: fetch layer i+1 (async H2D)
+    while layer i computes. `step_fn(layer, i, x) -> x`. The single home of
+    the prefetch-overlap invariant for streamed_forward/streamed_generate
+    and T5's streamed encoder."""
+    nxt = layer_slice(0)
+    for i in range(n_layers):
+        cur = nxt
+        if i + 1 < n_layers:
+            nxt = layer_slice(i + 1)
+        x = step_fn(cur, i, x)
+    return x
+
+
 def streamed_generate(
     params: Any,
     input_ids,
@@ -378,17 +392,18 @@ def streamed_generate(
         key = jax.random.key(0)
 
     def run_stack(ids, positions, cache_len):
-        x = embed_fn(resident, ids, positions)
-        nxt = layer_slice(0)
-        new_len = None
-        for i in range(n_layers):
-            cur = nxt
-            if i + 1 < n_layers:
-                nxt = layer_slice(i + 1)  # async H2D overlaps compute
-            x, (nk, nv, new_len) = layer_step_fn(
-                cur, x, positions, (caches[i][0], caches[i][1], cache_len))
+        new_len = [None]
+
+        def step(layer, i, x):
+            x, (nk, nv, nl) = layer_step_fn(
+                layer, x, positions, (caches[i][0], caches[i][1], cache_len))
             caches[i] = (nk, nv)
-        return project_fn(resident, x), new_len
+            new_len[0] = nl
+            return x
+
+        x = stream_layers(layer_slice, n_layers, step,
+                          embed_fn(resident, ids, positions))
+        return project_fn(resident, x), new_len[0]
 
     def select(logits, k):
         if temperature == 0.0:
@@ -432,13 +447,9 @@ def streamed_forward(
     n_layers, _layer_slice = make_layer_slicer(
         params[stacked_module], device, dtype)
 
-    x = embed_fn(resident, inputs)
-    nxt = _layer_slice(0)  # double buffer: prefetch layer 0
-    for i in range(n_layers):
-        cur = nxt
-        if i + 1 < n_layers:
-            nxt = _layer_slice(i + 1)  # async H2D while layer i computes
-        x = layer_fn(cur, x, i)
+    x = stream_layers(_layer_slice, n_layers,
+                      lambda layer, i, x: layer_fn(layer, x, i),
+                      embed_fn(resident, inputs))
     return final_fn(resident, x)
 
 

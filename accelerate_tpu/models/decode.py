@@ -69,6 +69,15 @@ def cached_attention_mask(k_len: int, positions, mask=None):
     return kv_mask if mask is None else mask[:, None, :] & kv_mask
 
 
+def sample_token(logits, key, temperature: float):
+    """Next token from the last position's logits: argmax at temperature 0,
+    else temperature-scaled categorical. The ONE sampling rule shared by the
+    on-device, streamed, and T5 decode paths."""
+    if temperature == 0.0:
+        return jnp.argmax(logits[:, -1], axis=-1)
+    return jax.random.categorical(key, logits[:, -1] / temperature)
+
+
 def build_generate(forward, init_caches):
     """Greedy/temperature `generate` for a causal family.
 
@@ -83,9 +92,7 @@ def build_generate(forward, init_caches):
     @functools.lru_cache(maxsize=32)
     def _programs(config, temperature: float):
         def select(logits, k):
-            if temperature == 0.0:
-                return jnp.argmax(logits[:, -1], axis=-1)
-            return jax.random.categorical(k, logits[:, -1] / temperature)
+            return sample_token(logits, k, temperature)
 
         @jax.jit
         def prefill(params, input_ids, caches, k):

@@ -229,9 +229,12 @@ def make_decode_layer_step(config: GPTNeoXConfig):
 
     @jax.jit
     def step(layer, x, positions, kv_cache):
+        # size the table by the cache reach too: decoding past
+        # max_position_embeddings must extend the rotary angles, not let the
+        # gather clamp every overflow token to the last row
+        max_len = max(config.max_position_embeddings, kv_cache[0].shape[1])
         cos, sin = rope_frequencies(
-            config.rotary_ndims, config.max_position_embeddings,
-            config.rotary_emb_base,
+            config.rotary_ndims, max_len, config.rotary_emb_base,
         )
         return _layer_body(config, x, layer, cos, sin, positions, None,
                            kv_cache)

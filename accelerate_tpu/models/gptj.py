@@ -105,10 +105,13 @@ def _rotate_every_two(x):
 
 def _apply_interleaved_rope(x, sin, cos, positions):
     """GPT-J rotary: pairs are interleaved (dims 0&1, 2&3, ...) rather than
-    split-half; sin/cos repeat per pair."""
+    split-half; sin/cos repeat per pair. Rotation math runs f32 but the
+    output keeps x's dtype (bf16 checkpoints must not upcast the residual
+    stream — the layer scan carry dtype is fixed)."""
     sin_p = jnp.repeat(sin[positions], 2, axis=-1)[:, :, None, :]
     cos_p = jnp.repeat(cos[positions], 2, axis=-1)[:, :, None, :]
-    return x * cos_p + _rotate_every_two(x) * sin_p
+    xf = x.astype(jnp.float32)
+    return (xf * cos_p + _rotate_every_two(xf) * sin_p).astype(x.dtype)
 
 
 def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask,

@@ -197,14 +197,22 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
             "flash" if on_tpu and kv_cache is None and s >= 1024
             else "einsum"
         )
-    # flash takes key-padding masks ([B, S]) natively; ring/ulysses still
-    # require mask-free batches
-    if backend == "ring" and kv_cache is None and mask is None:
+    # flash, ring, and ulysses all take [B, S] key-padding masks natively
+    # (ring rotates mask chunks with K/V; ulysses all-gathers the mask), so
+    # padded batches keep every fast path
+    key_mask = mask if mask is None or getattr(mask, "ndim", 0) == 2 else None
+    if backend == "ring" and kv_cache is None and (mask is None or key_mask is not None):
         # ring handles GQA itself: un-repeated K/V chunks ride the ring (the
         # repeat factor never touches ICI)
         from ..parallel.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, causal=True)
+        out = ring_attention(q, k, v, causal=True, mask=key_mask)
+    elif backend == "ulysses" and kv_cache is None and (mask is None or key_mask is not None):
+        # ulysses also keeps GQA K/V un-repeated on the wire (repeat happens
+        # after its all-to-all)
+        from ..parallel.ulysses import ulysses_attention
+
+        out = ulysses_attention(q, k, v, causal=True, mask=key_mask)
     else:
         k = repeat_kv(k, nh // nkv)
         v = repeat_kv(v, nh // nkv)
@@ -214,10 +222,6 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
             from ..ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True, mask=mask)
-        elif backend == "ulysses" and kv_cache is None and mask is None:
-            from ..parallel.ulysses import ulysses_attention
-
-            out = ulysses_attention(q, k, v, causal=True)
         else:
             out = dot_product_attention(q, k, v, mask=mask, causal=causal)
     out = out.reshape(b, s, nh * hd)

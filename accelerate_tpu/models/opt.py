@@ -155,6 +155,15 @@ def forward(
             # lands on the fairseq padding_idx row (1) after the +2 offset
             m = attention_mask.astype(jnp.int32)
             positions = jnp.cumsum(m, axis=1) * m - 1
+        elif attention_mask is not None:
+            # a masked CACHED prefill can't infer positions: the mask spans
+            # the whole cache, not the prompt, so the cumsum trick doesn't
+            # apply — silent arange would misplace left-padded tokens
+            raise ValueError(
+                "opt.forward with kv_caches and attention_mask needs "
+                "explicit `positions`: derive them from the prompt's real "
+                "tokens (left pads would otherwise get shifted embeddings)"
+            )
         else:
             positions = jnp.broadcast_to(
                 jnp.arange(input_ids.shape[1]), input_ids.shape

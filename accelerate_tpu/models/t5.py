@@ -394,10 +394,10 @@ def _decode_step_f32(config, params, decoder_ids, positions, state):
 
 @functools.lru_cache(maxsize=32)
 def _generate_programs(config: T5Config, temperature: float):
+    from .decode import sample_token
+
     def select(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits[:, -1], axis=-1)
-        return jax.random.categorical(k, logits[:, -1] / temperature)
+        return sample_token(logits, k, temperature)
 
     # the whole decode is ONE compiled program (models/decode.py rationale):
     # lax.scan over steps, (last_token, caches) carry, single dispatch
@@ -485,6 +485,7 @@ def streamed_generate(config: T5Config, params: dict, input_ids,
         _fetch_leaf,
         fetch_resident,
         make_layer_slicer,
+        stream_layers,
     )
 
     device = device or jax.local_devices()[0]
@@ -508,13 +509,9 @@ def streamed_generate(config: T5Config, params: dict, input_ids,
     pad = attention_mask[:, None, None, :] if attention_mask is not None else None
 
     enc_layer = _enc_layer_program(config)
-    x = enc_res["shared"]["embedding"][input_ids]
-    nxt = layer_slice(0)
-    for i in range(n_layers):
-        cur = nxt
-        if i + 1 < n_layers:
-            nxt = layer_slice(i + 1)  # async H2D overlaps compute
-        x = enc_layer(cur, x, bias, pad)
+    x = stream_layers(layer_slice, n_layers,
+                      lambda layer, i, x: enc_layer(layer, x, bias, pad),
+                      enc_res["shared"]["embedding"][input_ids])
     enc = rms_norm(x, enc_res["final_ln"]["scale"], eps)
 
     # --- resident decoder token loop ---

@@ -52,20 +52,37 @@ def _chunk_blocks(s_local: int) -> int:
     return _pow2_floor(min(512, s_local))
 
 
-def _chunk_fwd(q, k, v, causal: bool, interpret: bool):
-    """One chunk pair through the flash kernel; returns (o, lse[B,H,S])."""
+def _kernel_mask(mask, b, s):
+    """[B, s] key mask -> the kernel's [B, SUB, s] sublane-broadcast f32."""
+    from ..ops.flash_attention import _SUB
+
+    return jnp.broadcast_to(mask.astype(jnp.float32)[:, None, :], (b, _SUB, s))
+
+
+def _chunk_fwd(q, k, v, causal: bool, interpret: bool, mask=None):
+    """One chunk pair through the flash kernel; returns (o, lse[B,H,S]).
+    `mask` is this K/V chunk's [B, s] key-padding mask; a batch row whose
+    chunk is fully masked reports lse = -inf so the streaming fold treats it
+    as no contribution (the kernel itself pins such rows to lse = 0)."""
     b, s, h, d = q.shape
     blk = _chunk_blocks(s)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     o, lse = _flash_forward(qf, kf, vf, causal, blk, blk, interpret,
-                            save_residuals=True)
+                            save_residuals=True,
+                            mask=None if mask is None else _kernel_mask(mask, b, s),
+                            heads=h)
     o = o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
-    return o, lse[..., 0].reshape(b, h, s)
+    lse = lse[..., 0].reshape(b, h, s)
+    if mask is not None:
+        # (the kernel already zeros such rows' outputs)
+        any_key = jnp.any(mask > 0, axis=-1)  # [B]
+        lse = jnp.where(any_key[:, None, None], lse, NEG_INF)
+    return o, lse
 
 
-def _chunk_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool):
+def _chunk_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool, mask=None):
     """Flash backward for one chunk pair using the GLOBAL lse — exactly the
     ring-attention backward: p = exp(s - lse_global) are the true
     (unnormalized-by-chunk) probabilities, delta = rowsum(do * o_global)."""
@@ -76,6 +93,7 @@ def _chunk_bwd(q, k, v, o, lse, do, causal: bool, interpret: bool):
         to_f(q), to_f(k), to_f(v), to_f(o),
         lse.reshape(b * h, s), to_f(do),
         causal, blk, blk, interpret,
+        mask=None if mask is None else _kernel_mask(mask, b, s), heads=h,
     )
     back = lambda t: t.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
     return back(dq), back(dk), back(dv)
@@ -107,32 +125,31 @@ def _fold(out, lse, o_i, lse_i, visible):
     return out * w_old + o_i * w_new, new_lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
-    return _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep,
-                           interpret)[0]
-
-
-def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
+def _ring_flash_fwd_impl(q, k, v, mask, axis_name, axis_size, causal, n_rep,
+                         interpret):
+    """Forward ring. `mask` is this device's [B, S_local] key-padding chunk
+    (or None); it rotates around the ring WITH its K/V chunk."""
     my = jax.lax.axis_index(axis_name)
     b, s, h, d = q.shape
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     # step 0: the diagonal chunk (causal within the chunk)
     o0, lse0 = _chunk_fwd(q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep),
-                          causal, interpret)
+                          causal, interpret, mask=mask)
     out, lse = o0.astype(jnp.float32), lse0
 
     def step(carry, t):
-        out, lse, k_cur, v_cur = carry
+        out, lse, k_cur, v_cur, m_cur = carry
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if m_cur is not None:
+            m_cur = jax.lax.ppermute(m_cur, axis_name, perm)
         src = (my - t) % axis_size
 
         def live(_):
             o_i, lse_i = _chunk_fwd(
                 q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
-                False, interpret,
+                False, interpret, mask=m_cur,
             )
             return o_i.astype(jnp.float32), lse_i
 
@@ -147,18 +164,24 @@ def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
         else:
             o_i, lse_i = live(None)
         out, lse = _fold(out, lse, o_i, lse_i, jnp.bool_(True))
-        return (out, lse, k_cur, v_cur), None
+        return (out, lse, k_cur, v_cur, m_cur), None
 
     if axis_size > 1:
-        (out, lse, _, _), _ = jax.lax.scan(
-            step, (out, lse, k, v), jnp.arange(1, axis_size)
+        (out, lse, _, _, _), _ = jax.lax.scan(
+            step, (out, lse, k, v, mask), jnp.arange(1, axis_size)
         )
     out = out.astype(q.dtype)
-    return out, (q, k, v, out, lse)
+    if mask is not None:
+        # rows with NO visible key anywhere (padded queries) folded to
+        # lse = -inf; pin to 0 (the kernel's own convention) so the backward
+        # computes p = exp(-inf - 0) = 0 instead of exp(-inf + inf) garbage
+        lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)
+    return out, (q, k, v, out, lse, mask)
 
 
-def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
-    q, k, v, o, lse = res
+def _ring_flash_bwd_impl(axis_name, axis_size, causal, n_rep, interpret,
+                         res, g):
+    q, k, v, o, lse, mask = res
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
     lse_f = lse  # [B,H,S] global logsumexp
@@ -166,7 +189,7 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
     # diagonal chunk
     dq, dk0, dv0 = _chunk_bwd(
         q, _repeat_heads(k, n_rep), _repeat_heads(v, n_rep), o, lse_f, g,
-        causal, interpret,
+        causal, interpret, mask=mask,
     )
     dq = dq.astype(jnp.float32)
     dk_cur = _reduce_heads(dk0.astype(jnp.float32), n_rep)
@@ -175,9 +198,11 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
     h_full = q.shape[2]
 
     def step(carry, t):
-        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq, k_cur, v_cur, m_cur, dk_cur, dv_cur = carry
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if m_cur is not None:
+            m_cur = jax.lax.ppermute(m_cur, axis_name, perm)
         dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
         src = (my - t) % axis_size
@@ -185,7 +210,7 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
         def live(_):
             return _chunk_bwd(
                 q, _repeat_heads(k_cur, n_rep), _repeat_heads(v_cur, n_rep),
-                o, lse_f, g, False, interpret,
+                o, lse_f, g, False, interpret, mask=m_cur,
             )
 
         def dead(_):
@@ -206,11 +231,11 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
         dq = dq + dq_i.astype(jnp.float32)
         dk_cur = dk_cur + _reduce_heads(dk_i.astype(jnp.float32), n_rep)
         dv_cur = dv_cur + _reduce_heads(dv_i.astype(jnp.float32), n_rep)
-        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+        return (dq, k_cur, v_cur, m_cur, dk_cur, dv_cur), None
 
     if axis_size > 1:
-        (dq, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            step, (dq, k, v, dk_cur, dv_cur), jnp.arange(1, axis_size)
+        (dq, _, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
+            step, (dq, k, v, mask, dk_cur, dv_cur), jnp.arange(1, axis_size)
         )
         # the accumulators have rotated axis_size-1 times; one more rotation
         # brings each chunk's dK/dV home to its owner
@@ -219,7 +244,51 @@ def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
     return dq.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
+    return _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep,
+                           interpret)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis_name, axis_size, causal, n_rep, interpret):
+    out, (q, k, v, o, lse, _) = _ring_flash_fwd_impl(
+        q, k, v, None, axis_name, axis_size, causal, n_rep, interpret)
+    return out, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd(axis_name, axis_size, causal, n_rep, interpret, res, g):
+    q, k, v, o, lse = res
+    return _ring_flash_bwd_impl(axis_name, axis_size, causal, n_rep,
+                                interpret, (q, k, v, o, lse, None), g)
+
+
 _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _ring_flash_masked(q, k, v, mask, axis_name, axis_size, causal, n_rep,
+                       interpret):
+    """Masked ring: mask is nondifferentiable data threaded as an operand
+    (zero cotangent), its chunk riding the ring with K/V."""
+    return _ring_flash_masked_fwd(q, k, v, mask, axis_name, axis_size,
+                                  causal, n_rep, interpret)[0]
+
+
+def _ring_flash_masked_fwd(q, k, v, mask, axis_name, axis_size, causal,
+                           n_rep, interpret):
+    return _ring_flash_fwd_impl(q, k, v, mask, axis_name, axis_size, causal,
+                                n_rep, interpret)
+
+
+def _ring_flash_masked_bwd(axis_name, axis_size, causal, n_rep, interpret,
+                           res, g):
+    mask = res[5]
+    dq, dk, dv = _ring_flash_bwd_impl(axis_name, axis_size, causal, n_rep,
+                                      interpret, res, g)
+    return dq, dk, dv, jnp.zeros_like(mask)
+
+
+_ring_flash_masked.defvjp(_ring_flash_masked_fwd, _ring_flash_masked_bwd)
 
 
 def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
@@ -230,13 +299,20 @@ def _ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
                        interpret)
 
 
+def _ring_attention_local_masked(q, k, v, mask, *, axis_name: str,
+                                 axis_size: int, causal: bool, n_rep: int,
+                                 interpret: bool):
+    return _ring_flash_masked(q, k, v, mask, axis_name, axis_size, causal,
+                              n_rep, interpret)
+
+
 # ---------------------------------------------------------------------------
 # einsum fallback ring (tiny chunks / no kernel)
 # ---------------------------------------------------------------------------
 
 
-def _ring_attention_local_einsum(q, k, v, *, axis_name: str, axis_size: int,
-                                 causal: bool, n_rep: int):
+def _ring_attention_local_einsum(q, k, v, mask=None, *, axis_name: str,
+                                 axis_size: int, causal: bool, n_rep: int):
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -247,7 +323,7 @@ def _ring_attention_local_einsum(q, k, v, *, axis_name: str, axis_size: int,
     row_max = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
     row_sum = jnp.zeros((b, h, s_local), jnp.float32)
 
-    def fold_chunk(acc, row_max, row_sum, k_cur, v_cur, src):
+    def fold_chunk(acc, row_max, row_sum, k_cur, v_cur, m_cur, src):
         kf = _repeat_heads(k_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
         vf = _repeat_heads(v_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
@@ -259,28 +335,39 @@ def _ring_attention_local_einsum(q, k, v, *, axis_name: str, axis_size: int,
                 jnp.int32, (s_local, s_local), 1
             )
             s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        if m_cur is not None:
+            s = jnp.where((m_cur > 0)[:, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(row_max, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(row_max - m_new)
+        # a row with nothing visible yet keeps m_new = NEG_INF; exp(s - m)
+        # would be exp(0) = 1 per masked key — clamp the subtrahend
+        safe_m = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(jnp.maximum(row_max, NEG_INF / 2) - safe_m)
         row_sum_new = row_sum * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
         return acc_new, m_new, row_sum_new
 
     # local chunk first, then axis_size-1 rotations (no wasted final permute)
-    acc, row_max, row_sum = fold_chunk(acc, row_max, row_sum, k, v, my_idx)
+    acc, row_max, row_sum = fold_chunk(acc, row_max, row_sum, k, v, mask,
+                                       my_idx)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def block(carry, step):
-        acc, row_max, row_sum, k_cur, v_cur = carry
+        acc, row_max, row_sum, k_cur, v_cur, m_cur = carry
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        if m_cur is not None:
+            m_cur = jax.lax.ppermute(m_cur, axis_name, perm)
         src = (my_idx - step) % axis_size  # owner of the chunk we now hold
-        acc, row_max, row_sum = fold_chunk(acc, row_max, row_sum, k_cur, v_cur, src)
-        return (acc, row_max, row_sum, k_cur, v_cur), None
+        acc, row_max, row_sum = fold_chunk(acc, row_max, row_sum, k_cur,
+                                           v_cur, m_cur, src)
+        return (acc, row_max, row_sum, k_cur, v_cur, m_cur), None
 
     if axis_size > 1:
-        (acc, row_max, row_sum, _, _), _ = jax.lax.scan(
-            block, (acc, row_max, row_sum, k, v), jnp.arange(1, axis_size)
+        (acc, row_max, row_sum, _, _, _), _ = jax.lax.scan(
+            block, (acc, row_max, row_sum, k, v, mask),
+            jnp.arange(1, axis_size)
         )
     out = acc / jnp.maximum(row_sum, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B, S_local, H, D]
@@ -291,6 +378,7 @@ def ring_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
+    mask: jax.Array | None = None,
     mesh=None,
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
@@ -300,6 +388,11 @@ def ring_attention(
     over the provided (or ambient) mesh. Falls back to plain attention when
     the mesh has no seq axis. K/V may carry fewer heads (GQA) — they ring
     un-repeated and the repeat happens per chunk at the kernel boundary.
+
+    `mask` is a [B, S] key-padding mask (1 = attend): it shards over the
+    same `seq` axis and each chunk rotates the ring with its K/V, so padded
+    fine-tuning batches keep the ring fast path (the kernel applies it in
+    forward AND backward).
     """
     if mesh is None:
         from ..state import PartialState
@@ -319,7 +412,12 @@ def ring_attention(
 
         return dot_product_attention(q, _repeat_heads(k, q.shape[2] // k.shape[2]),
                                      _repeat_heads(v, q.shape[2] // v.shape[2]),
-                                     causal=causal)
+                                     mask=mask, causal=causal)
+    if mask is not None and mask.shape != (q.shape[0], k.shape[1]):
+        raise ValueError(
+            f"ring_attention mask must be a [B, S_k] key-padding mask; got "
+            f"{mask.shape} for B={q.shape[0]}, S_k={k.shape[1]}"
+        )
 
     axis_size = mesh.shape[axis_name]
     n_rep = q.shape[2] // k.shape[2]
@@ -329,7 +427,20 @@ def ring_attention(
     use_kernel = blk >= 16 and s_local % blk == 0
 
     seq_spec = P(None, axis_name, None, None)
+    mask_spec = P(None, axis_name)
     if use_kernel:
+        if mask is not None:
+            fn = partial(
+                _ring_attention_local_masked, axis_name=axis_name,
+                axis_size=axis_size, causal=causal, n_rep=n_rep,
+                interpret=interpret,
+            )
+            return jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
+                out_specs=seq_spec,
+                check_vma=False,
+            )(q, k, v, mask)
         fn = partial(
             _ring_attention_local, axis_name=axis_name, axis_size=axis_size,
             causal=causal, n_rep=n_rep, interpret=interpret,
@@ -339,6 +450,13 @@ def ring_attention(
             _ring_attention_local_einsum, axis_name=axis_name,
             axis_size=axis_size, causal=causal, n_rep=n_rep,
         )
+        if mask is not None:
+            return jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
+                out_specs=seq_spec,
+                check_vma=False,
+            )(q, k, v, mask)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),

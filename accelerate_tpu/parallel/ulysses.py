@@ -24,12 +24,17 @@ from jax.sharding import PartitionSpec as P
 from ..utils.constants import AXIS_SEQ
 
 
-def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
-    """Runs INSIDE shard_map. q,k,v: [B, S_local, H, D] — this device's
-    sequence chunk with ALL heads. all_to_all trades the head dim for the
-    sequence dim so attention sees the full sequence. The local full-
-    sequence attention runs the pallas flash kernel (which itself falls
-    back to einsum for shapes under one block)."""
+def _ulysses_local(q, k, v, mask=None, *, axis_name: str, causal: bool,
+                   n_rep: int):
+    """Runs INSIDE shard_map. q: [B, S_local, H, D], k/v: [B, S_local,
+    Hkv, D] — this device's sequence chunk. all_to_all trades the head dim
+    for the sequence dim so attention sees the full sequence; GQA K/V
+    scatter with their Hkv heads and repeat AFTER the collective, so the
+    wire carries 1/n_rep of the repeated volume (same economy as the ring's
+    un-repeated chunks). The local full-sequence attention runs the pallas
+    flash kernel (which itself falls back to einsum for shapes under one
+    block) with the all-gathered [B, S] key-padding mask."""
+    from ..models.common import repeat_kv
     from ..ops.flash_attention import flash_attention
 
     # [B, S/P, H, D] -> [B, S, H/P, D]: split heads (axis 2) across the axis,
@@ -45,9 +50,13 @@ def _ulysses_local(q, k, v, *, axis_name: str, causal: bool):
         )
 
     q_full = scatter_heads(q)
-    k_full = scatter_heads(k)
-    v_full = scatter_heads(v)
-    out = flash_attention(q_full, k_full, v_full, causal=causal)
+    k_full = repeat_kv(scatter_heads(k), n_rep)
+    v_full = repeat_kv(scatter_heads(v), n_rep)
+    if mask is not None:
+        # the [B, S/P] mask chunk is tiny next to K/V: one all_gather
+        # rebuilds the full [B, S] key mask every device needs
+        mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = flash_attention(q_full, k_full, v_full, causal=causal, mask=mask)
     return gather_heads(out)
 
 
@@ -56,27 +65,32 @@ def ulysses_attention(
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
+    mask: jax.Array | None = None,
     mesh=None,
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over the mesh `seq` axis via
-    head-scatter all-to-all. K/V may carry fewer (GQA) heads — they repeat
-    to the full head count here, matching `ring_attention`'s accepted
-    inputs (the ring keeps them un-repeated on the wire; ulysses scatters
-    full heads). Falls back to plain attention when no seq axis exists or
-    shapes don't divide."""
-    if k.shape[2] != q.shape[2]:
-        from ..models.common import repeat_kv
-
-        rep = q.shape[2] // k.shape[2]
-        k = repeat_kv(k, rep)
-        v = repeat_kv(v, rep)
+    head-scatter all-to-all. K/V may carry fewer (GQA) heads — when the kv
+    head count divides the axis they scatter un-repeated (n_rep× less ICI
+    traffic) and repeat locally after the collective; otherwise they repeat
+    up-front to keep the all_to_all legal. `mask` is a [B, S] key-padding
+    mask (1 = attend), sharded over the seq axis and all-gathered inside.
+    Falls back to plain attention when no seq axis exists or shapes don't
+    divide."""
     if mesh is None:
         from ..state import PartialState
 
         if PartialState._shared_state:
             mesh = PartialState().mesh
     axis_size = mesh.shape.get(axis_name, 1) if mesh is not None else 1
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1 and axis_size > 1 and k.shape[2] % axis_size != 0:
+        # kv heads don't divide the axis: repeat first (legal, just heavier)
+        from ..models.common import repeat_kv
+
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        n_rep = 1
     if (
         mesh is None
         or axis_size == 1
@@ -85,12 +99,27 @@ def ulysses_attention(
         or q.shape[2] % axis_size != 0
         or k.shape[2] % axis_size != 0
     ):
-        from ..models.common import dot_product_attention
+        from ..models.common import dot_product_attention, repeat_kv
 
-        return dot_product_attention(q, k, v, causal=causal)
+        return dot_product_attention(q, repeat_kv(k, n_rep),
+                                     repeat_kv(v, n_rep), mask=mask,
+                                     causal=causal)
+    if mask is not None and mask.shape != (q.shape[0], k.shape[1]):
+        raise ValueError(
+            f"ulysses_attention mask must be a [B, S_k] key-padding mask; "
+            f"got {mask.shape} for B={q.shape[0]}, S_k={k.shape[1]}"
+        )
 
     seq_spec = P(None, axis_name, None, None)
-    fn = partial(_ulysses_local, axis_name=axis_name, causal=causal)
+    fn = partial(_ulysses_local, axis_name=axis_name, causal=causal,
+                 n_rep=n_rep)
+    if mask is not None:
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec, P(None, axis_name)),
+            out_specs=seq_spec,
+            check_vma=False,
+        )(q, k, v, mask)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),

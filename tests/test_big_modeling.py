@@ -340,13 +340,20 @@ def test_decode_mask_must_span_cache():
     cfg = opt.OPTConfig.tiny()
     params = opt.init_params(cfg, jax.random.key(46))
     ids = jnp.ones((1, 4), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(4), (1, 4))
     caches = opt.init_kv_caches(cfg, 1, 8)
     with pytest.raises(ValueError, match="span the whole cache"):
         opt.forward(cfg, params, ids,
                     attention_mask=jnp.ones((1, 4), jnp.int32),
+                    positions=positions, kv_caches=caches)
+    # masked cached prefill without explicit positions: loud error (OPT
+    # derives positions from the mask only on the uncached path)
+    with pytest.raises(ValueError, match="explicit `positions`"):
+        opt.forward(cfg, params, ids,
+                    attention_mask=jnp.ones((1, 8), jnp.int32),
                     kv_caches=caches)
-    # a full-cache mask works
+    # a full-cache mask with explicit positions works
     full = jnp.ones((1, 8), jnp.int32)
     logits, _ = opt.forward(cfg, params, ids, attention_mask=full,
-                            kv_caches=caches)
+                            positions=positions, kv_caches=caches)
     assert logits.shape == (1, 4, cfg.vocab_size)

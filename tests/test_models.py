@@ -384,3 +384,15 @@ def test_t5_generate_shapes_and_determinism():
     assert out1.shape == (2, 5)  # start token + 4 generated
     assert np.asarray(out1[:, 0]).tolist() == [0, 0]
     np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+@pytest.mark.parametrize("name", ["gpt2", "gptj", "gpt_neox", "opt"])
+def test_zoo_bf16_generate(name):
+    """bf16 checkpoints (the big-model benchmark dtype) must flow through
+    forward + decode without dtype drift breaking the layer-scan carry
+    (regression: GPT-J's interleaved rope upcast bf16 residuals to f32)."""
+    mod, cfg = _zoo_member(name)
+    params = mod.init_params(cfg, jax.random.key(7), dtype=jnp.bfloat16)
+    ids = jnp.ones((1, 8), jnp.int32)
+    out = mod.generate(cfg, params, ids, max_new_tokens=3)
+    assert out.shape == (1, 11)
