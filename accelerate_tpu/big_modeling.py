@@ -405,10 +405,10 @@ def streamed_generate(
                           embed_fn(resident, ids, positions))
         return project_fn(resident, x), new_len[0]
 
+    from .models.decode import sample_token
+
     def select(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits[:, -1], axis=-1)
-        return jax.random.categorical(k, logits[:, -1] / temperature)
+        return sample_token(logits, k, temperature)
 
     positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
     ids = jnp.asarray(input_ids)

@@ -109,16 +109,23 @@ def dot_product_attention(
     v: jax.Array,
     mask: jax.Array | None = None,
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
-    """[B, S, H, D] attention with fp32 softmax (MXU-friendly einsum form)."""
+    """[B, S, H, D] attention with fp32 softmax (MXU-friendly einsum form).
+    `window` limits causal reach to q - key < window (HF sliding-window
+    convention)."""
     depth = q.shape[-1]
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) / math.sqrt(depth)
-    if causal:
+    if causal or window is not None:
         s_q, s_k = q.shape[1], k.shape[1]
-        causal_mask = jnp.tril(jnp.ones((s_q, s_k), jnp.bool_), k=s_k - s_q)
-        scores = jnp.where(causal_mask[None, None], scores, -1e30)
+        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)  # bottom-aligned
+        k_pos = jnp.arange(s_k)[None, :]
+        keep = q_pos >= k_pos if causal else jnp.ones((s_q, s_k), jnp.bool_)
+        if window is not None:
+            keep = keep & (q_pos - k_pos < window)
+        scores = jnp.where(keep[None, None], scores, -1e30)
     if mask is not None:
         # mask: [B, S_k] padding, [B, S_q, S_k], or [B, H|1, S_q, S_k]
         if mask.ndim == 2:

@@ -69,6 +69,18 @@ def cached_attention_mask(k_len: int, positions, mask=None):
     return kv_mask if mask is None else mask[:, None, :] & kv_mask
 
 
+def windowed_cached_attention_mask(k_len: int, positions, mask=None,
+                                   window: int | None = None):
+    """`cached_attention_mask` with a sliding window: cached keys older than
+    `window` positions (q - key >= window, HF Mistral convention) drop out,
+    so single-token decode steps past the window match the full forward."""
+    kv_mask = cached_attention_mask(k_len, positions, mask)
+    if window is None:
+        return kv_mask
+    in_band = jnp.arange(k_len)[None, None, :] > positions[:, :, None] - window
+    return kv_mask & in_band
+
+
 def sample_token(logits, key, temperature: float):
     """Next token from the last position's logits: argmax at temperature 0,
     else temperature-scaled categorical. The ONE sampling rule shared by the

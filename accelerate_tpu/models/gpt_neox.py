@@ -177,9 +177,14 @@ def forward(
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
+    # size tables by cache reach too: generate past max_position_embeddings
+    # must extend rotary angles, not gather-clamp to the last table row
+    max_len = (
+        max(config.max_position_embeddings, kv_caches[0].shape[2])
+        if kv_caches is not None else config.max_position_embeddings
+    )
     cos, sin = rope_frequencies(
-        config.rotary_ndims, config.max_position_embeddings,
-        config.rotary_emb_base,
+        config.rotary_ndims, max_len, config.rotary_emb_base,
     )
 
     if kv_caches is not None:

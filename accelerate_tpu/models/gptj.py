@@ -172,9 +172,13 @@ def forward(
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
-    sin, cos = _interleaved_rope_tables(
-        config.rotary_dim, config.max_position_embeddings
+    # size tables by cache reach too: generate past max_position_embeddings
+    # must extend rotary angles, not gather-clamp to the last table row
+    max_len = (
+        max(config.max_position_embeddings, kv_caches[0].shape[2])
+        if kv_caches is not None else config.max_position_embeddings
     )
+    sin, cos = _interleaved_rope_tables(config.rotary_dim, max_len)
 
     if kv_caches is not None:
         ck, cv, cache_len = kv_caches

@@ -95,11 +95,9 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
 
 
 def mistral_config_from_hf(hf_config) -> LlamaConfig:
-    """Mistral is llama-shaped; sliding-window attention is NOT applied, so
-    imports are exact for sequences up to `sliding_window` (4096 on the
-    published checkpoints — transformers itself only masks beyond it). The
-    window is recorded on the config and the forward refuses longer
-    sequences rather than silently attending globally."""
+    """Mistral is llama-shaped; `sliding_window` imports onto the config and
+    the forward applies it as a band mask (flash kernel block-skip / einsum
+    band / windowed decode mask), matching transformers at any length."""
     return llama_config_from_hf(hf_config)
 
 

@@ -30,9 +30,9 @@ import jax.numpy as jnp
 from .decode import (
     build_generate,
     build_streamed_generate,
-    cached_attention_mask,
     extend_cache,
     make_kv_caches,
+    windowed_cached_attention_mask,
 )
 from .common import (
     apply_rope,
@@ -67,9 +67,9 @@ class LlamaConfig:
     # whichever biases the param tree holds, so an HF-llama checkpoint with
     # an o_proj bias still imports and runs exactly
     attention_bias: bool = False
-    # Mistral-style sliding-window attention is NOT implemented; when set,
-    # the forward refuses sequences longer than the window instead of
-    # silently attending globally where HF would mask
+    # Mistral/Qwen2-style sliding-window attention: keys visible iff
+    # q - key < window (applied as a band mask in the flash kernel with
+    # out-of-band block skip, in the einsum path, and in the decode mask)
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
     attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
@@ -182,7 +182,8 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     new_cache = None
     if kv_cache is not None:
         k, v, new_cache = extend_cache(kv_cache, k, v)
-        mask = cached_attention_mask(k.shape[1], positions, mask)
+        mask = windowed_cached_attention_mask(k.shape[1], positions, mask,
+                                              config.sliding_window)
         causal = False
     else:
         causal = True
@@ -196,6 +197,13 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         backend = (
             "flash" if on_tpu and kv_cache is None and s >= 1024
             else "einsum"
+        )
+    window = config.sliding_window
+    if window is not None and backend in ("ring", "ulysses") and kv_cache is None:
+        raise NotImplementedError(
+            f"attention_backend={backend!r} does not implement "
+            "sliding-window attention; use 'auto', 'flash', or 'einsum' for "
+            "sliding-window checkpoints (Mistral/Qwen2)"
         )
     # flash, ring, and ulysses all take [B, S] key-padding masks natively
     # (ring rotates mask chunks with K/V; ulysses all-gathers the mask), so
@@ -221,9 +229,12 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         ):
             from ..ops.flash_attention import flash_attention
 
-            out = flash_attention(q, k, v, causal=True, mask=mask)
+            out = flash_attention(q, k, v, causal=True, mask=mask,
+                                  window=window)
         else:
-            out = dot_product_attention(q, k, v, mask=mask, causal=causal)
+            out = dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                        window=window if kv_cache is None
+                                        else None)
     out = out.reshape(b, s, nh * hd)
     o, mo = _dense_maybe_fp8(out, layer["attn"]["o_proj"]["kernel"],
                              fa.get("o_proj"))
@@ -294,21 +305,6 @@ def forward(
     if fp8_state is not None and kv_caches is not None:
         raise ValueError("fp8 is a training-path feature; decode "
                          "(kv_caches) runs bf16")
-    if config.sliding_window is not None:
-        # the attention window must also cover decode: a kv cache longer
-        # than the window would let single-token steps attend globally past
-        # it, silently diverging from the reference model
-        reach = (
-            kv_caches[0].shape[2] if kv_caches is not None
-            else input_ids.shape[1]
-        )
-        if reach > config.sliding_window:
-            raise NotImplementedError(
-                f"attention reach {reach} exceeds this checkpoint's "
-                f"sliding_window={config.sliding_window}; sliding-window "
-                "attention is not implemented, and attending globally would "
-                "silently diverge from the reference model"
-            )
     x = params["embed_tokens"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(

@@ -57,10 +57,34 @@ def _apply_key_mask(mask_ref, s):
     return jnp.where(mask_ref[0][:1, :] > 0, s, NEG_INF)
 
 
+def _band_live(qi, ki, block_q, block_k, causal, window):
+    """Whether k block `ki` can contribute to q block `qi`: under causality
+    its first key must be visible to the block's last query; under a sliding
+    window its last key must be inside the reach of the block's first query
+    (key > q - window)."""
+    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+    if window is not None:
+        live = live & (ki * block_k + block_k - 1 > qi * block_q - window)
+    return live
+
+
+def _band_mask(s, qi, ki, block_q, block_k, causal, window):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = q_pos >= k_pos if causal else jnp.bool_(True)
+    if window is not None:
+        # HF sliding-window convention: key visible iff q - key < window
+        # (reach of `window` positions INCLUDING the query itself)
+        keep = keep & (q_pos - k_pos < window)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
                   sm_scale: float, block_q: int, block_k: int,
                   num_k_blocks: int, with_lse: bool = False,
-                  with_mask: bool = False):
+                  with_mask: bool = False, window: int | None = None):
     if with_mask:
         mask_ref, o_ref, *rest = rest
     else:
@@ -77,9 +101,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: this k block contributes iff its first position is visible to
-    # the last q row of the block
-    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+    live = _band_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -89,21 +111,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
         k = k_ref[0]  # [bk, d]
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, qi, ki, block_q, block_k, causal, window)
         if mask_ref is not None:
             s = _apply_key_mask(mask_ref, s)
         m_prev = m_scr[...][:, :1]  # [bq, 1]
         l_prev = l_scr[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if mask_ref is not None:
-            # a fully-masked row keeps m_new at NEG_INF, where exp(s - m_new)
-            # would be exp(0)=1 per masked key — zero those explicitly
+        if mask_ref is not None or window is not None:
+            # a row with nothing visible in any block so far keeps m_new at
+            # NEG_INF, where exp(s - m_new) would be exp(0)=1 per masked key
+            # (a windowed live block can have rows entirely out of band) —
+            # zero those explicitly
             p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -128,7 +148,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, causal: bool,
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                    interpret: bool, save_residuals: bool = False, mask=None,
-                   heads: int = 1):
+                   heads: int = 1, window: int | None = None):
     """q,k,v: [BH, S, D] -> [BH, S, D] (and LSE [BH, S, 8] if asked).
     mask: optional [B, SUB, S_k] key-padding mask (1 = attend), sublane-
     broadcast like the LSE residual and shared across `heads` heads via the
@@ -141,7 +161,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     kernel = functools.partial(
         _flash_kernel, causal=causal, sm_scale=sm_scale,
         block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
-        with_lse=save_residuals, with_mask=mask is not None,
+        with_lse=save_residuals, with_mask=mask is not None, window=window,
     )
     out_shape = [jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype)]
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
@@ -181,7 +201,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
 def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
                      causal: bool, sm_scale: float, block_q: int,
                      block_k: int, num_k_blocks: int,
-                     with_mask: bool = False):
+                     with_mask: bool = False, window: int | None = None):
     """FlashAttention-2 backward, dQ pass: grid [BH, q_blocks, k_blocks]."""
     if with_mask:
         mask_ref, dq_ref, dq_scr = rest
@@ -193,7 +213,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+    live = _band_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -209,12 +229,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
         delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                         axis=-1, keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, qi, ki, block_q, block_k, causal, window)
         if mask_ref is not None:
             s = _apply_key_mask(mask_ref, s)
         p = jnp.exp(s - lse)                                   # [bq, bk]
@@ -231,7 +247,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
                       causal: bool, sm_scale: float, block_q: int,
                       block_k: int, num_q_blocks: int,
-                      with_mask: bool = False):
+                      with_mask: bool = False, window: int | None = None):
     """FlashAttention-2 backward, dK/dV pass: grid [BH, k_blocks, q_blocks]."""
     if with_mask:
         mask_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
@@ -244,7 +260,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    live = (qi + 1) * block_q - 1 >= ki * block_k if causal else True
+    live = _band_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(live)
     def _compute():
@@ -257,12 +273,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
         delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
                         axis=-1, keepdims=True)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if causal or window is not None:
+            s = _band_mask(s, qi, ki, block_q, block_k, causal, window)
         if mask_ref is not None:
             s = _apply_key_mask(mask_ref, s)
         p = jnp.exp(s - lse)                                   # [bq, bk]
@@ -286,7 +298,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
 
 def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
                     block_k: int, interpret: bool, mask=None,
-                    heads: int = 1):
+                    heads: int = 1, window: int | None = None):
     """Fused O(S) backward: no S x S materialization.
 
     Per-row state stays near-compact: the saved residual is [BH, S] f32,
@@ -314,7 +326,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
         functools.partial(
             _flash_dq_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, num_k_blocks=num_k_blocks,
-            with_mask=mask is not None,
+            with_mask=mask is not None, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
         grid=(bh, num_q_blocks, num_k_blocks),
@@ -340,7 +352,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
         functools.partial(
             _flash_dkv_kernel, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, num_q_blocks=num_q_blocks,
-            with_mask=mask is not None,
+            with_mask=mask is not None, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
@@ -361,48 +373,53 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, window):
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                          window=window)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret, window):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                            save_residuals=True)
+                            save_residuals=True, window=window)
     # keep only one lane of the broadcast LSE as the saved residual
     # ([BH, S] f32, not [BH, S, 128]) — re-broadcast transiently in bwd
     return o, (q, k, v, o, lse[..., 0])
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, block_q, block_k, interpret, window, res, g):
     q, k, v, o, lse = res
     return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
-                           interpret)
+                           interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_masked(q, k, v, mask, causal, block_q, block_k, interpret, heads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_masked(q, k, v, mask, causal, block_q, block_k, interpret, heads,
+                  window):
     """Masked variant: mask is [B, SUB, S_k] (1 = attend), nondifferentiable
     data threaded as a regular operand (its cotangent is zeros) and shared
     across heads by the kernels' index maps."""
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                          mask=mask, heads=heads)
+                          mask=mask, heads=heads, window=window)
 
 
 def _flash_masked_fwd(q, k, v, mask, causal, block_q, block_k, interpret,
-                      heads):
+                      heads, window):
     o, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
-                            save_residuals=True, mask=mask, heads=heads)
+                            save_residuals=True, mask=mask, heads=heads,
+                            window=window)
     return o, (q, k, v, o, lse[..., 0], mask)
 
 
-def _flash_masked_bwd(causal, block_q, block_k, interpret, heads, res, g):
+def _flash_masked_bwd(causal, block_q, block_k, interpret, heads, window,
+                      res, g):
     q, k, v, o, lse, mask = res
     dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal, block_q,
-                                 block_k, interpret, mask=mask, heads=heads)
+                                 block_k, interpret, mask=mask, heads=heads,
+                                 window=window)
     return dq, dk, dv, jnp.zeros_like(mask)
 
 
@@ -415,6 +432,7 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     mask: jax.Array | None = None,
+    window: int | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -434,10 +452,24 @@ def flash_attention(
     forward and backward; fully-masked rows produce zero output. Full
     per-position [B, ..., S_q, S_k] masks fall back to einsum attention.
 
+    `window` is a sliding-attention window in the HF Mistral convention —
+    key visible iff q - key < window (reach includes the query) — applied as
+    a band mask inside the kernels; blocks wholly outside the band are
+    skipped entirely, so long-context windowed attention costs
+    O(S * window), not O(S^2). Requires causal=True.
+
     Default blocks come from the v5e sweep (benchmarks/sweep_attn.py):
     big blocks amortize pallas grid overhead — 512x1024 wins to ~2k context,
     1024x1024 from 4k up (96.7 TF/s vs einsum's 18.2 at s=4096)."""
     b, sq, h, d = q.shape
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal-LM feature)")
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        if window >= k.shape[1]:
+            window = None  # band wider than the sequence: plain causal
     sk = k.shape[1]
     key_mask = None
     if mask is not None:
@@ -449,7 +481,8 @@ def flash_attention(
         else:
             from ..models.common import dot_product_attention
 
-            return dot_product_attention(q, k, v, mask=mask, causal=causal)
+            return dot_product_attention(q, k, v, mask=mask, causal=causal,
+                                         window=window)
     if block_q is None:
         block_q = 1024 if sq >= 4096 else 512
     if block_k is None:
@@ -467,7 +500,8 @@ def flash_attention(
     def _fallback():
         from ..models.common import dot_product_attention
 
-        return dot_product_attention(q, k, v, mask=key_mask, causal=causal)
+        return dot_product_attention(q, k, v, mask=key_mask, causal=causal,
+                                     window=window)
 
     # sq != sk would make the kernel's top-aligned causal mask disagree with
     # the bottom-aligned reference (and read past the k buffer when sq > sk)
@@ -499,8 +533,8 @@ def flash_attention(
                     if key_mask is not None else None
                 )
                 out = flash_attention(qp, kp, vp, causal=True, mask=mp,
-                                      block_q=block_q, block_k=block_k,
-                                      interpret=interpret)
+                                      window=window, block_q=block_q,
+                                      block_k=block_k, interpret=interpret)
                 return out[:, :sq]
         else:
             # non-causal can't pad (extra keys would get real softmax
@@ -522,7 +556,7 @@ def flash_attention(
             key_mask.astype(jnp.float32)[:, None, :], (b, _SUB, sk)
         )
         out = _flash_masked(qf, kf, vf, mf, causal, block_q, block_k,
-                            interpret, h)
+                            interpret, h, window)
     else:
-        out = _flash(qf, kf, vf, causal, block_q, block_k, interpret)
+        out = _flash(qf, kf, vf, causal, block_q, block_k, interpret, window)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

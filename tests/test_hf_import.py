@@ -324,22 +324,28 @@ def test_mixtral_rope_scaling_importable():
     assert not np.allclose(np.asarray(out), np.asarray(out0), atol=1e-4)
 
 
-def test_mistral_sliding_window_refused_beyond_window():
+def test_mistral_sliding_window_parity_beyond_window():
+    """Sequences LONGER than sliding_window must reproduce HF logits — the
+    band mask (not global attention) is what the checkpoint was trained
+    with. Round-2 refused these; the window is now applied."""
     from accelerate_tpu.models import hf_import, llama
 
-    cfg = hf_import.config_from_hf("mistral", {
-        "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
-        "num_hidden_layers": 1, "num_attention_heads": 2,
-        "num_key_value_heads": 2, "max_position_embeddings": 128,
-        "sliding_window": 16,
-    })
-    import jax
-
-    params = llama.init_params(cfg, jax.random.key(0))
-    ids = np.zeros((1, 8), np.int32)
-    llama.forward(cfg, params, ids)  # within window: fine
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        llama.forward(cfg, params, np.zeros((1, 32), np.int32))
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=8,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(50)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("mistral", hf_cfg)
+    assert cfg.sliding_window == 8
+    params = hf_import.params_from_hf("mistral", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(51).integers(0, 96, (2, 33)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    got = np.asarray(llama.forward(cfg, params, ids))
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
 
 
 def test_explicit_decoupled_head_dim_refused():
@@ -365,22 +371,66 @@ def test_qwen2_unused_sliding_window_not_recorded():
     assert cfg.sliding_window is None
 
 
-def test_sliding_window_guard_covers_decode():
+def test_sliding_window_decode_matches_forward():
+    """KV-cache decode past the window must drop out-of-band cached keys,
+    matching the full windowed forward position by position."""
     import jax
+    import jax.numpy as jnp
 
     from accelerate_tpu.models import hf_import, llama
 
     cfg = hf_import.config_from_hf("mistral", {
         "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
-        "num_hidden_layers": 1, "num_attention_heads": 2,
+        "num_hidden_layers": 2, "num_attention_heads": 2,
         "num_key_value_heads": 2, "max_position_embeddings": 128,
-        "sliding_window": 16,
+        "sliding_window": 6,
     })
     params = llama.init_params(cfg, jax.random.key(0))
-    caches = llama.init_kv_caches(cfg, 1, 32)  # cache reach 32 > window 16
-    with pytest.raises(NotImplementedError, match="sliding_window"):
-        llama.forward(cfg, params, np.zeros((1, 8), np.int32),
-                      kv_caches=caches)
+    ids = np.random.default_rng(52).integers(0, 64, (2, 20)).astype(np.int32)
+    full = llama.forward(cfg, params, ids)
+    caches = llama.init_kv_caches(cfg, 2, 24, dtype=jnp.float32)
+    prefix, caches = llama.forward(cfg, params, ids[:, :5], kv_caches=caches)
+    np.testing.assert_allclose(np.asarray(prefix), np.asarray(full[:, :5]),
+                               atol=2e-2)
+    outs = []
+    for t in range(5, 20):  # decode well past window=6
+        lg, caches = llama.forward(
+            cfg, params, ids[:, t : t + 1],
+            positions=jnp.full((2, 1), t), kv_caches=caches,
+        )
+        outs.append(lg)
+    decoded = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(decoded), np.asarray(full[:, 5:]),
+                               atol=2e-2)
+
+
+def test_mistral_generate_parity_beyond_window():
+    from accelerate_tpu.models import hf_import, llama
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, sliding_window=8,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(53)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = hf_import.config_from_hf("mistral", hf_cfg)
+    params = hf_import.params_from_hf("mistral", cfg, hf_model.state_dict())
+    ids = np.random.default_rng(54).integers(0, 96, (2, 12)).astype(np.int32)
+    got = np.asarray(llama.generate(cfg, params, ids, max_new_tokens=10))
+    _assert_greedy_match(hf_model, ids, 10, got, prompt_len=12)
+
+
+def test_ring_backend_refuses_sliding_window():
+    import jax
+
+    from accelerate_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(sliding_window=8, attention_backend="ring")
+    params = llama.init_params(cfg, jax.random.key(0))
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        llama.forward(cfg, params, np.zeros((1, 16), np.int32))
 
 
 def test_gptj_logit_parity():

@@ -396,3 +396,32 @@ def test_zoo_bf16_generate(name):
     ids = jnp.ones((1, 8), jnp.int32)
     out = mod.generate(cfg, params, ids, max_new_tokens=3)
     assert out.shape == (1, 11)
+
+
+@pytest.mark.parametrize("name", ["gptj", "gpt_neox"])
+def test_zoo_decode_past_max_position_embeddings(name):
+    """Rotary tables must extend to the cache reach: decoding past
+    max_position_embeddings would otherwise gather-clamp every overflow
+    position to the last table row (silently wrong logits), and diverge
+    from streamed_generate which already sized by cache reach."""
+    mod, cfg = _zoo_member(name)
+    cfg = dataclasses.replace(cfg, max_position_embeddings=16)
+    params = mod.init_params(cfg, jax.random.key(9))
+    ids = jax.random.randint(jax.random.key(10), (1, 12), 0, cfg.vocab_size)
+    # decode to position 19 (> 16): compare one-token steps vs a reference
+    # forward whose config admits the longer table
+    long_cfg = dataclasses.replace(cfg, max_position_embeddings=24)
+    full = mod.forward(long_cfg, params, jnp.concatenate(
+        [ids, ids[:, :8]], axis=1))
+    caches = mod.init_kv_caches(cfg, 1, 20, dtype=jnp.float32)
+    _, caches = mod.forward(cfg, params, ids, kv_caches=caches)
+    outs = []
+    seq = jnp.concatenate([ids, ids[:, :8]], axis=1)
+    for t in range(12, 20):
+        lg, caches = mod.forward(cfg, params, seq[:, t : t + 1],
+                                 positions=jnp.full((1, 1), t),
+                                 kv_caches=caches)
+        outs.append(lg)
+    decoded = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(decoded),
+                               np.asarray(full[:, 12:20]), atol=2e-2)

@@ -755,3 +755,59 @@ def test_llama_padded_batch_keeps_ring_backend(monkeypatch):
     np.testing.assert_allclose(np.asarray(out_ring)[real],
                                np.asarray(out_ein)[real], atol=3e-2)
     PartialState._reset_state()
+
+
+# --- sliding-window flash attention ------------------------------------------
+
+
+def test_flash_window_matches_reference():
+    """Band mask in the kernel must equal the einsum windowed attention,
+    across window widths incl. ones splitting blocks."""
+    q, k, v = make_qkv(jax.random.key(40), b=2, s=128, h=2, d=32)
+    for w in (8, 33, 100):
+        ref = dot_product_attention(q, k, v, causal=True, window=w)
+        out = flash_attention(q, k, v, causal=True, window=w,
+                              block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, err_msg=f"window={w}")
+
+
+def test_flash_window_with_padding_mask():
+    q, k, v = make_qkv(jax.random.key(41), b=2, s=64, h=2, d=32)
+    mask = _pad_mask(2, 64, [40, 64])
+    ref = dot_product_attention(q, k, v, mask=mask, causal=True, window=10)
+    out = flash_attention(q, k, v, causal=True, mask=mask, window=10,
+                          block_q=16, block_k=16)
+    real = np.asarray(mask, bool)
+    np.testing.assert_allclose(np.asarray(out)[real], np.asarray(ref)[real],
+                               atol=2e-3)
+
+
+def test_flash_window_gradients_match():
+    q, k, v = make_qkv(jax.random.key(42), b=1, s=64, h=2, d=32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, window=9,
+                                       block_q=16, block_k=16) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True,
+                                             window=9) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3)
+
+
+def test_flash_window_wider_than_sequence_is_plain_causal():
+    q, k, v = make_qkv(jax.random.key(43), b=1, s=32, h=2, d=16)
+    ref = flash_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, window=1000)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_flash_window_requires_causal():
+    q, k, v = make_qkv(jax.random.key(44), b=1, s=32, h=2, d=16)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
