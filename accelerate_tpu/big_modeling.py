@@ -340,13 +340,21 @@ def stream_layers(layer_slice, n_layers: int, step_fn, x):
     """Drive the double-buffered layer loop: fetch layer i+1 (async H2D)
     while layer i computes. `step_fn(layer, i, x) -> x`. The single home of
     the prefetch-overlap invariant for streamed_forward/streamed_generate
-    and T5's streamed encoder."""
+    and T5's streamed encoder.
+
+    Each iteration BLOCKS on layer i's output before issuing layer i+2's
+    fetch: async dispatch would otherwise let the Python loop queue every
+    layer's host→device copy at once, and on a slow link the in-flight
+    transfer buffers sum to the whole model in host RAM (observed as an
+    OOM-kill streaming a 41 GB checkpoint). The overlap of copy(i+1) with
+    compute(i) — issued before the block — is preserved."""
     nxt = layer_slice(0)
     for i in range(n_layers):
         cur = nxt
         if i + 1 < n_layers:
             nxt = layer_slice(i + 1)
         x = step_fn(cur, i, x)
+        jax.block_until_ready(x)
     return x
 
 

@@ -39,6 +39,15 @@ def make_kv_caches(num_layers: int, batch: int, max_len: int,
     )
 
 
+def rope_table_len(config_max: int, kv_caches) -> int:
+    """Rotary-table length covering both the config's trained range and the
+    cache reach: decoding past max_position_embeddings must extend the
+    angles, not gather-clamp every overflow position to the last row."""
+    if kv_caches is None:
+        return config_max
+    return max(config_max, kv_caches[0].shape[2])
+
+
 def extend_cache(kv_cache, k, v):
     """Write this step's K/V [B, S, H, D] at cache_len.
 

@@ -32,6 +32,7 @@ from .decode import (
     cached_attention_mask,
     extend_cache,
     make_kv_caches,
+    rope_table_len,
 )
 
 
@@ -177,14 +178,10 @@ def forward(
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
-    # size tables by cache reach too: generate past max_position_embeddings
-    # must extend rotary angles, not gather-clamp to the last table row
-    max_len = (
-        max(config.max_position_embeddings, kv_caches[0].shape[2])
-        if kv_caches is not None else config.max_position_embeddings
-    )
     cos, sin = rope_frequencies(
-        config.rotary_ndims, max_len, config.rotary_emb_base,
+        config.rotary_ndims,
+        rope_table_len(config.max_position_embeddings, kv_caches),
+        config.rotary_emb_base,
     )
 
     if kv_caches is not None:

@@ -30,6 +30,7 @@ from .decode import (
     cached_attention_mask,
     extend_cache,
     make_kv_caches,
+    rope_table_len,
 )
 
 
@@ -172,13 +173,9 @@ def forward(
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
-    # size tables by cache reach too: generate past max_position_embeddings
-    # must extend rotary angles, not gather-clamp to the last table row
-    max_len = (
-        max(config.max_position_embeddings, kv_caches[0].shape[2])
-        if kv_caches is not None else config.max_position_embeddings
-    )
-    sin, cos = _interleaved_rope_tables(config.rotary_dim, max_len)
+    sin, cos = _interleaved_rope_tables(
+        config.rotary_dim,
+        rope_table_len(config.max_position_embeddings, kv_caches))
 
     if kv_caches is not None:
         ck, cv, cache_len = kv_caches

@@ -32,6 +32,7 @@ from .decode import (
     build_streamed_generate,
     extend_cache,
     make_kv_caches,
+    rope_table_len,
     windowed_cached_attention_mask,
 )
 from .common import (
@@ -310,12 +311,10 @@ def forward(
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
         )
-    max_len = (
-        kv_caches[0].shape[2] if kv_caches is not None
-        else config.max_position_embeddings
-    )
-    cos, sin = rope_frequencies(config.head_dim, max_len, config.rope_theta,
-                                scaling=config.rope_scaling_dict)
+    cos, sin = rope_frequencies(
+        config.head_dim,
+        rope_table_len(config.max_position_embeddings, kv_caches),
+        config.rope_theta, scaling=config.rope_scaling_dict)
 
     if kv_caches is not None:
         # decode path: caches stack on a leading layer dim and ride the same

@@ -6,6 +6,7 @@ from .pipeline import (
     pipeline_apply,
     pipeline_value_and_grad,
     stack_layers_into_stages,
+    stack_layers_into_virtual_stages,
 )
 from .ring_attention import ring_attention
 from .ulysses import ulysses_attention

@@ -49,6 +49,85 @@ def stack_layers_into_stages(params: Any, num_stages: int) -> Any:
     return jax.tree_util.tree_map(_split, params)
 
 
+def stack_layers_into_virtual_stages(params: Any, num_stages: int,
+                                     num_chunks: int) -> Any:
+    """[L, ...]-stacked layer params -> [V, S, L/(V*S), ...] for the
+    interleaved schedule: virtual stage j = c*S + d holds model layers
+    [j*Lc, (j+1)*Lc) and runs as chunk c on device d — Megatron's
+    round-robin chunk assignment (ref utils/megatron_lm.py:964-1063,
+    utils/dataclasses.py:1263-1265)."""
+
+    def _split(x):
+        L = x.shape[0]
+        if L % (num_stages * num_chunks):
+            raise ValueError(
+                f"{L} layers not divisible by {num_stages} stages x "
+                f"{num_chunks} virtual chunks"
+            )
+        lc = L // (num_stages * num_chunks)
+        return x.reshape((num_chunks, num_stages, lc) + x.shape[1:])
+
+    return jax.tree_util.tree_map(_split, params)
+
+
+def _pipeline_interleaved_local(stage_params, x_micro, *, stage_fn,
+                                axis_name, num_stages, num_micro,
+                                num_chunks):
+    """Interleaved virtual-stage forward, runs INSIDE shard_map.
+
+    Clock: micro m enters virtual stage j (device j % S, chunk j // S) at
+    tick t = (m % S) + S*V*(m // S) + j. This schedule provably gives each
+    device AT MOST ONE active chunk per tick (two chunks j, j+kS of one
+    device would need micro indices separated by a multiple of S landing on
+    the same tick, which the S*V group stride forbids), and completes in
+    V*M + S - 1 chunk-ticks for M a multiple of S — the bubble is S-1
+    CHUNK-times, V x smaller than GPipe's S-1 full-stage-times (the
+    Megatron interleaving result). Backward is autodiff over the scan
+    (GPipe-style; combine with remat in stage_fn for memory).
+
+    stage_params: this device's chunks, leaves [V, 1, ...] (stage dim
+    sharded away); x_micro: [M, micro_b, ...] replicated; returns
+    [M, micro_b, ...] valid on the last stage, psum-broadcast.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[:, 0], stage_params)
+    S, M, V = num_stages, num_micro, num_chunks
+    SV = S * V
+    micro_shape = x_micro.shape[1:]
+    last_t = ((M - 1) % S) + SV * ((M - 1) // S) + (V * S - 1)
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    out0 = jnp.zeros((M,) + micro_shape, x_micro.dtype)
+    carry0 = (jnp.zeros(micro_shape, x_micro.dtype), out0)
+
+    def tick(carry, t):
+        inbound, outputs = carry
+        # which of this device's V chunks is active at tick t (<= 1 is)
+        c_arr = jnp.arange(V)
+        r = t - (c_arr * S + idx)
+        rem = r % SV
+        m = (r // SV) * S + rem
+        act = (r >= 0) & (rem < S) & (m < M)
+        any_act = jnp.any(act)
+        c_act = jnp.argmax(act)  # 0 when none active (output unused then)
+        m_act = jnp.clip(jnp.sum(jnp.where(act, m, 0)), 0, M - 1)
+        chunk_params = jax.tree_util.tree_map(lambda p: p[c_act], params)
+        # virtual stage 0 (device 0, chunk 0) ingests micro m; every other
+        # virtual stage consumes what its predecessor sent last tick —
+        # chunk boundaries (device S-1 -> device 0) ride the same ring
+        x_in = jnp.where((idx == 0) & (c_act == 0), x_micro[m_act], inbound)
+        y = stage_fn(chunk_params, x_in)
+        is_last = (idx == S - 1) & (c_act == V - 1) & any_act
+        outputs = jax.lax.cond(
+            is_last, lambda o: o.at[m_act].set(y), lambda o: o, outputs)
+        nxt = jax.lax.ppermute(y, axis_name, perm)
+        return (nxt, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, carry0, jnp.arange(last_t + 1))
+    mine = jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(mine, axis_name)
+
+
 def _pipeline_local(stage_params, x_micro, *, stage_fn, axis_name, num_stages,
                     num_micro):
     """Runs INSIDE shard_map.
@@ -103,16 +182,21 @@ def pipeline_apply(
     num_micro_batches: int,
     mesh=None,
     axis_name: str = AXIS_STAGE,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """GPipe-schedule apply: y = stages(x), differentiable.
 
     - `stage_fn(params_slice, x_micro) -> y_micro` is one stage's compute
       (activations and outputs must share x's shape/dtype).
-    - `stage_params`: pytree with leading stage dim S, sharded on `stage`.
+    - `stage_params`: pytree with leading stage dim S, sharded on `stage` —
+      or, with `virtual_stages=V > 1`, leading dims [V, S] from
+      `stack_layers_into_virtual_stages` (interleaved schedule: each device
+      runs V model chunks, cutting the pipeline bubble V x).
     - `x`: [B, ...] global batch; split into `num_micro_batches` micro-batches.
 
     Replaces Megatron `get_forward_backward_func` micro-batch chunking
-    (ref utils/megatron_lm.py:975-1011).
+    (ref utils/megatron_lm.py:975-1011) and virtual pipeline stages
+    (ref utils/dataclasses.py:1263-1265).
     """
     if mesh is None:
         from ..state import PartialState
@@ -129,13 +213,24 @@ def pipeline_apply(
         raise ValueError(f"batch {b} not divisible by {num_micro_batches} micro-batches")
     micro = x.reshape((num_micro_batches, b // num_micro_batches) + x.shape[1:])
 
-    stage_spec = jax.tree_util.tree_map(
-        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
-    )
-    fn = partial(
-        _pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
-        num_stages=num_stages, num_micro=num_micro_batches,
-    )
+    if virtual_stages > 1:
+        stage_spec = jax.tree_util.tree_map(
+            lambda p: P(None, axis_name, *([None] * (p.ndim - 2))),
+            stage_params,
+        )
+        fn = partial(
+            _pipeline_interleaved_local, stage_fn=stage_fn,
+            axis_name=axis_name, num_stages=num_stages,
+            num_micro=num_micro_batches, num_chunks=virtual_stages,
+        )
+    else:
+        stage_spec = jax.tree_util.tree_map(
+            lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
+        )
+        fn = partial(
+            _pipeline_local, stage_fn=stage_fn, axis_name=axis_name,
+            num_stages=num_stages, num_micro=num_micro_batches,
+        )
     out = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(stage_spec, P()),
@@ -186,12 +281,20 @@ def _pipeline_1f1b_local(stage_params, x_micro, targets, *, stage_fn,
         slot_f = m_f_c % ring_size
         ring = ring.at[slot_f].set(jnp.where(f_valid, x_in, ring[slot_f]))
 
-        # ---- loss + its gradient on the last stage (same tick as B below)
+        # ---- loss + its gradient on the last stage (same tick as B below);
+        # a runtime cond so non-last stages skip the projection+CE FLOPs
+        # entirely (with a real LM loss that cost is substantial, and only
+        # one of S stages ever uses the result)
         tgt = jax.tree_util.tree_map(lambda v: v[m_f_c], targets)
-        lval, dy_self = jax.value_and_grad(
-            lambda yy: loss_fn(yy, tgt).astype(jnp.float32)
-        )(y)
-        loss_sum = loss_sum + jnp.where(last & f_valid, lval, 0.0)
+        lval, dy_self = jax.lax.cond(
+            last & f_valid,
+            lambda yy: jax.value_and_grad(
+                lambda y_: loss_fn(y_, tgt).astype(jnp.float32)
+            )(yy),
+            lambda yy: (jnp.float32(0.0), jnp.zeros_like(yy)),
+            y,
+        )
+        loss_sum = loss_sum + lval
 
         # ---- backward slot: micro m_b leaves this stage
         m_b = t - 2 * (S - 1) + idx
@@ -230,21 +333,35 @@ def pipeline_value_and_grad(
     mesh=None,
     axis_name: str = AXIS_STAGE,
     schedule: str = "1f1b",
+    virtual_stages: int = 1,
 ) -> tuple[jax.Array, Any]:
     """(loss, grads) of mean_m loss_fn(stages(x_m), targets_m).
 
     `schedule="1f1b"` runs the memory-bounded interleaved schedule (O(S)
     saved activations per stage); `schedule="gpipe"` differentiates
-    `pipeline_apply` (O(M) activations, kept for comparison/debug). Both
-    return identical values up to float reassociation.
+    `pipeline_apply` (O(M) activations, kept for comparison/debug);
+    `schedule="interleaved"` runs `virtual_stages` model chunks per device
+    (stage_params from `stack_layers_into_virtual_stages`) — the pipeline
+    bubble shrinks by the chunk count (ref utils/megatron_lm.py:964-1063).
+    All return identical values up to float reassociation.
 
     - `stage_fn(params_slice, x_micro) -> y_micro`: one stage's compute.
     - `loss_fn(y_micro, target_micro) -> scalar`: per-micro loss (mean-style;
       the pipeline averages it over micro-batches).
     - `targets`: pytree of arrays with the same leading batch dim as `x`.
     """
-    if schedule not in ("1f1b", "gpipe"):
-        raise ValueError(f"unknown schedule {schedule!r}; use '1f1b' or 'gpipe'")
+    if schedule not in ("1f1b", "gpipe", "interleaved"):
+        raise ValueError(f"unknown schedule {schedule!r}; use '1f1b', "
+                         "'gpipe', or 'interleaved'")
+    if schedule == "interleaved" and virtual_stages < 2:
+        raise ValueError("schedule='interleaved' needs virtual_stages >= 2 "
+                         "(1 chunk per device IS the gpipe schedule)")
+    if schedule != "interleaved" and virtual_stages != 1:
+        raise ValueError(
+            f"virtual_stages={virtual_stages} requires schedule='interleaved'"
+            f" (got {schedule!r}); [V, S, ...] stage params don't fit the "
+            "single-chunk schedules"
+        )
     if mesh is None:
         from ..state import PartialState
 
@@ -265,10 +382,12 @@ def pipeline_value_and_grad(
         lambda v: v.reshape((M, mb) + v.shape[1:]), targets
     )
 
-    if schedule == "gpipe":
+    if schedule in ("gpipe", "interleaved"):
+        v = virtual_stages if schedule == "interleaved" else 1
+
         def total_loss(sp):
             y = pipeline_apply(stage_fn, sp, x, M, mesh=mesh,
-                               axis_name=axis_name)
+                               axis_name=axis_name, virtual_stages=v)
             ym = y.reshape((M, mb) + y.shape[1:])
             losses = jax.vmap(loss_fn)(ym, tmicro)
             return jnp.mean(losses.astype(jnp.float32))
