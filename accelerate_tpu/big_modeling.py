@@ -346,7 +346,10 @@ def stream_layers(layer_slice, n_layers: int, step_fn, x):
     fetch: async dispatch would otherwise let the Python loop queue every
     layer's host→device copy at once, and on a slow link the in-flight
     transfer buffers sum to the whole model in host RAM (observed as an
-    OOM-kill streaming a 41 GB checkpoint). The overlap of copy(i+1) with
+    OOM-kill streaming a 41 GB checkpoint). The barrier is a one-element
+    device→host READ, not block_until_ready — tunneled/experimental
+    backends have been observed returning from block_until_ready without
+    waiting, which re-opens the pileup. The overlap of copy(i+1) with
     compute(i) — issued before the block — is preserved."""
     nxt = layer_slice(0)
     for i in range(n_layers):
@@ -354,7 +357,8 @@ def stream_layers(layer_slice, n_layers: int, step_fn, x):
         if i + 1 < n_layers:
             nxt = layer_slice(i + 1)
         x = step_fn(cur, i, x)
-        jax.block_until_ready(x)
+        probe = jax.tree_util.tree_leaves(x)[0]
+        np.asarray(probe.ravel()[0])  # true sync: D2H of one element
     return x
 
 

@@ -50,7 +50,7 @@ class MixtralConfig:
     router_aux_loss_coef: float = 0.02
     remat: bool = False
     attention_backend: str = "auto"
-    moe_impl: str = "dense"        # dense (exact) | sparse (capacity dispatch)
+    moe_impl: str = "dense"        # dense (exact) | sparse (capacity) | a2a (token-sharded EP)
     capacity_factor: float = 1.25  # sparse mode: C = ceil(k*S/E * factor)
 
     def __post_init__(self):
@@ -151,8 +151,13 @@ def _route(config: MixtralConfig, moe: dict, x: jax.Array):
     return probs, topk_probs, topk_idx, aux
 
 
-def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Top-k routed expert MLP. Returns (output, router_aux_loss).
+def moe_block(config: MixtralConfig, moe: dict, x: jax.Array,
+              fp8: dict | None = None) -> tuple:
+    """Top-k routed expert MLP. Returns (output, router_aux_loss,
+    new_fp8_or_None). With `fp8` (per-role {gate,up,down}_proj meta pairs),
+    expert MLP projections run E4M3/E5M2 delayed-scaled; the ROUTER stays
+    full-precision — routing decisions are precision-sensitive and tiny
+    (TE likewise leaves LayerNorm/router ops alone).
 
     Two implementations, selected by `config.moe_impl`:
     - "dense": every expert processes every token; the [B,S,E] combine
@@ -166,24 +171,86 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array
       (standard MoE-training behavior under load imbalance).
     """
     if config.moe_impl == "sparse":
-        return moe_block_sparse(config, moe, x)
+        return moe_block_sparse(config, moe, x, fp8)
+    if config.moe_impl == "a2a":
+        if fp8 is not None:
+            raise NotImplementedError(
+                "fp8 is not wired through the moe_impl='a2a' shard_map "
+                "dispatch; use moe_impl='dense' or 'sparse' with fp8"
+            )
+        return moe_block_a2a(config, moe, x) + (None,)
     if config.moe_impl != "dense":
-        raise ValueError(f"unknown moe_impl {config.moe_impl!r}; use 'dense' or 'sparse'")
+        raise ValueError(f"unknown moe_impl {config.moe_impl!r}; use "
+                         "'dense', 'sparse', or 'a2a'")
     E = config.num_local_experts
+    b, s, h = x.shape
     probs, topk_probs, topk_idx, aux = _route(config, moe, x)
     # combine weights [B,S,E]
     combine = jnp.sum(
         jax.nn.one_hot(topk_idx, E, dtype=x.dtype) * topk_probs[..., None].astype(x.dtype),
         axis=2,
     )
-    gate = jax.nn.silu(jnp.einsum("bsh,ehf->besf", x, moe["experts"]["gate_proj"]["kernel"],
-                                  preferred_element_type=jnp.float32).astype(x.dtype))
-    up = jnp.einsum("bsh,ehf->besf", x, moe["experts"]["up_proj"]["kernel"],
-                    preferred_element_type=jnp.float32).astype(x.dtype)
-    expert_out = jnp.einsum("besf,efh->besh", gate * up, moe["experts"]["down_proj"]["kernel"],
-                            preferred_element_type=jnp.float32).astype(x.dtype)
+    if fp8 is not None:
+        from ..ops.fp8 import fp8_expert_dense
+
+        x2 = x.reshape(b * s, h)
+        g8, mg = fp8_expert_dense(x2, moe["experts"]["gate_proj"]["kernel"],
+                                  fp8["gate_proj"])
+        u8, mu = fp8_expert_dense(x2, moe["experts"]["up_proj"]["kernel"],
+                                  fp8["up_proj"])
+        gate = jax.nn.silu(g8.astype(jnp.float32)).astype(x.dtype)
+        prod = gate * u8.astype(x.dtype)                        # [E, BS, F]
+        d8, md = fp8_expert_dense(prod, moe["experts"]["down_proj"]["kernel"],
+                                  fp8["down_proj"])
+        expert_out = d8.reshape(E, b, s, h).transpose(1, 0, 2, 3).astype(x.dtype)
+        new_fp8 = {"gate_proj": mg, "up_proj": mu, "down_proj": md}
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsh,ehf->besf", x, moe["experts"]["gate_proj"]["kernel"],
+                                      preferred_element_type=jnp.float32).astype(x.dtype))
+        up = jnp.einsum("bsh,ehf->besf", x, moe["experts"]["up_proj"]["kernel"],
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+        expert_out = jnp.einsum("besf,efh->besh", gate * up, moe["experts"]["down_proj"]["kernel"],
+                                preferred_element_type=jnp.float32).astype(x.dtype)
+        new_fp8 = None
     out = jnp.einsum("besh,bse->bsh", expert_out, combine)
-    return out, aux
+    return out, aux, new_fp8
+
+
+def moe_block_a2a(config: MixtralConfig, moe: dict,
+                  x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Token-sharded expert-parallel dispatch (parallel/moe.py
+    `expert_parallel_moe_a2a`): tokens flatten to [B*S, H] sharded over the
+    mesh `expert` axis, routing runs on local shards, and a pair of
+    all_to_alls carries exactly the dispatched capacity rows — the
+    production EP layout (no replicated [E, C, H] buffer, no all_gather).
+    Mixtral's renormalized top-k gates thread through the `topk` override.
+    Falls back to the single-device sort dispatch off-mesh."""
+    from ..parallel.moe import expert_parallel_moe_a2a
+
+    b, s, h = x.shape
+    k = config.num_experts_per_tok
+    probs, topk_probs, topk_idx, aux = _route(config, moe, x)
+    xt = x.reshape(b * s, h)
+    # router_logits only carry the expert count to the dispatcher when the
+    # topk override supplies the actual routing
+    logits_flat = probs.reshape(b * s, -1).astype(x.dtype)
+
+    def expert_fn(p, xs):
+        gate = jax.nn.silu(jnp.einsum(
+            "ch,hf->cf", xs, p["gate_proj"]["kernel"],
+            preferred_element_type=jnp.float32).astype(xs.dtype))
+        up = jnp.einsum("ch,hf->cf", xs, p["up_proj"]["kernel"],
+                        preferred_element_type=jnp.float32).astype(xs.dtype)
+        return jnp.einsum("cf,fh->ch", gate * up, p["down_proj"]["kernel"],
+                          preferred_element_type=jnp.float32).astype(xs.dtype)
+
+    out = expert_parallel_moe_a2a(
+        xt, logits_flat, moe["experts"], expert_fn, mesh=None,
+        capacity_factor=config.capacity_factor, top_k=k,
+        topk=(topk_probs.reshape(b * s, k).astype(jnp.float32),
+              topk_idx.reshape(b * s, k)),
+    )
+    return out.reshape(b, s, h), aux
 
 
 # crossover measured on v5e (benchmarks/bench_moe.py): one-hot einsum
@@ -191,7 +258,8 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array
 _ONEHOT_DISPATCH_MAX_ELEMENTS = 16 * 2**20
 
 
-def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array,
+                     fp8: dict | None = None) -> tuple:
     """Capacity-bounded dispatch: experts compute C tokens, not S.
 
     Two dispatch mechanisms, auto-selected by the would-be one-hot size:
@@ -212,32 +280,51 @@ def moe_block_sparse(config: MixtralConfig, moe: dict, x: jax.Array) -> tuple[ja
 
     # one-hot dispatch tensor is [S*k, E, C] per batch row; past the
     # threshold (bf16: 32 MB/row) the sort path wins on v5e
-    use_onehot = s * k * E * cap <= _ONEHOT_DISPATCH_MAX_ELEMENTS
+    use_onehot = (fp8 is None
+                  and s * k * E * cap <= _ONEHOT_DISPATCH_MAX_ELEMENTS)
     if use_onehot:
         expert_out, combine = _dispatch_onehot(
             config, moe, x, topk_idx, topk_probs, cap
         )
-        return _combine_onehot(expert_out, combine, b, s, k, h), aux
+        return _combine_onehot(expert_out, combine, b, s, k, h), aux, None
     from ..parallel.moe import sort_combine, sort_dispatch
 
     buffers, info = jax.vmap(
         lambda xr, ir, gr: sort_dispatch(xr, ir, gr.astype(xr.dtype), E, cap)
     )(x, topk_idx, topk_probs)                                 # [B, E, C, H]
-    expert_out = _expert_mlp(moe, buffers, x.dtype)
+    expert_out, new_fp8 = _expert_mlp(moe, buffers, x.dtype, fp8)
     out = jax.vmap(sort_combine)(expert_out, info)
-    return out, aux
+    return out, aux, new_fp8
 
 
-def _expert_mlp(moe: dict, buffers: jax.Array, dtype) -> jax.Array:
-    """SwiGLU expert MLP over [B, E, C, H] capacity buffers."""
+def _expert_mlp(moe: dict, buffers: jax.Array, dtype,
+                fp8: dict | None = None):
+    """SwiGLU expert MLP over [B, E, C, H] capacity buffers. Returns
+    (out [B, E, C, H], new_fp8_or_None)."""
+    if fp8 is not None:
+        from ..ops.fp8 import fp8_expert_dense
+
+        b, e, c, h = buffers.shape
+        xb = buffers.transpose(1, 0, 2, 3).reshape(e, b * c, h)
+        g8, mg = fp8_expert_dense(xb, moe["experts"]["gate_proj"]["kernel"],
+                                  fp8["gate_proj"])
+        u8, mu = fp8_expert_dense(xb, moe["experts"]["up_proj"]["kernel"],
+                                  fp8["up_proj"])
+        gate = jax.nn.silu(g8.astype(jnp.float32)).astype(dtype)
+        d8, md = fp8_expert_dense(gate * u8.astype(dtype),
+                                  moe["experts"]["down_proj"]["kernel"],
+                                  fp8["down_proj"])
+        out = d8.reshape(e, b, c, h).transpose(1, 0, 2, 3).astype(dtype)
+        return out, {"gate_proj": mg, "up_proj": mu, "down_proj": md}
     gate = jax.nn.silu(jnp.einsum(
         "bech,ehf->becf", buffers, moe["experts"]["gate_proj"]["kernel"],
         preferred_element_type=jnp.float32).astype(dtype))
     up = jnp.einsum("bech,ehf->becf", buffers, moe["experts"]["up_proj"]["kernel"],
                     preferred_element_type=jnp.float32).astype(dtype)
-    return jnp.einsum(
+    out = jnp.einsum(
         "becf,efh->bech", gate * up, moe["experts"]["down_proj"]["kernel"],
         preferred_element_type=jnp.float32).astype(dtype)
+    return out, None
 
 
 def _dispatch_onehot(config, moe, x, topk_idx, topk_probs, cap):
@@ -257,7 +344,7 @@ def _dispatch_onehot(config, moe, x, topk_idx, topk_probs, cap):
     )[..., :cap]                                               # dropped -> all-zero
     x_rep = jnp.repeat(x, k, axis=1)                           # [B, S*k, H]
     expert_in = jnp.einsum("btec,bth->bech", d, x_rep)         # gather
-    expert_out = _expert_mlp(moe, expert_in, x.dtype)
+    expert_out, _ = _expert_mlp(moe, expert_in, x.dtype)
     combine = d * flat_prob[..., None, None].astype(x.dtype)   # [B, S*k, E, C]
     return expert_out, combine
 
@@ -272,8 +359,12 @@ def forward(
     params: dict,
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (logits [B,S,V], total router aux loss)."""
+    fp8_state: dict | None = None,
+) -> tuple:
+    """Returns (logits [B,S,V], total router aux loss); with `fp8_state`
+    (see `init_fp8_state`) attention and expert-MLP projections run fp8 and
+    the return is (logits, aux, new_fp8_state) — threaded through the fused
+    train step like llama's (models/llama.py:345-360)."""
     lcfg = config._as_llama()
     x = params["embed_tokens"]["embedding"][input_ids]
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
@@ -281,34 +372,90 @@ def forward(
                                 config.rope_theta,
                                 scaling=config.rope_scaling_dict)
 
-    def scan_body(carry, layer):
-        x, aux_sum = carry
-        attn_out, _, _ = _attention(
+    def layer_step(x, aux_sum, layer, fp8_layer):
+        attn_out, _, fp8_attn = _attention(
             lcfg, layer,
             rms_norm(x, layer["input_layernorm"]["scale"], config.rms_norm_eps),
             cos, sin, positions, attention_mask,
+            fp8={"attn": fp8_layer["attn"]} if fp8_layer is not None else None,
         )
         x = x + attn_out
-        moe_out, aux = moe_block(
+        moe_out, aux, fp8_moe = moe_block(
             config, layer["moe"],
             rms_norm(x, layer["post_attention_layernorm"]["scale"], config.rms_norm_eps),
+            fp8_layer["moe"] if fp8_layer is not None else None,
         )
-        return (x + moe_out, aux_sum + aux), None
+        new_fp8 = (
+            {"attn": fp8_attn, "moe": fp8_moe}
+            if fp8_layer is not None else None
+        )
+        return x + moe_out, aux_sum + aux, new_fp8
+
+    if fp8_state is not None:
+        def scan_body(carry, xs):
+            x, aux_sum = carry
+            layer, fp8_layer = xs
+            x, aux_sum, new_fp8 = layer_step(x, aux_sum, layer, fp8_layer)
+            return (x, aux_sum), new_fp8
+
+        scan_xs = (params["layers"], fp8_state["layers"])
+    else:
+        def scan_body(carry, layer):
+            x, aux_sum = carry
+            x, aux_sum, _ = layer_step(x, aux_sum, layer, None)
+            return (x, aux_sum), None
+
+        scan_xs = params["layers"]
 
     body = scan_body
     if config.remat:
         body = jax.checkpoint(body, prevent_cse=False)
-    (x, aux_total), _ = jax.lax.scan(body, (x, jnp.float32(0.0)), params["layers"])
+    (x, aux_total), scan_ys = jax.lax.scan(body, (x, jnp.float32(0.0)), scan_xs)
     x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
                         preferred_element_type=jnp.float32)
-    return logits, aux_total / config.num_hidden_layers
+    aux_total = aux_total / config.num_hidden_layers
+    if fp8_state is not None:
+        return logits, aux_total, {"layers": scan_ys}
+    return logits, aux_total
 
 
-def causal_lm_loss(config: MixtralConfig, params: dict, batch: dict) -> jax.Array:
+def init_fp8_state(config: MixtralConfig, history_len: int = 16) -> dict:
+    """Per-layer delayed-scaling metas for attention projections and expert
+    MLPs, stacked on the layer dim to ride the forward's scan (llama's
+    layout, models/llama.py init_fp8_state; ref
+    utils/transformer_engine.py:24-84). The router is NOT converted — it is
+    tiny and routing is precision-sensitive."""
+    from ..ops.fp8 import Fp8Meta
+
+    L = config.num_hidden_layers
+
+    def stacked():
+        return Fp8Meta(
+            scale=jnp.ones((L,), jnp.float32),
+            amax_history=jnp.zeros((L, history_len), jnp.float32),
+        )
+
+    def pair():
+        return {"x": stacked(), "w": stacked()}
+
+    return {
+        "layers": {
+            "attn": {k: pair() for k in ("q_proj", "k_proj", "v_proj", "o_proj")},
+            "moe": {k: pair() for k in ("gate_proj", "up_proj", "down_proj")},
+        }
+    }
+
+
+def causal_lm_loss(config: MixtralConfig, params: dict, batch: dict,
+                   fp8_state: dict | None = None):
     input_ids = batch["input_ids"]
-    logits, aux = forward(config, params, input_ids[:, :-1])
+    out = forward(config, params, input_ids[:, :-1], fp8_state=fp8_state)
+    logits, aux = out[0], out[1]
     mask = batch.get("attention_mask")
     mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     loss = cross_entropy_loss(logits, input_ids[:, 1:], mask)
-    return loss + config.router_aux_loss_coef * aux
+    loss = loss + config.router_aux_loss_coef * aux
+    if fp8_state is not None:
+        return loss, out[2]
+    return loss

@@ -102,11 +102,16 @@ def _fp8_matmul_fwd(x, w, x_scale, w_scale):
     out = jnp.dot(
         x8, w8, preferred_element_type=jnp.float32
     ) / (x_scale * w_scale)
-    return out.astype(jnp.bfloat16), (x8, w8, x_scale, w_scale)
+    # zero-length dtype tokens ride the residuals so the backward can cast
+    # cotangents to the PRIMALS' dtypes (f32 graphs must not silently get
+    # bf16 weight grads)
+    x_tok = jnp.zeros((0,), x.dtype)
+    w_tok = jnp.zeros((0,), w.dtype)
+    return out.astype(jnp.bfloat16), (x8, w8, x_scale, w_scale, x_tok, w_tok)
 
 
 def _fp8_matmul_bwd(res, g):
-    x8, w8, x_scale, w_scale = res
+    x8, w8, x_scale, w_scale, x_tok, w_tok = res
     amax_g = jnp.max(jnp.abs(g)).astype(jnp.float32)
     g_scale = jnp.where(amax_g > 0.0, E5M2_MAX / amax_g, 1.0)
     g8 = _cast8(g, g_scale, jnp.float8_e5m2, E5M2_MAX)
@@ -119,8 +124,8 @@ def _fp8_matmul_bwd(res, g):
         x2.T, g2, preferred_element_type=jnp.float32
     ) / (x_scale * g_scale)
     return (
-        dx.astype(jnp.bfloat16),
-        dw.astype(jnp.bfloat16),
+        dx.astype(x_tok.dtype),
+        dw.astype(w_tok.dtype),
         jnp.zeros_like(x_scale),
         jnp.zeros_like(w_scale),
     )
@@ -141,6 +146,28 @@ def fp8_dense(
     and returns {'x': Fp8Meta, 'w': Fp8Meta}; thread it through the train
     step like optimizer state. Backward runs E5M2 with current scaling."""
     out = _fp8_matmul(x, kernel, meta["x"].scale, meta["w"].scale)
+    stop = jax.lax.stop_gradient
+    new_meta = {
+        "x": update_meta(meta["x"], stop(jnp.max(jnp.abs(x))).astype(jnp.float32), "E4M3", margin),
+        "w": update_meta(meta["w"], stop(jnp.max(jnp.abs(kernel))).astype(jnp.float32), "E4M3", margin),
+    }
+    return out, new_meta
+
+
+def fp8_expert_dense(
+    x: jax.Array,
+    kernel: jax.Array,
+    meta: dict,
+    margin: int = 0,
+) -> tuple[jax.Array, dict]:
+    """Per-expert batched fp8 projection: x [E, T, H] (or [T, H], shared
+    across experts) @ kernel [E, H, F] -> [E, T, F]. ONE delayed-scale pair
+    covers the stacked expert tensor (per-tensor scaling, the TE
+    convention); the vmap batches the same custom-vjp fp8 matmul the dense
+    path uses, so the backward is E5M2 current-scaled too."""
+    in_axes = (0 if x.ndim == 3 else None, 0, None, None)
+    out = jax.vmap(_fp8_matmul, in_axes=in_axes)(
+        x, kernel, meta["x"].scale, meta["w"].scale)
     stop = jax.lax.stop_gradient
     new_meta = {
         "x": update_meta(meta["x"], stop(jnp.max(jnp.abs(x))).astype(jnp.float32), "E4M3", margin),

@@ -9,9 +9,9 @@ dispatch. With an `expert` mesh axis, each device computes
 only its own experts' capacity buffers (the routing/index math runs
 replicated — cheap int ops) and one `all_gather` reassembles the outputs,
 the behavior the reference could only reach through DeepSpeed-MoE
-(ref utils/dataclasses.py:724-730). With token-sharded inputs an
-all-to-all dispatch would replace the all_gather; that variant lands with
-token-parallel routing.
+(ref utils/dataclasses.py:724-730). `expert_parallel_moe_a2a` is the
+token-sharded production variant: routing runs on local tokens and a pair
+of all_to_alls replaces the replicated buffer + all_gather entirely.
 
 `sort_dispatch` / `sort_combine` are shared with models/mixtral.py's sparse
 implementation (vmapped per batch row there).
@@ -82,8 +82,14 @@ def sort_combine(expert_outputs, combine_info):
     return jnp.sum(vals * w[..., None], axis=1)
 
 
-def _moe_local(x, router_logits, expert_params, *, expert_fn, axis_name,
-               num_experts, capacity, top_k):
+def _route_topk(router_logits, top_k):
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    return jax.lax.top_k(probs, top_k)  # gates, idx: [T, k]
+
+
+def _moe_local(x, router_logits, expert_params, topk_gate=None,
+               topk_idx=None, *, expert_fn, axis_name, num_experts,
+               capacity, top_k):
     """Top-k dispatch with capacity bounding. Runs inside shard_map when
     `axis_name` is set (expert_params then hold only this device's experts).
 
@@ -92,8 +98,10 @@ def _moe_local(x, router_logits, expert_params, *, expert_fn, axis_name,
     e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
     n_tokens, h = x.shape
 
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    gate, expert_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    if topk_gate is None:
+        gate, expert_idx = _route_topk(router_logits, top_k)
+    else:
+        gate, expert_idx = topk_gate, topk_idx
 
     expert_inputs, info = sort_dispatch(
         x, expert_idx, gate, num_experts, capacity
@@ -118,6 +126,106 @@ def _moe_local(x, router_logits, expert_params, *, expert_fn, axis_name,
     return sort_combine(expert_outputs, info).astype(x.dtype)
 
 
+def _moe_local_a2a(x, router_logits, expert_params, topk_gate=None,
+                   topk_idx=None, *, expert_fn, axis_name, num_experts,
+                   capacity, top_k, n_dev):
+    """Token-sharded dispatch, runs INSIDE shard_map: x/router_logits are
+    this device's [T_local, H]/[T_local, E] shard. Routing runs on LOCAL
+    tokens only; each device fills its own [E, C_src, H] capacity buffers,
+    ONE all_to_all ships every buffer to its expert's owner, experts run
+    batched over all sources' rows, and the reverse all_to_all brings
+    outputs home for the local gate-weighted combine. No replicated [E, C,
+    H] buffer and no all_gather — the wire carries exactly the dispatched
+    rows, the production layout of DeepSpeed-MoE-style EP
+    (ref utils/dataclasses.py:724-730)."""
+    e_local = num_experts // n_dev
+    if topk_gate is None:
+        gate, expert_idx = _route_topk(router_logits, top_k)
+    else:
+        gate, expert_idx = topk_gate, topk_idx
+
+    buffers, info = sort_dispatch(x, expert_idx, gate, num_experts, capacity)
+    h = buffers.shape[-1]
+
+    # [E, C, H] rows j*e_local..(j+1)*e_local are destined to device j:
+    # tiled all_to_all sends chunk j there; received blocks (one per source
+    # device, concatenated in device order) are my experts' inputs
+    recv = jax.lax.all_to_all(buffers, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)
+    recv = recv.reshape(n_dev, e_local, capacity, h)
+    recv = recv.transpose(1, 0, 2, 3).reshape(e_local, n_dev * capacity, h)
+    out = jax.vmap(expert_fn)(expert_params, recv)
+    out = out.reshape(e_local, n_dev, capacity, h)
+    out = out.transpose(1, 0, 2, 3).reshape(num_experts, capacity, h)
+    # reverse: chunk j = source device j's outputs; each device gets back
+    # its own tokens' rows, blocks landing in expert order
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+    return sort_combine(back, info).astype(x.dtype)
+
+
+def expert_parallel_moe_a2a(
+    x: jax.Array,
+    router_logits: jax.Array,
+    expert_params,
+    expert_fn: Callable,
+    mesh=None,
+    axis_name: str = AXIS_EXPERT,
+    capacity_factor: float = 1.25,
+    top_k: int = 1,
+    topk: tuple | None = None,
+):
+    """Token-sharded top-k EP-MoE: x [T, H] and router_logits [T, E] shard
+    their token dim over `axis_name` (the same devices that own the
+    experts), expert_params leaves lead with dim E. Capacity is bounded PER
+    SOURCE DEVICE (capacity_factor * k * T_local / E) — each expert accepts
+    up to that many rows from every device, the DeepSpeed-MoE convention —
+    so drop decisions are local and the dispatch needs no global
+    coordination. At generous capacity the result equals
+    `expert_parallel_moe` exactly; differentiable end-to-end (the
+    all_to_alls transpose to each other).
+
+    `topk` optionally supplies precomputed routing ([T, k] gates, [T, k]
+    expert ids) — e.g. mixtral's renormalized gates — instead of the
+    internal raw-softmax top-k."""
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+    num_experts = router_logits.shape[-1]
+    n_dev = mesh.shape.get(axis_name, 1)
+    if n_dev == 1 or num_experts % n_dev or x.shape[0] % n_dev:
+        return expert_parallel_moe(
+            x, router_logits, expert_params, expert_fn, mesh=mesh,
+            axis_name=axis_name, capacity_factor=capacity_factor,
+            top_k=top_k, topk=topk,
+        )
+    t_local = x.shape[0] // n_dev
+    capacity = max(int(capacity_factor * top_k * t_local / num_experts), 1)
+    expert_spec = jax.tree_util.tree_map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), expert_params
+    )
+    fn = partial(
+        _moe_local_a2a, expert_fn=expert_fn, axis_name=axis_name,
+        num_experts=num_experts, capacity=capacity, top_k=top_k,
+        n_dev=n_dev,
+    )
+    if topk is not None:
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), expert_spec,
+                      P(axis_name), P(axis_name)),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )(x, router_logits, expert_params, topk[0], topk[1])
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), expert_spec),
+        out_specs=P(axis_name),
+        check_vma=False,
+    )(x, router_logits, expert_params)
+
+
 def expert_parallel_moe(
     x: jax.Array,
     router_logits: jax.Array,
@@ -127,11 +235,13 @@ def expert_parallel_moe(
     axis_name: str = AXIS_EXPERT,
     capacity_factor: float = 1.25,
     top_k: int = 1,
+    topk: tuple | None = None,
 ):
     """Top-k EP-MoE (k=1 gives Switch, k=2 Mixtral-style routing). x: [T, H]
     tokens, router_logits: [T, E], expert_params leaves lead with dim E
-    (sharded over `expert`). Gates are the raw top-k softmax probabilities;
-    renormalize in the caller's router if desired."""
+    (sharded over `expert`). Gates are the raw top-k softmax probabilities
+    unless `topk` = ([T, k] gates, [T, k] ids) supplies the caller's own
+    routing (e.g. renormalized gates)."""
     if mesh is None:
         from ..state import PartialState
 
@@ -139,10 +249,11 @@ def expert_parallel_moe(
     num_experts = router_logits.shape[-1]
     n_dev = mesh.shape.get(axis_name, 1)
     capacity = max(int(capacity_factor * top_k * x.shape[0] / num_experts), 1)
+    tg, ti = (topk if topk is not None else (None, None))
     if n_dev == 1:
         # single device: same math without the a2a
         return _moe_local(
-            x, router_logits, expert_params,
+            x, router_logits, expert_params, tg, ti,
             expert_fn=expert_fn, axis_name=None, num_experts=num_experts,
             capacity=capacity, top_k=top_k,
         )
@@ -153,6 +264,13 @@ def expert_parallel_moe(
         _moe_local, expert_fn=expert_fn, axis_name=axis_name,
         num_experts=num_experts, capacity=capacity, top_k=top_k,
     )
+    if topk is not None:
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(), P(), expert_spec, P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(x, router_logits, expert_params, tg, ti)
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(), P(), expert_spec),

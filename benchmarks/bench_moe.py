@@ -50,6 +50,9 @@ def run(moe_impl: str, batch: int = 8, seq: int = 1024, steps: int = 20) -> floa
 
 
 if __name__ == "__main__":
-    impls = sys.argv[1].split(",") if len(sys.argv) > 1 else ["dense", "sparse"]
+    # "a2a" is the token-sharded EP dispatch; on one chip it falls back to
+    # the single-device sort path, so this row mainly proves no regression —
+    # the 8-way all_to_all itself is exercised by tests + the dryrun
+    impls = sys.argv[1].split(",") if len(sys.argv) > 1 else ["dense", "sparse", "a2a"]
     for impl in impls:
         run(impl)

