@@ -24,6 +24,7 @@ import argparse
 import os
 import subprocess
 import sys
+import time
 
 from ..utils.launch import (
     build_script_cmd,
@@ -199,6 +200,11 @@ def launch_command(args: argparse.Namespace) -> int:
     # full up to N times; scripts resume from their last checkpoint
     max_restarts = getattr(args, "max_restarts", None) or 0
     rc = 1
+    # deterministic failures (bad args, import errors) fail again instantly:
+    # burning N full world relaunches on them helps nobody. A run that dies
+    # within this many seconds twice in a row is a crash loop — stop early.
+    fast_fail_s = 10.0
+    fast_fails = 0
     for attempt in range(max_restarts + 1):
         if attempt:
             print(
@@ -206,9 +212,22 @@ def launch_command(args: argparse.Namespace) -> int:
                 f"restart {attempt}/{max_restarts}",
                 file=sys.stderr,
             )
+        t0 = time.monotonic()
         rc = run_once()
         if rc == 0:
             return 0
+        if time.monotonic() - t0 < fast_fail_s:
+            fast_fails += 1
+            if fast_fails >= 2 and attempt < max_restarts:
+                print(
+                    "accelerate-tpu launch: two consecutive failures within "
+                    f"{fast_fail_s:.0f}s look deterministic (bad arguments, "
+                    "import error?); stopping the restart loop early",
+                    file=sys.stderr,
+                )
+                return rc
+        else:
+            fast_fails = 0
     return rc
 
 

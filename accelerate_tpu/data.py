@@ -107,7 +107,8 @@ def _batch_size(batch: Any) -> int | None:
             return None
         # numpy / torch / jax arrays all expose .ndim and .shape
         if getattr(node, "ndim", 0):
-            return int(node.shape[0])
+            dims.append(int(node.shape[0]))
+            return None
         if isinstance(node, dict):
             children = (v for _, v in sorted(node.items(), key=lambda kv: str(kv[0])))
         elif isinstance(node, (list, tuple)):
@@ -115,14 +116,24 @@ def _batch_size(batch: Any) -> int | None:
         else:
             return None
         for child in children:
-            found = walk(child)
-            if found is not None:
-                return found
+            walk(child)
         return None
 
-    size = walk(batch)
-    if size is not None:
-        return size
+    dims: list[int] = []
+    walk(batch)
+    if dims:
+        # the MAJORITY leading dim is the batch size: first-found would let
+        # an aux array whose key merely sorts first (e.g. 'a_weights' [3])
+        # hijack the batch size and misclassify the real data as aux
+        # (advisor r2 finding). Ties break toward the first-seen dim, which
+        # preserves the old behavior for uniform batches.
+        from collections import Counter
+
+        counts = Counter(dims)
+        best = max(counts.values())
+        for d in dims:
+            if counts[d] == best:
+                return d
     for node in containers:
         return len(node)
     for node in deferred:

@@ -165,6 +165,24 @@ def token_nll(logits: jax.Array, labels: jax.Array,
     return nll
 
 
+def shifted_padding_masks(mask):
+    """(attention_mask, label_weights) for a next-token loss over
+    `input_ids` with a [B, S] padding mask (1 = real).
+
+    - attention: the key mask for the forward over input_ids[:, :-1];
+    - label weights: a label counts only when IT is real AND its predicting
+      token is real — the prediction made from a pad position (left-padded
+      rows) has no valid context (a fully-masked attention row) and must
+      not weight the loss.
+
+    NOTE: for PACKED sequences (interior zeros separating segments) this
+    also drops the first label after each gap — packed batches should build
+    their own weights."""
+    if mask is None:
+        return None, None
+    return mask[:, :-1], (mask[:, 1:] * mask[:, :-1]).astype(jnp.float32)
+
+
 def cross_entropy_loss(
     logits: jax.Array, labels: jax.Array, mask: jax.Array | None = None,
     label_smoothing: float = 0.0,

@@ -19,6 +19,7 @@ import numpy as np
 
 from .common import (
     cross_entropy_loss,
+    shifted_padding_masks,
     dense,
     dot_product_attention,
     layer_norm,
@@ -211,9 +212,9 @@ generate = build_generate(forward, init_kv_caches)
 def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict) -> jax.Array:
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
-    mask = batch.get("attention_mask")
-    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
-    logits = forward(config, params, input_ids[:, :-1])
+    attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
+    logits = forward(config, params, input_ids[:, :-1],
+                     attention_mask=attn_mask)
     return cross_entropy_loss(logits, labels, mask)
 
 

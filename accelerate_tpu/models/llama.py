@@ -37,6 +37,7 @@ from .decode import (
 )
 from .common import (
     apply_rope,
+    shifted_padding_masks,
     cross_entropy_loss,
     token_nll,
     dense,
@@ -441,11 +442,17 @@ def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
 
     With `fp8_state` (mixed_precision="fp8"), layer projections run fp8 and
     the return is (loss, new_fp8_state) — the fused train step threads it
-    through TrainState.fp8_state."""
+    through TrainState.fp8_state.
+
+    The attention_mask threads into the forward as a key-padding mask
+    (flash/ring/ulysses all take it natively) so padded tokens cannot leak
+    into real tokens' attention, AND weights the loss. Positions stay
+    sequential (0..S-1): batches should be RIGHT-padded — left-padded rows
+    get correctly-masked attention but their real tokens sit at shifted
+    rope positions vs a pretrained checkpoint's convention."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
-    mask = batch.get("attention_mask")
-    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
+    attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
     B, S = labels.shape
 
     if loss_chunk_size is None:
@@ -453,15 +460,16 @@ def causal_lm_loss(config: LlamaConfig, params: dict, batch: dict,
         loss_chunk_size = max(1, budget // max(1, B * config.vocab_size))
     chunk = _pick_chunk(S, loss_chunk_size)
     if chunk is None or chunk >= S:
-        out = forward(config, params, input_ids[:, :-1], attention_mask=None,
-                      fp8_state=fp8_state)
+        out = forward(config, params, input_ids[:, :-1],
+                      attention_mask=attn_mask, fp8_state=fp8_state)
         if fp8_state is not None:
             logits, new_fp8 = out
             return cross_entropy_loss(logits, labels, mask), new_fp8
         return cross_entropy_loss(out, labels, mask)
 
-    out = forward(config, params, input_ids[:, :-1], attention_mask=None,
-                  return_hidden=True, fp8_state=fp8_state)
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, return_hidden=True,
+                  fp8_state=fp8_state)
     hidden, new_fp8 = out if fp8_state is not None else (out, None)
     n = S // chunk
     h_chunks = hidden.reshape(B, n, chunk, -1).transpose(1, 0, 2, 3)

@@ -27,6 +27,7 @@ from .common import (
     repeat_kv,
     rms_norm,
     rope_frequencies,
+    shifted_padding_masks,
 )
 from .llama import LlamaConfig, _attention
 
@@ -450,10 +451,10 @@ def init_fp8_state(config: MixtralConfig, history_len: int = 16) -> dict:
 def causal_lm_loss(config: MixtralConfig, params: dict, batch: dict,
                    fp8_state: dict | None = None):
     input_ids = batch["input_ids"]
-    out = forward(config, params, input_ids[:, :-1], fp8_state=fp8_state)
+    attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, fp8_state=fp8_state)
     logits, aux = out[0], out[1]
-    mask = batch.get("attention_mask")
-    mask = mask[:, 1:].astype(jnp.float32) if mask is not None else None
     loss = cross_entropy_loss(logits, input_ids[:, 1:], mask)
     loss = loss + config.router_aux_loss_coef * aux
     if fp8_state is not None:

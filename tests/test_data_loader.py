@@ -743,3 +743,16 @@ def test_dispatcher_ragged_rows_slice_by_row(monkeypatch):
     (got,) = list(loader)
     assert [t.tolist() for t in got["ids"]] == [[7, 8, 9], [10], [11, 12]]
     np.testing.assert_array_equal(got["x"], [3.0, 4.0, 5.0])
+
+
+def test_batch_size_majority_dim_beats_key_order():
+    """An aux array whose key sorts first must not hijack the batch size
+    (advisor r2 finding): the majority leading dim across leaves wins."""
+    from accelerate_tpu.data import _batch_size
+
+    batch = {
+        "a_weights": np.ones((3,)),          # aux, sorts first
+        "x": np.ones((8, 2)),
+        "y": np.ones((8,)),
+    }
+    assert _batch_size(batch) == 8

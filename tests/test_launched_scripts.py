@@ -35,6 +35,10 @@ SCRIPTS = [
 SMOKE_SCRIPTS = [
     "test_ops.py",
     "test_uneven_inputs.py",
+    # checkpointing + metrics are precisely where multi-host regressions
+    # hide (round-2 review); the rest of the matrix stays nightly
+    "external_deps/test_checkpointing.py",
+    "external_deps/test_metrics.py",
 ]
 
 
