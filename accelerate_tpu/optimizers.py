@@ -1,0 +1,128 @@
+"""Memory-efficient optimizers (bitsandbytes-style 8-bit Adam, TPU-native).
+
+The reference reaches 8-bit optimizers through bitsandbytes
+(ref utils/modeling.py bnb paths); here the recipe is implemented directly
+as an optax transformation: Adam moments stored as int8 with per-block f32
+absmax scales. Memory per parameter drops from 8 bytes of f32 moments to
+~2.06 bytes (2 x int8 + 2 x f32/block), which is what lets multi-billion-
+parameter models train on a single 16 GB chip
+(benchmarks/mfu_table.py "2B" row; docs/performance.md).
+
+The quantize/dequantize math is pure elementwise + reshape — XLA fuses it
+into the update, so the step stays one compiled program (no bnb CUDA
+kernels to replace).
+
+At multi-host scale the preferred memory recipe is ZeRO/FSDP sharding
+(sharding/planner.py plan_optimizer_sharding): 8B params x 16 bytes / 64
+chips is 2 GB/chip — host-offload is unnecessary on TPU pods, so it is
+deliberately not implemented.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class _Quantized(NamedTuple):
+    """One moment tensor in int8 block format."""
+
+    q: jax.Array       # int8 payload, original shape
+    scale: jax.Array   # f32 per-block absmax / 127
+
+
+_BLOCK = 256
+
+
+def _quantize(x: jax.Array) -> _Quantized:
+    flat = x.reshape(-1)
+    pad = (-flat.size) % _BLOCK
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    blocks = flat.reshape(-1, _BLOCK)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return _Quantized(q=q, scale=scale.astype(jnp.float32))
+
+
+def _dequantize(z: _Quantized, shape, dtype=jnp.float32) -> jax.Array:
+    flat = (z.q.astype(jnp.float32) * z.scale).reshape(-1)
+    n = 1
+    for d in shape:
+        n *= d
+    return flat[:n].reshape(shape).astype(dtype)
+
+
+class Adam8bitState(NamedTuple):
+    count: jax.Array
+    mu: object   # pytree of _Quantized
+    nu: object
+
+
+def adamw_8bit(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """AdamW with int8 block-quantized first AND second moments.
+
+    Matches `optax.adamw` trajectories to quantization noise (tested in
+    tests/test_utils_misc.py); the classic 8-bit-Adam result is that this
+    noise does not change LM convergence. Small tensors (norm scales,
+    biases) quantize too — their block count is tiny either way.
+    """
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: _quantize(jnp.zeros(p.shape, jnp.float32)), params
+        )
+        zeros2 = jax.tree_util.tree_map(
+            lambda p: _quantize(jnp.zeros(p.shape, jnp.float32)), params
+        )
+        return Adam8bitState(count=jnp.zeros((), jnp.int32), mu=zeros,
+                             nu=zeros2)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        is_q = lambda x: isinstance(x, _Quantized)  # noqa: E731
+
+        def one(g, p, mu_q, nu_q):
+            g = g.astype(jnp.float32)
+            mu = _dequantize(mu_q, g.shape)
+            nu = _dequantize(nu_q, g.shape)
+            mu = b1 * mu + (1 - b1) * g
+            nu = b2 * nu + (1 - b2) * g * g
+            mu_hat = mu / (1 - b1 ** count.astype(jnp.float32))
+            nu_hat = nu / (1 - b2 ** count.astype(jnp.float32))
+            upd = mu_hat / (jnp.sqrt(nu_hat) + eps)
+            if weight_decay:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            lr = (
+                learning_rate(count) if callable(learning_rate)
+                else learning_rate
+            )
+            return (-lr * upd).astype(p.dtype), _quantize(mu), _quantize(nu)
+
+        out = jax.tree_util.tree_map(
+            one, grads, params, state.mu, state.nu,
+            is_leaf=lambda x: is_q(x),
+        )
+        # unzip the (update, mu, nu) triples
+        updates = jax.tree_util.tree_map(
+            lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        mu = jax.tree_util.tree_map(
+            lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        nu = jax.tree_util.tree_map(
+            lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return updates, Adam8bitState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
