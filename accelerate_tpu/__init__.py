@@ -48,6 +48,7 @@ _LAZY = {
     "make_stage_fn": ".inference",
     "notebook_launcher": ".launchers",
     "debug_launcher": ".launchers",
+    "adamw_8bit": ".optimizers",
     "TokenCorpusLoader": ".native",
     "profile": ".profiler",
     "annotate": ".profiler",

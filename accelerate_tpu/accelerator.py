@@ -51,12 +51,16 @@ from .training import (
 )
 from .utils import operations as ops
 from .utils.dataclasses import (
+    AutocastKwargs,
     ContextParallelPlugin,
     DataLoaderConfiguration,
     DeepSpeedPlugin,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
+    InitProcessGroupKwargs,
     JitConfig,
+    KwargsHandler,
     MegatronLMPlugin,
     MeshConfig,
     PrecisionType,
@@ -122,6 +126,41 @@ class Accelerator:
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
 
+        # --- kwargs handlers (ref accelerator.py:338-376) --------------------
+        # AutocastKwargs(enabled=False) pins compute to f32 (the XLA analogue
+        # of exiting torch.autocast); InitProcessGroupKwargs.timeout reaches
+        # jax.distributed.initialize; FP8RecipeKwargs rides into fp8 helpers.
+        self.autocast_handler: AutocastKwargs | None = None
+        self.init_handler: InitProcessGroupKwargs | None = None
+        self.fp8_recipe_handler: FP8RecipeKwargs | None = None
+        for handler in kwargs_handlers or []:
+            if not isinstance(handler, KwargsHandler):
+                raise ValueError(
+                    f"Unsupported kwargs handler {handler!r}: expected a "
+                    "KwargsHandler instance (AutocastKwargs, "
+                    "InitProcessGroupKwargs, FP8RecipeKwargs)."
+                )
+            for attr, cls in (
+                ("autocast_handler", AutocastKwargs),
+                ("init_handler", InitProcessGroupKwargs),
+                ("fp8_recipe_handler", FP8RecipeKwargs),
+            ):
+                if isinstance(handler, cls):
+                    if getattr(self, attr) is not None:
+                        raise ValueError(
+                            f"You can only pass one {cls.__name__} in "
+                            "kwargs_handlers."
+                        )
+                    setattr(self, attr, handler)
+                    break
+            else:
+                raise ValueError(
+                    f"Unsupported kwargs handler type "
+                    f"{type(handler).__name__}: GradScaler/DDP handlers have "
+                    "no TPU meaning (mesh plugins configure parallelism; see "
+                    "MeshConfig)."
+                )
+
         # --- mesh resolution: explicit > env > plugins > default DP ----------
         # (replaces ref env promotion ACCELERATE_USE_* state.py:892-910)
         self.deepspeed_plugin = deepspeed_plugin
@@ -140,11 +179,17 @@ class Accelerator:
             for a in wilds[:-1]:
                 axes.pop(a)
             resolved_mesh = MeshConfig(axes=axes) if axes else None
+        state_kwargs: dict = {}
+        if self.init_handler is not None and self.init_handler.timeout is not None:
+            state_kwargs["timeout"] = self.init_handler.timeout
         self.state = AcceleratorState(
-            mixed_precision=mixed_precision, cpu=cpu, mesh_config=resolved_mesh
+            mixed_precision=mixed_precision, cpu=cpu,
+            mesh_config=resolved_mesh, **state_kwargs,
         )
         # visible to parallel.context_attention without an Accelerator handle
         self.state.context_parallel_plugin = context_parallel_plugin
+        # visible to ops.fp8.resolve_history_len (models' init_fp8_state)
+        self.state.fp8_recipe_handler = self.fp8_recipe_handler
 
         # --- gradient accumulation (ref :421, dataclasses.py:586) ------------
         if gradient_accumulation_plugin is None:
@@ -227,6 +272,10 @@ class Accelerator:
 
     @property
     def compute_dtype(self):
+        if self.autocast_handler is not None and not self.autocast_handler.enabled:
+            # autocast disabled: compute in full precision regardless of the
+            # mixed_precision policy (ref autocast(enabled=False) semantics)
+            return jnp.float32
         if self.state.mixed_precision == PrecisionType.BF16:
             return jnp.bfloat16
         if self.state.mixed_precision == PrecisionType.FP16:

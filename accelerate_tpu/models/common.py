@@ -22,12 +22,70 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def sp_constrain(x: jax.Array, axis: str | None = None) -> jax.Array:
+    """Sequence-parallel activation constraint (Megatron SP, ref
+    dataclasses.py:1249-1251 `sequence_parallelism`): hint GSPMD to shard
+    hidden states [B, S, H] along the sequence dim in the norm/residual
+    regions, so those elementwise ops compute 1/n of the tokens per device
+    instead of replicating. The TP matmuls stay sharded by the param specs;
+    XLA inserts the Megatron allgather/reduce-scatter pair at the region
+    boundaries on its own.
+
+    Uses the live mesh from AcceleratorState; picks the `seq` axis if the
+    mesh carries one (>1), else the `model` (TP) axis — Megatron SP reuses
+    the TP group. A no-op outside an initialized state, under a mesh with
+    neither axis, or when the sequence length does not divide the axis.
+    """
+    from ..sharding.planner import batch_spec, constrain
+    from ..state import AcceleratorState
+
+    if not AcceleratorState._shared_state:
+        return x
+    mesh = AcceleratorState().mesh
+    if axis is None:
+        axis = next(
+            (a for a in ("seq", "model") if mesh.shape.get(a, 1) > 1), None
+        )
+    if axis is None or mesh.shape.get(axis, 1) <= 1:
+        return x
+    if x.ndim not in (2, 3) or x.shape[-2] % mesh.shape[axis]:
+        return x
+    from jax.sharding import PartitionSpec
+
+    if x.ndim == 3:
+        lead = batch_spec(mesh)[0]
+        # the batch axes may include `axis` itself (e.g. a pure-TP mesh
+        # where 'model' also absorbs batch) — never double-book an axis
+        if lead == axis or (isinstance(lead, tuple) and axis in lead):
+            lead = None
+        spec = PartitionSpec(lead, axis, None)
+    else:
+        spec = PartitionSpec(axis, None)
+    return constrain(x, mesh, spec)
+
+
 def dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None = None) -> jax.Array:
     out = jnp.einsum("...d,df->...f", x, kernel, preferred_element_type=jnp.float32)
     out = out.astype(x.dtype)
     if bias is not None:
         out = out + bias
     return out
+
+
+def dense_maybe_fp8(x, kernel, meta, bias=None):
+    """te.Linear-style swap point shared by the model zoo: with an Fp8Meta
+    pair the projection runs in fp8 (ops/fp8.py, replacing ref
+    utils/transformer_engine.py:24-84); otherwise the ordinary bf16/f32
+    dense. Returns (out, new_meta_or_None); bias (if any) adds in the
+    output dtype after the (possibly fp8) matmul, matching te.Linear."""
+    if meta is None:
+        return dense(x, kernel, bias), None
+    from ..ops.fp8 import fp8_dense
+
+    out, new_meta = fp8_dense(x, kernel, meta)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out, new_meta
 
 
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:

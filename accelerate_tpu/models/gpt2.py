@@ -19,9 +19,11 @@ import jax.numpy as jnp
 
 from .common import (
     dense,
+    dense_maybe_fp8,
     dot_product_attention,
     layer_norm,
     normal_init,
+    shifted_padding_masks,
     token_nll,
     cross_entropy_loss,
 )
@@ -90,13 +92,17 @@ def init_params(config: GPT2Config, key: jax.Array, dtype=jnp.float32) -> dict:
 
 
 def _layer_body(config: GPT2Config, x, layer, mask, positions=None,
-                kv_cache=None):
+                kv_cache=None, fp8=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_epsilon
+    fa = fp8["attn"] if fp8 is not None else {}
+    fm = fp8["mlp"] if fp8 is not None else {}
 
     y = layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"], eps)
-    qkv = dense(y, layer["attn"]["c_attn"]["kernel"], layer["attn"]["c_attn"]["bias"])
+    qkv, m_qkv = dense_maybe_fp8(
+        y, layer["attn"]["c_attn"]["kernel"], fa.get("c_attn"),
+        layer["attn"]["c_attn"]["bias"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, s, nh, hd)
     k = k.reshape(b, s, nh, hd)
@@ -109,15 +115,26 @@ def _layer_body(config: GPT2Config, x, layer, mask, positions=None,
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     attn = attn.reshape(b, s, h)
-    x = x + dense(attn, layer["attn"]["c_proj"]["kernel"],
-                  layer["attn"]["c_proj"]["bias"])
+    a_out, m_ap = dense_maybe_fp8(
+        attn, layer["attn"]["c_proj"]["kernel"], fa.get("c_proj"),
+        layer["attn"]["c_proj"]["bias"])
+    x = x + a_out
 
     y = layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"], eps)
-    y = dense(y, layer["mlp"]["c_fc"]["kernel"], layer["mlp"]["c_fc"]["bias"])
+    y, m_fc = dense_maybe_fp8(
+        y, layer["mlp"]["c_fc"]["kernel"], fm.get("c_fc"),
+        layer["mlp"]["c_fc"]["bias"])
     y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
-    x = x + dense(y, layer["mlp"]["c_proj"]["kernel"],
-                  layer["mlp"]["c_proj"]["bias"])
-    return x, new_cache
+    m_out, m_mp = dense_maybe_fp8(
+        y, layer["mlp"]["c_proj"]["kernel"], fm.get("c_proj"),
+        layer["mlp"]["c_proj"]["bias"])
+    x = x + m_out
+    new_fp8 = (
+        {"attn": {"c_attn": m_qkv, "c_proj": m_ap},
+         "mlp": {"c_fc": m_fc, "c_proj": m_mp}}
+        if fp8 is not None else None
+    )
+    return x, new_cache, new_fp8
 
 
 def forward(
@@ -127,10 +144,16 @@ def forward(
     attention_mask: jax.Array | None = None,
     positions: jax.Array | None = None,
     kv_caches=None,
+    fp8_state=None,
 ) -> jax.Array | tuple:
     """Logits [B, S, V]; LM head tied to wte (GPT-2 always ties).
     With `kv_caches` (see `init_kv_caches`), returns (logits, new_caches) —
-    the incremental-decode path behind `generate`."""
+    the incremental-decode path behind `generate`. With `fp8_state` (see
+    `init_fp8_state`), layer projections run fp8 and the result is
+    (logits, new_fp8_state)."""
+    if fp8_state is not None and kv_caches is not None:
+        raise ValueError("fp8 is a training-path feature; decode "
+                         "(kv_caches) runs bf16")
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(input_ids.shape[1]), input_ids.shape
@@ -142,8 +165,8 @@ def forward(
 
         def decode_body(carry, xs):
             layer, ck_l, cv_l = xs
-            y, cache = _layer_body(config, carry, layer, attention_mask,
-                                   positions, (ck_l, cv_l, cache_len))
+            y, cache, _ = _layer_body(config, carry, layer, attention_mask,
+                                      positions, (ck_l, cv_l, cache_len))
             nk, nv, _ = cache
             return y, (nk, nv)
 
@@ -156,16 +179,30 @@ def forward(
         )
         return logits, (nk, nv, cache_len + input_ids.shape[1])
 
-    def scan_body(carry, layer):
-        return _layer_body(config, carry, layer, attention_mask)[0], None
+    if fp8_state is not None:
+        # per-layer metas ride the scan as xs, updated metas stack as ys
+        # (the same threading as models/llama.py forward)
+        def scan_body(carry, xs):
+            layer, f = xs
+            y, _, nf = _layer_body(config, carry, layer, attention_mask,
+                                   fp8=f)
+            return y, nf
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x, new_fp8 = jax.lax.scan(
+            scan_body, x, (params["layers"], fp8_state["layers"])
+        )
+    else:
+        def scan_body(carry, layer):
+            return _layer_body(config, carry, layer, attention_mask)[0], None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"],
                    config.layer_norm_epsilon)
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsh,vh->bsv", x, params["wte"]["embedding"].astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
+    return (logits, {"layers": new_fp8}) if fp8_state is not None else logits
 
 
 def init_kv_caches(config: GPT2Config, batch: int, max_len: int,
@@ -177,13 +214,32 @@ def init_kv_caches(config: GPT2Config, batch: int, max_len: int,
 generate = build_generate(forward, init_kv_caches)
 
 
-def causal_lm_loss(config: GPT2Config, params: dict, batch: dict) -> jax.Array:
+def causal_lm_loss(config: GPT2Config, params: dict, batch: dict,
+                   fp8_state=None) -> jax.Array | tuple:
+    """Next-token loss; with `fp8_state` (mixed_precision="fp8") returns
+    (loss, new_fp8_state) — the fused train step threads it through
+    TrainState.fp8_state."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
     attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
-    logits = forward(config, params, input_ids[:, :-1],
-                     attention_mask=attn_mask)
-    return cross_entropy_loss(logits, labels, mask)
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, fp8_state=fp8_state)
+    if fp8_state is not None:
+        logits, new_fp8 = out
+        return cross_entropy_loss(logits, labels, mask), new_fp8
+    return cross_entropy_loss(out, labels, mask)
+
+
+def init_fp8_state(config: GPT2Config, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for the four layer projections
+    (shared builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs)."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("c_attn", "c_proj"),
+        "mlp": ("c_fc", "c_proj"),
+    }, history_len)
 
 
 @functools.lru_cache(maxsize=8)
@@ -193,7 +249,8 @@ def make_decode_layer_step(config: GPT2Config):
 
     @jax.jit
     def step(layer, x, positions, kv_cache):
-        return _layer_body(config, x, layer, None, positions, kv_cache)
+        y, cache, _ = _layer_body(config, x, layer, None, positions, kv_cache)
+        return y, cache
 
     return step
 

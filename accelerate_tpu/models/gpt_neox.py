@@ -21,10 +21,12 @@ from .common import (
     apply_rope,
     cross_entropy_loss,
     dense,
+    dense_maybe_fp8,
     dot_product_attention,
     layer_norm,
     normal_init,
     rope_frequencies,
+    shifted_padding_masks,
 )
 from .decode import (
     build_generate,
@@ -110,15 +112,18 @@ def _partial_rope(x, cos, sin, positions, rotary_ndims: int):
 
 
 def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask,
-                kv_cache=None):
+                kv_cache=None, fp8=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_eps
+    fa = fp8["attn"] if fp8 is not None else {}
+    fm = fp8["mlp"] if fp8 is not None else {}
 
     attn_in = layer_norm(x, layer["input_layernorm"]["scale"],
                          layer["input_layernorm"]["bias"], eps)
-    qkv = dense(attn_in, layer["attn"]["query_key_value"]["kernel"],
-                layer["attn"]["query_key_value"]["bias"])
+    qkv, m_qkv = dense_maybe_fp8(
+        attn_in, layer["attn"]["query_key_value"]["kernel"],
+        fa.get("query_key_value"), layer["attn"]["query_key_value"]["bias"])
     # NeoX packs qkv per head: out dim layout is [head][q|k|v][head_dim]
     qkv = qkv.reshape(b, s, nh, 3, hd)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
@@ -131,8 +136,9 @@ def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask,
         attn = dot_product_attention(q, k, v, mask=mask, causal=False)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=True)
-    attn_out = dense(attn.reshape(b, s, h), layer["attn"]["dense"]["kernel"],
-                     layer["attn"]["dense"]["bias"])
+    attn_out, m_ad = dense_maybe_fp8(
+        attn.reshape(b, s, h), layer["attn"]["dense"]["kernel"],
+        fa.get("dense"), layer["attn"]["dense"]["bias"])
 
     mlp_in = (
         layer_norm(x, layer["post_attention_layernorm"]["scale"],
@@ -142,15 +148,22 @@ def _layer_body(config: GPTNeoXConfig, x, layer, cos, sin, positions, mask,
                         layer["post_attention_layernorm"]["scale"],
                         layer["post_attention_layernorm"]["bias"], eps)
     )
-    y = dense(mlp_in, layer["mlp"]["dense_h_to_4h"]["kernel"],
-              layer["mlp"]["dense_h_to_4h"]["bias"])
+    y, m_up = dense_maybe_fp8(
+        mlp_in, layer["mlp"]["dense_h_to_4h"]["kernel"],
+        fm.get("dense_h_to_4h"), layer["mlp"]["dense_h_to_4h"]["bias"])
     y = jax.nn.gelu(y.astype(jnp.float32), approximate=False).astype(x.dtype)
-    mlp_out = dense(y, layer["mlp"]["dense_4h_to_h"]["kernel"],
-                    layer["mlp"]["dense_4h_to_h"]["bias"])
+    mlp_out, m_dn = dense_maybe_fp8(
+        y, layer["mlp"]["dense_4h_to_h"]["kernel"],
+        fm.get("dense_4h_to_h"), layer["mlp"]["dense_4h_to_h"]["bias"])
 
+    new_fp8 = (
+        {"attn": {"query_key_value": m_qkv, "dense": m_ad},
+         "mlp": {"dense_h_to_4h": m_up, "dense_4h_to_h": m_dn}}
+        if fp8 is not None else None
+    )
     # both residual modes add the same three terms — the difference is
     # entirely in what mlp_in read above (x alone vs x + attn_out)
-    return x + attn_out + mlp_out, new_cache
+    return x + attn_out + mlp_out, new_cache, new_fp8
 
 
 def _project_out(config: GPTNeoXConfig, params: dict, x):
@@ -169,10 +182,16 @@ def forward(
     attention_mask: jax.Array | None = None,
     positions: jax.Array | None = None,
     kv_caches=None,
+    fp8_state=None,
 ) -> jax.Array | tuple:
     """Logits [B, S, V] via the untied embed_out head; with `kv_caches`
     (see `init_kv_caches`), returns (logits, new_caches) — the
-    incremental-decode path behind `generate`."""
+    incremental-decode path behind `generate`. With `fp8_state` (see
+    `init_fp8_state`), layer projections run fp8 and the result is
+    (logits, new_fp8_state)."""
+    if fp8_state is not None and kv_caches is not None:
+        raise ValueError("fp8 is a training-path feature; decode "
+                         "(kv_caches) runs bf16")
     x = params["embed_in"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(
@@ -189,14 +208,27 @@ def forward(
 
         def decode_body(carry, xs):
             layer, ck_l, cv_l = xs
-            y, cache = _layer_body(config, carry, layer, cos, sin, positions,
-                                   attention_mask, (ck_l, cv_l, cache_len))
+            y, cache, _ = _layer_body(config, carry, layer, cos, sin,
+                                      positions, attention_mask,
+                                      (ck_l, cv_l, cache_len))
             nk, nv, _ = cache
             return y, (nk, nv)
 
         x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
         return (_project_out(config, params, x),
                 (nk, nv, cache_len + input_ids.shape[1]))
+
+    if fp8_state is not None:
+        def scan_body(carry, xs):
+            layer, f = xs
+            y, _, nf = _layer_body(config, carry, layer, cos, sin, positions,
+                                   attention_mask, fp8=f)
+            return y, nf
+
+        x, new_fp8 = jax.lax.scan(
+            scan_body, x, (params["layers"], fp8_state["layers"])
+        )
+        return _project_out(config, params, x), {"layers": new_fp8}
 
     def scan_body(carry, layer):
         return _layer_body(config, carry, layer, cos, sin, positions,
@@ -215,13 +247,32 @@ def init_kv_caches(config: GPTNeoXConfig, batch: int, max_len: int,
 generate = build_generate(forward, init_kv_caches)
 
 
-def causal_lm_loss(config: GPTNeoXConfig, params: dict, batch: dict) -> jax.Array:
+def causal_lm_loss(config: GPTNeoXConfig, params: dict, batch: dict,
+                   fp8_state=None) -> jax.Array | tuple:
+    """Next-token loss; with `fp8_state` (mixed_precision="fp8") returns
+    (loss, new_fp8_state)."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
     attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
-    logits = forward(config, params, input_ids[:, :-1],
-                     attention_mask=attn_mask)
-    return cross_entropy_loss(logits, labels, mask)
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, fp8_state=fp8_state)
+    if fp8_state is not None:
+        logits, new_fp8 = out
+        return cross_entropy_loss(logits, labels, mask), new_fp8
+    return cross_entropy_loss(out, labels, mask)
+
+
+def init_fp8_state(config: GPTNeoXConfig,
+                   history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for the four layer projections
+    (shared builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs)."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("query_key_value", "dense"),
+        "mlp": ("dense_h_to_4h", "dense_4h_to_h"),
+    }, history_len)
 
 
 @functools.lru_cache(maxsize=8)
@@ -238,8 +289,9 @@ def make_decode_layer_step(config: GPTNeoXConfig):
         cos, sin = rope_frequencies(
             config.rotary_ndims, max_len, config.rotary_emb_base,
         )
-        return _layer_body(config, x, layer, cos, sin, positions, None,
-                           kv_cache)
+        y, cache, _ = _layer_body(config, x, layer, cos, sin, positions,
+                                  None, kv_cache)
+        return y, cache
 
     return step
 

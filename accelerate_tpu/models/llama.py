@@ -47,6 +47,7 @@ from .common import (
     repeat_kv,
     rms_norm,
     rope_frequencies,
+    sp_constrain,
 )
 
 
@@ -75,6 +76,11 @@ class LlamaConfig:
     sliding_window: int | None = None
     tie_word_embeddings: bool = False
     attention_backend: str = "auto"  # auto | einsum | flash | ring | ulysses
+    # Megatron-style sequence parallelism (ref dataclasses.py:1249-1251):
+    # hidden states constrain to a seq-dim sharding in the norm/residual
+    # regions (common.sp_constrain) — 'seq' mesh axis if present, else the
+    # TP 'model' axis, the Megatron SP group
+    sequence_parallel: bool = False
     remat: bool = False
     remat_policy: str = "full"  # full | dots (save MXU outputs, recompute rest)
 
@@ -151,15 +157,7 @@ def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     return params
 
 
-def _dense_maybe_fp8(x, kernel, meta):
-    """te.Linear-style swap point: with an Fp8Meta pair the projection runs
-    in fp8 (ops/fp8.py, replacing ref utils/transformer_engine.py:24-84);
-    otherwise the ordinary bf16/f32 dense."""
-    if meta is None:
-        return dense(x, kernel), None
-    from ..ops.fp8 import fp8_dense
-
-    return fp8_dense(x, kernel, meta)
+from .common import dense_maybe_fp8 as _dense_maybe_fp8  # shared swap point
 
 
 def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
@@ -339,6 +337,8 @@ def forward(
         return logits, (nk, nv, cache_len + input_ids.shape[1])
 
     body = partial(_layer_body, config)
+    sp = sp_constrain if config.sequence_parallel else (lambda y: y)
+    x = sp(x)
 
     if fp8_state is not None:
         # per-layer metas ride the scan as xs; updated metas stack back on
@@ -347,13 +347,13 @@ def forward(
             layer, fp8_layer = xs
             y, _, new_fp8 = body(carry, layer, cos, sin, positions,
                                  attention_mask, fp8=fp8_layer)
-            return y, new_fp8
+            return sp(y), new_fp8
 
         scan_xs = (params["layers"], fp8_state["layers"])
     else:
         def scan_body(carry, layer):
             y, _, _ = body(carry, layer, cos, sin, positions, attention_mask)
-            return y, None
+            return sp(y), None
 
         scan_xs = params["layers"]
 
@@ -372,7 +372,7 @@ def forward(
         scan_body = jax.checkpoint(scan_body, prevent_cse=False, policy=policy)
     x, scan_ys = jax.lax.scan(scan_body, x, scan_xs)
     new_fp8_state = {"layers": scan_ys} if fp8_state is not None else None
-    x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+    x = sp(rms_norm(x, params["norm"]["scale"], config.rms_norm_eps))
     if return_hidden:
         return (x, new_fp8_state) if fp8_state is not None else x
     out = _project_out(config, params, x)
@@ -514,34 +514,17 @@ def _pick_chunk(S: int, target: int) -> int | None:
     return best
 
 
-def init_fp8_state(config: LlamaConfig, history_len: int = 16) -> dict:
-    """Per-layer delayed-scaling metas for every layer projection, stacked on
-    the layer dim so they ride the forward's `lax.scan` (the functional
-    analogue of transformer-engine's per-module buffers, ref
-    utils/transformer_engine.py:24-84). Pass to
-    `TrainState.create(fp8_state=...)` and train with
-    `Accelerator(mixed_precision="fp8")`."""
-    from ..ops.fp8 import Fp8Meta
+def init_fp8_state(config: LlamaConfig, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for every layer projection (shared
+    builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs). Pass to `TrainState.create(fp8_state=...)` and train
+    with `Accelerator(mixed_precision="fp8")`."""
+    from ..ops.fp8 import stacked_fp8_metas
 
-    L = config.num_hidden_layers
-
-    def stacked():
-        # fresh arrays per role: shared buffers would be donated twice by
-        # the fused train step
-        return Fp8Meta(
-            scale=jnp.ones((L,), jnp.float32),
-            amax_history=jnp.zeros((L, history_len), jnp.float32),
-        )
-
-    def pair():
-        return {"x": stacked(), "w": stacked()}
-
-    return {
-        "layers": {
-            "attn": {k: pair() for k in ("q_proj", "k_proj", "v_proj", "o_proj")},
-            "mlp": {k: pair() for k in ("gate_proj", "up_proj", "down_proj")},
-        }
-    }
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("q_proj", "k_proj", "v_proj", "o_proj"),
+        "mlp": ("gate_proj", "up_proj", "down_proj"),
+    }, history_len)
 
 
 def init_kv_caches(config: LlamaConfig, batch: int, max_len: int, dtype=jnp.bfloat16):

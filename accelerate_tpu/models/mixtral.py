@@ -421,31 +421,17 @@ def forward(
     return logits, aux_total
 
 
-def init_fp8_state(config: MixtralConfig, history_len: int = 16) -> dict:
+def init_fp8_state(config: MixtralConfig, history_len: int | None = None) -> dict:
     """Per-layer delayed-scaling metas for attention projections and expert
-    MLPs, stacked on the layer dim to ride the forward's scan (llama's
-    layout, models/llama.py init_fp8_state; ref
-    utils/transformer_engine.py:24-84). The router is NOT converted — it is
+    MLPs (shared builder: ops/fp8.py stacked_fp8_metas; honors the
+    Accelerator's FP8RecipeKwargs). The router is NOT converted — it is
     tiny and routing is precision-sensitive."""
-    from ..ops.fp8 import Fp8Meta
+    from ..ops.fp8 import stacked_fp8_metas
 
-    L = config.num_hidden_layers
-
-    def stacked():
-        return Fp8Meta(
-            scale=jnp.ones((L,), jnp.float32),
-            amax_history=jnp.zeros((L, history_len), jnp.float32),
-        )
-
-    def pair():
-        return {"x": stacked(), "w": stacked()}
-
-    return {
-        "layers": {
-            "attn": {k: pair() for k in ("q_proj", "k_proj", "v_proj", "o_proj")},
-            "moe": {k: pair() for k in ("gate_proj", "up_proj", "down_proj")},
-        }
-    }
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("q_proj", "k_proj", "v_proj", "o_proj"),
+        "moe": ("gate_proj", "up_proj", "down_proj"),
+    }, history_len)
 
 
 def causal_lm_loss(config: MixtralConfig, params: dict, batch: dict,

@@ -20,6 +20,7 @@ from .common import (
     cross_entropy_loss,
     shifted_padding_masks,
     dense,
+    dense_maybe_fp8,
     dot_product_attention,
     layer_norm,
     normal_init,
@@ -99,17 +100,23 @@ def init_params(config: OPTConfig, key: jax.Array, dtype=jnp.float32) -> dict:
 
 
 def _layer_body(config: OPTConfig, x, layer, mask, positions=None,
-                kv_cache=None):
+                kv_cache=None, fp8=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     eps = config.layer_norm_eps
+    fa = fp8["attn"] if fp8 is not None else {}
+    fm = fp8["mlp"] if fp8 is not None else {}
 
     y = layer_norm(x, layer["self_attn_layer_norm"]["scale"],
                    layer["self_attn_layer_norm"]["bias"], eps)
     a = layer["attn"]
-    q = dense(y, a["q_proj"]["kernel"], a["q_proj"]["bias"]).reshape(b, s, nh, hd)
-    k = dense(y, a["k_proj"]["kernel"], a["k_proj"]["bias"]).reshape(b, s, nh, hd)
-    v = dense(y, a["v_proj"]["kernel"], a["v_proj"]["bias"]).reshape(b, s, nh, hd)
+    q, m_q = dense_maybe_fp8(y, a["q_proj"]["kernel"], fa.get("q_proj"),
+                             a["q_proj"]["bias"])
+    k, m_k = dense_maybe_fp8(y, a["k_proj"]["kernel"], fa.get("k_proj"),
+                             a["k_proj"]["bias"])
+    v, m_v = dense_maybe_fp8(y, a["v_proj"]["kernel"], fa.get("v_proj"),
+                             a["v_proj"]["bias"])
+    q, k, v = (t.reshape(b, s, nh, hd) for t in (q, k, v))
     new_cache = None
     if kv_cache is not None:
         k, v, new_cache = extend_cache(kv_cache, k, v)
@@ -117,15 +124,25 @@ def _layer_body(config: OPTConfig, x, layer, mask, positions=None,
         attn = dot_product_attention(q, k, v, mask=mask, causal=False)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=True)
-    x = x + dense(attn.reshape(b, s, h), a["out_proj"]["kernel"],
-                  a["out_proj"]["bias"])
+    o, m_o = dense_maybe_fp8(attn.reshape(b, s, h), a["out_proj"]["kernel"],
+                             fa.get("out_proj"), a["out_proj"]["bias"])
+    x = x + o
 
     y = layer_norm(x, layer["final_layer_norm"]["scale"],
                    layer["final_layer_norm"]["bias"], eps)
-    y = jax.nn.relu(dense(y, layer["mlp"]["fc1"]["kernel"],
-                          layer["mlp"]["fc1"]["bias"]))
-    x = x + dense(y, layer["mlp"]["fc2"]["kernel"], layer["mlp"]["fc2"]["bias"])
-    return x, new_cache
+    y, m_f1 = dense_maybe_fp8(y, layer["mlp"]["fc1"]["kernel"],
+                              fm.get("fc1"), layer["mlp"]["fc1"]["bias"])
+    y = jax.nn.relu(y)
+    y, m_f2 = dense_maybe_fp8(y, layer["mlp"]["fc2"]["kernel"],
+                              fm.get("fc2"), layer["mlp"]["fc2"]["bias"])
+    x = x + y
+    new_fp8 = (
+        {"attn": {"q_proj": m_q, "k_proj": m_k, "v_proj": m_v,
+                  "out_proj": m_o},
+         "mlp": {"fc1": m_f1, "fc2": m_f2}}
+        if fp8 is not None else None
+    )
+    return x, new_cache, new_fp8
 
 
 def _project_out(config: OPTConfig, params: dict, x):
@@ -144,11 +161,17 @@ def forward(
     attention_mask: jax.Array | None = None,
     positions: jax.Array | None = None,
     kv_caches=None,
+    fp8_state=None,
 ) -> jax.Array | tuple:
     """Logits [B, S, V] (LM head tied to embed_tokens); with `kv_caches`
     (see `init_kv_caches`), returns (logits, new_caches). `positions` are
     logical 0-based token positions — the fairseq +2 offset is applied
-    internally at the embedding lookup."""
+    internally at the embedding lookup. With `fp8_state` (see
+    `init_fp8_state`), layer projections run fp8 and the result is
+    (logits, new_fp8_state)."""
+    if fp8_state is not None and kv_caches is not None:
+        raise ValueError("fp8 is a training-path feature; decode "
+                         "(kv_caches) runs bf16")
     if positions is None:
         if attention_mask is not None and kv_caches is None:
             # HF OPT derives positions from the mask cumsum, so left-padded
@@ -177,14 +200,26 @@ def forward(
 
         def decode_body(carry, xs):
             layer, ck_l, cv_l = xs
-            y, cache = _layer_body(config, carry, layer, attention_mask,
-                                   positions, (ck_l, cv_l, cache_len))
+            y, cache, _ = _layer_body(config, carry, layer, attention_mask,
+                                      positions, (ck_l, cv_l, cache_len))
             nk, nv, _ = cache
             return y, (nk, nv)
 
         x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
         return (_project_out(config, params, x),
                 (nk, nv, cache_len + input_ids.shape[1]))
+
+    if fp8_state is not None:
+        def scan_body(carry, xs):
+            layer, f = xs
+            y, _, nf = _layer_body(config, carry, layer, attention_mask,
+                                   fp8=f)
+            return y, nf
+
+        x, new_fp8 = jax.lax.scan(
+            scan_body, x, (params["layers"], fp8_state["layers"])
+        )
+        return _project_out(config, params, x), {"layers": new_fp8}
 
     def scan_body(carry, layer):
         return _layer_body(config, carry, layer, attention_mask)[0], None
@@ -202,13 +237,31 @@ def init_kv_caches(config: OPTConfig, batch: int, max_len: int,
 generate = build_generate(forward, init_kv_caches)
 
 
-def causal_lm_loss(config: OPTConfig, params: dict, batch: dict) -> jax.Array:
+def causal_lm_loss(config: OPTConfig, params: dict, batch: dict,
+                   fp8_state=None) -> jax.Array | tuple:
+    """Next-token loss; with `fp8_state` (mixed_precision="fp8") returns
+    (loss, new_fp8_state)."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
     attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
-    logits = forward(config, params, input_ids[:, :-1],
-                     attention_mask=attn_mask)
-    return cross_entropy_loss(logits, labels, mask)
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, fp8_state=fp8_state)
+    if fp8_state is not None:
+        logits, new_fp8 = out
+        return cross_entropy_loss(logits, labels, mask), new_fp8
+    return cross_entropy_loss(out, labels, mask)
+
+
+def init_fp8_state(config: OPTConfig, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for the six layer projections
+    (shared builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs)."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("q_proj", "k_proj", "v_proj", "out_proj"),
+        "mlp": ("fc1", "fc2"),
+    }, history_len)
 
 
 @functools.lru_cache(maxsize=8)
@@ -218,7 +271,8 @@ def make_decode_layer_step(config: OPTConfig):
 
     @jax.jit
     def step(layer, x, positions, kv_cache):
-        return _layer_body(config, x, layer, None, positions, kv_cache)
+        y, cache, _ = _layer_body(config, x, layer, None, positions, kv_cache)
+        return y, cache
 
     return step
 

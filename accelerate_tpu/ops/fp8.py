@@ -176,6 +176,59 @@ def fp8_expert_dense(
     return out, new_meta
 
 
+def resolve_history_len(explicit: int | None = None) -> int:
+    """amax-history window: explicit arg > the live Accelerator's
+    `FP8RecipeKwargs` kwargs-handler > the dataclass default (16 here — TE's
+    1024-step window buys nothing under delayed scaling with per-step jit
+    and costs [L, H] state per projection)."""
+    if explicit is not None:
+        return explicit
+    from ..state import AcceleratorState
+
+    if AcceleratorState._shared_state:
+        recipe = AcceleratorState._shared_state.get("fp8_recipe_handler")
+        if recipe is not None:
+            return recipe.amax_history_len
+    return 16
+
+
+def stacked_fp8_metas(num_layers: int, groups: dict[str, tuple],
+                      history_len: int | None = None) -> dict:
+    """The model zoo's shared init_fp8_state body: per-layer delayed-scaling
+    meta pairs for every projection name, stacked on the layer dim so they
+    ride the forward's `lax.scan` (the functional analogue of
+    transformer-engine's per-module buffers, ref
+    utils/transformer_engine.py:24-84).
+
+    `groups` maps module group -> projection names, e.g.
+    ``{"attn": ("q_proj", ...), "mlp": ("gate_proj", ...)}``;
+    `history_len` resolves via `resolve_history_len` (so
+    ``Accelerator(kwargs_handlers=[FP8RecipeKwargs(amax_history_len=N)])``
+    reaches every family without threading)."""
+    h = resolve_history_len(history_len)
+
+    def pair():
+        # fresh arrays per role: shared buffers would be donated twice by
+        # the fused train step
+        return {
+            "x": Fp8Meta(
+                scale=jnp.ones((num_layers,), jnp.float32),
+                amax_history=jnp.zeros((num_layers, h), jnp.float32),
+            ),
+            "w": Fp8Meta(
+                scale=jnp.ones((num_layers,), jnp.float32),
+                amax_history=jnp.zeros((num_layers, h), jnp.float32),
+            ),
+        }
+
+    return {
+        "layers": {
+            group: {name: pair() for name in names}
+            for group, names in groups.items()
+        }
+    }
+
+
 def init_fp8_state(params, recipe: FP8RecipeKwargs | None = None):
     """One (x, w) meta pair per 2D+ weight leaf, matching the param pytree
     structure (the functional analogue of TE's per-module buffers)."""

@@ -15,7 +15,12 @@ kernels to replace).
 At multi-host scale the preferred memory recipe is ZeRO/FSDP sharding
 (sharding/planner.py plan_optimizer_sharding): 8B params x 16 bytes / 64
 chips is 2 GB/chip — host-offload is unnecessary on TPU pods, so it is
-deliberately not implemented.
+deliberately not implemented. Under `plan_optimizer_sharding` the
+quantized moments REPLICATE (with a logged warning): their [blocks, 256]
+payload layout cannot adopt a param-shaped PartitionSpec, and at the
+scale where moment sharding matters, plain `optax.adamw` + ZeRO is the
+better tool — this transform's niche is fitting multi-billion-param
+training on ONE chip (benchmarks/mfu_table.py 1.5B/2B rows).
 """
 
 from __future__ import annotations
@@ -59,8 +64,12 @@ def _dequantize(z: _Quantized, shape, dtype=jnp.float32) -> jax.Array:
 
 class Adam8bitState(NamedTuple):
     count: jax.Array
-    mu: object   # pytree of _Quantized
-    nu: object
+    mu: object        # pytree of _Quantized (linear domain)
+    # second moment stored as quantized sqrt(nu) — the field name IS the
+    # format version: checkpoints from the earlier linear-domain layout
+    # carried a field named `nu` and fail loudly on restore (tree-structure
+    # mismatch) instead of silently dequantizing into the wrong domain
+    nu_sqrt: object
 
 
 def adamw_8bit(
@@ -73,9 +82,11 @@ def adamw_8bit(
     """AdamW with int8 block-quantized first AND second moments.
 
     Matches `optax.adamw` trajectories to quantization noise (tested in
-    tests/test_utils_misc.py); the classic 8-bit-Adam result is that this
-    noise does not change LM convergence. Small tensors (norm scales,
-    biases) quantize too — their block count is tiny either way.
+    tests/test_optimizers.py); the classic 8-bit-Adam result is that this
+    noise does not change LM convergence. The second moment is stored in
+    sqrt domain (see the update body) so the denominator error stays
+    absolute-bounded. Small tensors (norm scales, biases) quantize too —
+    their block count is tiny either way.
     """
 
     def init(params):
@@ -86,7 +97,7 @@ def adamw_8bit(
             lambda p: _quantize(jnp.zeros(p.shape, jnp.float32)), params
         )
         return Adam8bitState(count=jnp.zeros((), jnp.int32), mu=zeros,
-                             nu=zeros2)
+                             nu_sqrt=zeros2)
 
     def update(grads, state, params=None):
         count = state.count + 1
@@ -95,7 +106,13 @@ def adamw_8bit(
         def one(g, p, mu_q, nu_q):
             g = g.astype(jnp.float32)
             mu = _dequantize(mu_q, g.shape)
-            nu = _dequantize(nu_q, g.shape)
+            # nu is stored in sqrt domain: linear int8 on sqrt(nu) compresses
+            # the dynamic range the way bnb's nonlinear quantile map does —
+            # the Adam denominator sqrt(nu)+eps then carries at most half a
+            # quantization step of absolute error, where linear-domain int8
+            # gave small-nu entries unbounded relative error and visibly
+            # bent the trajectory (tests/test_optimizers.py)
+            nu = _dequantize(nu_q, g.shape) ** 2
             mu = b1 * mu + (1 - b1) * g
             nu = b2 * nu + (1 - b2) * g * g
             mu_hat = mu / (1 - b1 ** count.astype(jnp.float32))
@@ -107,10 +124,14 @@ def adamw_8bit(
                 learning_rate(count) if callable(learning_rate)
                 else learning_rate
             )
-            return (-lr * upd).astype(p.dtype), _quantize(mu), _quantize(nu)
+            return (
+                (-lr * upd).astype(p.dtype),
+                _quantize(mu),
+                _quantize(jnp.sqrt(nu)),
+            )
 
         out = jax.tree_util.tree_map(
-            one, grads, params, state.mu, state.nu,
+            one, grads, params, state.mu, state.nu_sqrt,
             is_leaf=lambda x: is_q(x),
         )
         # unzip the (update, mu, nu) triples
@@ -123,6 +144,6 @@ def adamw_8bit(
         nu = jax.tree_util.tree_map(
             lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple)
         )
-        return updates, Adam8bitState(count=count, mu=mu, nu=nu)
+        return updates, Adam8bitState(count=count, mu=mu, nu_sqrt=nu)
 
     return optax.GradientTransformation(init, update)

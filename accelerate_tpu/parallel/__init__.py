@@ -1,7 +1,11 @@
 """Parallel schedules beyond plain GSPMD: ring attention (context parallel),
 pipeline parallelism, expert-parallel MoE dispatch."""
 
-from .moe import expert_parallel_moe, expert_parallel_moe_a2a
+from .moe import (
+    MoEFallbackWarning,
+    expert_parallel_moe,
+    expert_parallel_moe_a2a,
+)
 from .pipeline import (
     pipeline_apply,
     pipeline_value_and_grad,

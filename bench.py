@@ -6,17 +6,37 @@ Prints ONE JSON line:
 The reference publishes no training-throughput numbers (BASELINE.md); the
 target from BASELINE.json is >=40% MFU on the causal-LM training loop, so
 `vs_baseline` reports measured_MFU / 0.40.
+
+Unkillable-by-design (the round-3 failure mode): the whole TPU bench runs
+as a SUBPROCESS with a hard wall-clock ceiling, because the hosted tunnel
+can either raise at init or hang indefinitely — both happened in practice.
+The child IS the bench (one backend init on the happy path); if it fails,
+times out, or finds no TPU, the parent re-runs the child with
+JAX_PLATFORMS=cpu and emits the JSON line from the CPU smoke config,
+carrying an "error" field that names the TPU failure.  Any other
+exception is caught at top-level and still produces a parseable line;
+exit code is always 0.  See docs/benchmarking.md for re-running after
+tunnel failures.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+# wall-clock ceiling for the full TPU bench child (init + compile + timed
+# windows). A hung tunnel costs this once; a healthy run initializes the
+# backend exactly once (the child IS the bench — no separate probe).
+_TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
 
-def main() -> None:
+
+def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
+    """Build and time the bench; None when require_tpu and no TPU visible
+    (the caller exits nonzero so the parent falls back to CPU)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -26,7 +46,12 @@ def main() -> None:
     from accelerate_tpu.models.common import count_params
     from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    dev0 = jax.devices()[0]
+    on_tpu = "tpu" in (
+        dev0.platform + getattr(dev0, "device_kind", "")
+    ).lower()
+    if require_tpu and not on_tpu:
+        return None
     if on_tpu:
         # ~400M params: fp32 master + adam moments + grads fit one v5e chip
         cfg = llama.LlamaConfig(
@@ -77,7 +102,7 @@ def main() -> None:
     ) if on_tpu else 1e12
     mfu = achieved / peak
 
-    print(json.dumps({
+    result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
@@ -92,8 +117,87 @@ def main() -> None:
             "device": device_kind,
             "n_chips": n_chips,
         },
-    }))
+    }
+    if error:
+        result["error"] = error
+    return result
+
+
+def _child_main() -> None:
+    """Runs inside a bench child process (BENCH_CHILD=1)."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the hosted image pins jax_platforms to the tunnel backend at
+        # import time, silently overriding the env var — force CPU via the
+        # config before any backend initializes (tests/conftest.py fix)
+        from accelerate_tpu.utils.environment import force_cpu_platform
+
+        force_cpu_platform()
+        print(json.dumps(run_bench(os.environ.get("BENCH_TPU_ERROR") or None)))
+        return
+    result = run_bench(None, require_tpu=True)
+    if result is None:
+        sys.exit(3)  # no TPU visible; parent falls back to CPU
+    print(json.dumps(result))
+
+
+def _last_json_line(text: str) -> str | None:
+    return next(
+        (ln for ln in reversed(text.splitlines()) if ln.startswith("{")),
+        None,
+    )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD") == "1":
+        _child_main()
+        return
+    # The parent never initializes JAX. The TPU attempt runs as a killable
+    # child (the tunnel can hang at init, not just fail) and IS the full
+    # bench — one backend init on the happy path, no separate probe.
+    error = None
+    try:
+        out = subprocess.run(
+            [sys.executable, __file__],
+            env={**os.environ, "BENCH_CHILD": "1", "JAX_PLATFORMS": ""},
+            capture_output=True, text=True, timeout=_TPU_TIMEOUT,
+        )
+        line = _last_json_line(out.stdout)
+        if out.returncode == 0 and line:
+            print(line)
+            return
+        if out.returncode == 3:
+            error = "no tpu visible (tunnel backend came up without one)"
+        else:
+            tail = (out.stderr or out.stdout).strip().splitlines()
+            error = "tpu bench failed: " + (
+                tail[-1][:300] if tail else "no output"
+            )
+    except subprocess.TimeoutExpired:
+        error = f"tpu bench hung >{_TPU_TIMEOUT}s (tunnel unresponsive)"
+    # TPU unusable: CPU child so no poisoned backend state survives
+    env = {**os.environ, "BENCH_CHILD": "1", "JAX_PLATFORMS": "cpu",
+           "BENCH_TPU_ERROR": error}
+    out = subprocess.run([sys.executable, __file__], env=env,
+                         capture_output=True, text=True, timeout=900)
+    line = _last_json_line(out.stdout)
+    if line:
+        print(line)
+    else:  # last resort: the contract line, hand-built
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": error,
+            "fallback_stderr": (out.stderr or "")[-500:],
+        }))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # absolute last resort — still one parseable line
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {str(e)[:300]}",
+        }))
+    sys.exit(0)

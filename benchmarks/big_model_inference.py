@@ -74,6 +74,13 @@ def main() -> None:
 
     import importlib
 
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # the hosted image pins jax_platforms to the tunnel backend at
+        # import time, overriding the env var — honor the caller's CPU
+        # request (same fix as tests/conftest.py and bench.py)
+        from accelerate_tpu.utils.environment import force_cpu_platform
+
+        force_cpu_platform()
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -25,27 +25,44 @@ from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
 
 CONFIGS = {
     # name: (hidden, ffn, layers, heads, kv_heads, batch, seq, remat_policy,
-    #        bf16_moments) — the 16 GB chip fits the larger rows only with
-    #        bf16 adam moments (a standard large-model recipe) and, at 1B,
-    #        full remat
-    "400M": (1536, 4096, 12, 12, 4, 8, 2048, "dots", False),
-    "700M": (2048, 5504, 12, 16, 8, 4, 2048, "dots", True),
-    "1B": (2048, 5504, 20, 16, 8, 4, 2048, "full", True),
+    #        moments) — the 16 GB chip fits the larger rows only by shrinking
+    #        the Adam moments: 'f32' -> plain adamw, 'bf16' -> mu_dtype
+    #        downcast, 'int8' -> accelerate_tpu.optimizers.adamw_8bit
+    #        (~2.06 bytes/param of optimizer state instead of 8 — what lets
+    #        the 1.5B/2B rows train on one chip at all)
+    "400M": (1536, 4096, 12, 12, 4, 8, 2048, "dots", "f32"),
+    "700M": (2048, 5504, 12, 16, 8, 4, 2048, "dots", "bf16"),
+    "1B": (2048, 5504, 20, 16, 8, 4, 2048, "full", "bf16"),
+    "1.5B": (2560, 6912, 20, 20, 4, 4, 2048, "full", "int8"),
+    "2B": (2560, 6912, 26, 20, 4, 2, 2048, "full", "int8"),
+    "2B-s4k": (2560, 6912, 26, 20, 4, 1, 4096, "full", "int8"),
 }
 
 
 def run(name: str, steps: int = 15) -> None:
     import jax.numpy as jnp
 
-    h, f, L, nh, nkv, batch, seq, policy, bf16_m = CONFIGS[name]
+    from accelerate_tpu.optimizers import adamw_8bit
+
+    h, f, L, nh, nkv, batch, seq, policy, moments = CONFIGS[name]
     cfg = llama.LlamaConfig(
         vocab_size=32000, hidden_size=h, intermediate_size=f,
         num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nkv,
         max_position_embeddings=seq, remat=True, remat_policy=policy,
     )
     acc = Accelerator(mixed_precision="bf16", gradient_clipping=1.0)
-    params = llama.init_params(cfg, jax.random.key(0))
-    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16 if bf16_m else None)
+    if moments == "int8":
+        # the single-chip multi-billion recipe: bf16 weights (grads then
+        # materialize bf16 straight out of autodiff) + int8 Adam moments
+        # ≈ 6 bytes/param of resident state — 2B params ≈ 11.7 GB, which is
+        # what fits a 16 GB chip; f32 masters + f32 grads would need ~20 GB
+        params = llama.init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16)
+        tx = adamw_8bit(3e-4)
+    else:
+        params = llama.init_params(cfg, jax.random.key(0))
+        tx = optax.adamw(
+            3e-4, mu_dtype=jnp.bfloat16 if moments == "bf16" else None
+        )
     ts = acc.prepare(TrainState.create(apply_fn=None, params=params, tx=tx))
     n_params = count_params(ts.params)
     rng = np.random.default_rng(0)

@@ -1,0 +1,60 @@
+"""Measure the real host->device link: bandwidth and per-call latency.
+
+Settles the load-path question from VERDICT r3 weak #4: the big-model load
+moved bytes at 39-76 MB/s against a claimed ~140 MB/s tunnel. This probe
+times raw `jax.device_put` at several sizes, separating per-call fixed cost
+(dominates small tensors — a checkpoint has thousands) from asymptotic
+bandwidth (dominates the stacked-layer megatensors). Compare
+`bench_results/tunnel_probe.jsonl` with the load rate: if device_put at
+256 MB reaches ~2x the observed load rate, the loader's per-tensor
+round-trips are the factor; if it doesn't, the claim in
+big_model_inference.py:26-28 is what needs correcting.
+
+Run: python benchmarks/tunnel_probe.py   (prints one JSON line)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    sizes_mb = [1, 16, 64, 256]
+    rows = {}
+    for mb in sizes_mb:
+        arr = np.zeros((mb * 2**20 // 4,), np.float32)
+        # warm once (allocator, program setup)
+        jax.block_until_ready(jax.device_put(arr, dev))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(arr, dev))
+            best = min(best, time.perf_counter() - t0)
+        rows[f"{mb}MB"] = {
+            "seconds": round(best, 4),
+            "MB_per_s": round(mb / best, 1),
+        }
+    # per-call fixed cost via a tiny transfer
+    tiny = np.zeros((16,), np.float32)
+    jax.block_until_ready(jax.device_put(tiny, dev))
+    t0 = time.perf_counter()
+    n = 50
+    for _ in range(n):
+        jax.block_until_ready(jax.device_put(tiny, dev))
+    per_call_ms = (time.perf_counter() - t0) / n * 1e3
+    print(json.dumps({
+        "metric": "host_device_link",
+        "value": rows["256MB"]["MB_per_s"],
+        "unit": "MB/s@256MB",
+        "extra": {"sizes": rows, "per_call_ms": round(per_call_ms, 2),
+                  "device": str(dev)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

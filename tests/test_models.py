@@ -515,3 +515,35 @@ def test_causal_lm_loss_left_padded_runs_and_masks():
     loss2 = llama.causal_lm_loss(cfg, params, {
         "input_ids": jnp.asarray(ids2), "attention_mask": jnp.asarray(mask)})
     np.testing.assert_allclose(float(loss), float(loss2), rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gpt_neox", "opt", "gptj"])
+def test_zoo_masked_loss_runs_and_ignores_pads(family):
+    """Regression: gpt2/gpt_neox causal_lm_loss raised NameError on any
+    masked batch (shifted_padding_masks never imported; round-4 find).
+    Padded rows must also not change the loss of the real tokens."""
+    import importlib
+
+    mod = importlib.import_module(f"accelerate_tpu.models.{family}")
+    cfg_cls = {
+        "gpt2": "GPT2Config", "gpt_neox": "GPTNeoXConfig",
+        "opt": "OPTConfig", "gptj": "GPTJConfig",
+    }[family]
+    cfg = getattr(mod, cfg_cls).tiny()
+    params = mod.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, cfg.vocab_size, (2, 17)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[:, 12:] = 0  # right padding
+    ids_padded = ids.copy()
+    ids_padded[:, 12:] = 0
+    loss_masked = float(mod.causal_lm_loss(
+        cfg, params,
+        {"input_ids": jnp.asarray(ids_padded),
+         "attention_mask": jnp.asarray(mask)},
+    ))
+    loss_short = float(mod.causal_lm_loss(
+        cfg, params, {"input_ids": jnp.asarray(ids[:, :12])},
+    ))
+    assert np.isfinite(loss_masked)
+    np.testing.assert_allclose(loss_masked, loss_short, rtol=2e-3)

@@ -415,3 +415,96 @@ def test_mixtral_fp8_a2a_refused():
     with pytest.raises(NotImplementedError, match="a2a"):
         mixtral.forward(cfg, params, ids,
                         fp8_state=mixtral.init_fp8_state(cfg))
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gpt_neox", "opt"])
+def test_zoo_fp8_train_step_converges(family):
+    """VERDICT r3 item 9 (fp8 breadth): gpt2/gpt_neox/opt train under
+    mixed_precision='fp8' through the shared dense_maybe_fp8 swap point."""
+    import importlib
+
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.state import PartialState
+
+    mod = importlib.import_module(f"accelerate_tpu.models.{family}")
+    PartialState._reset_state()
+    cfg = mod.tiny_config() if hasattr(mod, "tiny_config") else None
+    if cfg is None:
+        cfg_cls = {
+            "gpt2": "GPT2Config", "gpt_neox": "GPTNeoXConfig",
+            "opt": "OPTConfig",
+        }[family]
+        cfg = getattr(mod, cfg_cls).tiny()
+    acc = Accelerator(mixed_precision="fp8")
+    params = mod.init_params(cfg, jax.random.key(0))
+    ts = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(5e-3),
+        fp8_state=mod.init_fp8_state(cfg),
+    )
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 33)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: mod.causal_lm_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    losses = []
+    for _ in range(12):
+        ts, m = step(ts, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+    # delayed-scaling metas actually updated
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(
+            lambda x: x, ts.fp8_state["layers"],
+        )
+    )
+    assert any(
+        not np.allclose(np.asarray(leaf), 1.0)
+        for leaf in leaves if leaf.ndim == 1
+    )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gpt_neox", "opt"])
+def test_zoo_fp8_forward_close_to_f32(family):
+    """fp8 logits stay close to the f32 forward on the same weights."""
+    import importlib
+
+    mod = importlib.import_module(f"accelerate_tpu.models.{family}")
+    cfg_cls = {
+        "gpt2": "GPT2Config", "gpt_neox": "GPTNeoXConfig", "opt": "OPTConfig",
+    }[family]
+    cfg = getattr(mod, cfg_cls).tiny()
+    params = mod.init_params(cfg, jax.random.key(1))
+    ids = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 17)),
+        jnp.int32,
+    )
+    ref = mod.forward(cfg, params, ids)
+    out, new_state = mod.forward(cfg, params, ids,
+                                 fp8_state=mod.init_fp8_state(cfg))
+    # first-step scales are 1.0: fp8 quantization noise only (same bound
+    # as test_mixtral_fp8_forward_close_to_f32)
+    err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+    assert err < 0.35, err
+    assert jax.tree_util.tree_structure(new_state) is not None
+
+
+@pytest.mark.parametrize("family", ["gpt2", "gpt_neox", "opt"])
+def test_zoo_fp8_decode_refused(family):
+    import importlib
+
+    mod = importlib.import_module(f"accelerate_tpu.models.{family}")
+    cfg_cls = {
+        "gpt2": "GPT2Config", "gpt_neox": "GPTNeoXConfig", "opt": "OPTConfig",
+    }[family]
+    cfg = getattr(mod, cfg_cls).tiny()
+    params = mod.init_params(cfg, jax.random.key(2))
+    caches = mod.init_kv_caches(cfg, 2, 16)
+    with pytest.raises(ValueError, match="fp8"):
+        mod.forward(cfg, params, jnp.zeros((2, 4), jnp.int32),
+                    kv_caches=caches, fp8_state=mod.init_fp8_state(cfg))
