@@ -819,14 +819,18 @@ class Accelerator:
             return True
         return False
 
-    def context_attention(self, q, k, v, causal: bool = True):
+    def context_attention(self, q, k, v, causal: bool = True,
+                          window: int | None = None):
         """Sequence-parallel attention using the configured
-        `ContextParallelPlugin.mode` (ring | ulysses) over this mesh."""
+        `ContextParallelPlugin.mode` (ring | ulysses) over this mesh.
+        `window` applies Mistral-style sliding-window banding in either
+        mode."""
         from .parallel import context_attention as _ca
 
         mode = (self.context_parallel_plugin.mode
                 if self.context_parallel_plugin is not None else None)
-        return _ca(q, k, v, causal=causal, mode=mode, mesh=self.mesh)
+        return _ca(q, k, v, causal=causal, mode=mode, mesh=self.mesh,
+                   window=window)
 
     # --------------------------------------------------------- profiling
     def profile(self, logdir: str = "/tmp/accelerate_tpu_trace", **kwargs):

@@ -21,6 +21,7 @@ from .common import (
     cross_entropy_loss,
     shifted_padding_masks,
     dense,
+    dense_maybe_fp8,
     dot_product_attention,
     layer_norm,
     normal_init,
@@ -117,15 +118,18 @@ def _apply_interleaved_rope(x, sin, cos, positions):
 
 
 def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask,
-                kv_cache=None):
+                kv_cache=None, fp8=None):
     b, s, h = x.shape
     nh, hd, rot = config.num_attention_heads, config.head_dim, config.rotary_dim
     eps = config.layer_norm_epsilon
+    fa = fp8["attn"] if fp8 is not None else {}
+    fm = fp8["mlp"] if fp8 is not None else {}
 
     y = layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"], eps)
-    q = dense(y, layer["attn"]["q_proj"]["kernel"]).reshape(b, s, nh, hd)
-    k = dense(y, layer["attn"]["k_proj"]["kernel"]).reshape(b, s, nh, hd)
-    v = dense(y, layer["attn"]["v_proj"]["kernel"]).reshape(b, s, nh, hd)
+    q, m_q = dense_maybe_fp8(y, layer["attn"]["q_proj"]["kernel"], fa.get("q_proj"))
+    k, m_k = dense_maybe_fp8(y, layer["attn"]["k_proj"]["kernel"], fa.get("k_proj"))
+    v, m_v = dense_maybe_fp8(y, layer["attn"]["v_proj"]["kernel"], fa.get("v_proj"))
+    q, k, v = (t.reshape(b, s, nh, hd) for t in (q, k, v))
     q = jnp.concatenate([
         _apply_interleaved_rope(q[..., :rot], sin, cos, positions),
         q[..., rot:],
@@ -141,13 +145,24 @@ def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask,
         attn = dot_product_attention(q, k, v, mask=mask, causal=False)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=True)
-    attn_out = dense(attn.reshape(b, s, h), layer["attn"]["out_proj"]["kernel"])
+    attn_out, m_o = dense_maybe_fp8(
+        attn.reshape(b, s, h), layer["attn"]["out_proj"]["kernel"],
+        fa.get("out_proj"))
 
     # parallel residual off the SAME ln_1 output
-    m = dense(y, layer["mlp"]["fc_in"]["kernel"], layer["mlp"]["fc_in"]["bias"])
+    m, m_fi = dense_maybe_fp8(y, layer["mlp"]["fc_in"]["kernel"],
+                              fm.get("fc_in"), layer["mlp"]["fc_in"]["bias"])
     m = jax.nn.gelu(m.astype(jnp.float32), approximate=True).astype(x.dtype)
-    mlp_out = dense(m, layer["mlp"]["fc_out"]["kernel"], layer["mlp"]["fc_out"]["bias"])
-    return x + attn_out + mlp_out, new_cache
+    mlp_out, m_fo = dense_maybe_fp8(m, layer["mlp"]["fc_out"]["kernel"],
+                                    fm.get("fc_out"),
+                                    layer["mlp"]["fc_out"]["bias"])
+    new_fp8 = (
+        {"attn": {"q_proj": m_q, "k_proj": m_k, "v_proj": m_v,
+                  "out_proj": m_o},
+         "mlp": {"fc_in": m_fi, "fc_out": m_fo}}
+        if fp8 is not None else None
+    )
+    return x + attn_out + mlp_out, new_cache, new_fp8
 
 
 def _project_out(config: GPTJConfig, params: dict, x):
@@ -166,9 +181,15 @@ def forward(
     attention_mask: jax.Array | None = None,
     positions: jax.Array | None = None,
     kv_caches=None,
+    fp8_state=None,
 ) -> jax.Array | tuple:
     """Logits [B, S, V]; with `kv_caches` (see `init_kv_caches`), returns
-    (logits, new_caches) — the incremental-decode path behind `generate`."""
+    (logits, new_caches) — the incremental-decode path behind `generate`.
+    With `fp8_state` (see `init_fp8_state`), layer projections run fp8 and
+    the result is (logits, new_fp8_state)."""
+    if fp8_state is not None and kv_caches is not None:
+        raise ValueError("fp8 is a training-path feature; decode "
+                         "(kv_caches) runs bf16")
     x = params["wte"]["embedding"][input_ids]
     if positions is None:
         positions = jnp.broadcast_to(
@@ -183,14 +204,27 @@ def forward(
 
         def decode_body(carry, xs):
             layer, ck_l, cv_l = xs
-            y, cache = _layer_body(config, carry, layer, sin, cos, positions,
-                                   attention_mask, (ck_l, cv_l, cache_len))
+            y, cache, _ = _layer_body(config, carry, layer, sin, cos,
+                                      positions, attention_mask,
+                                      (ck_l, cv_l, cache_len))
             nk, nv, _ = cache
             return y, (nk, nv)
 
         x, (nk, nv) = jax.lax.scan(decode_body, x, (params["layers"], ck, cv))
         return (_project_out(config, params, x),
                 (nk, nv, cache_len + input_ids.shape[1]))
+
+    if fp8_state is not None:
+        def scan_body(carry, xs):
+            layer, f = xs
+            y, _, nf = _layer_body(config, carry, layer, sin, cos, positions,
+                                   attention_mask, fp8=f)
+            return y, nf
+
+        x, new_fp8 = jax.lax.scan(
+            scan_body, x, (params["layers"], fp8_state["layers"])
+        )
+        return _project_out(config, params, x), {"layers": new_fp8}
 
     def scan_body(carry, layer):
         return _layer_body(config, carry, layer, sin, cos, positions,
@@ -209,13 +243,31 @@ def init_kv_caches(config: GPTJConfig, batch: int, max_len: int,
 generate = build_generate(forward, init_kv_caches)
 
 
-def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict) -> jax.Array:
+def causal_lm_loss(config: GPTJConfig, params: dict, batch: dict,
+                   fp8_state=None) -> jax.Array | tuple:
+    """Next-token loss; with `fp8_state` (mixed_precision="fp8") returns
+    (loss, new_fp8_state)."""
     input_ids = batch["input_ids"]
     labels = input_ids[:, 1:]
     attn_mask, mask = shifted_padding_masks(batch.get("attention_mask"))
-    logits = forward(config, params, input_ids[:, :-1],
-                     attention_mask=attn_mask)
-    return cross_entropy_loss(logits, labels, mask)
+    out = forward(config, params, input_ids[:, :-1],
+                  attention_mask=attn_mask, fp8_state=fp8_state)
+    if fp8_state is not None:
+        logits, new_fp8 = out
+        return cross_entropy_loss(logits, labels, mask), new_fp8
+    return cross_entropy_loss(out, labels, mask)
+
+
+def init_fp8_state(config: GPTJConfig, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for the six layer projections
+    (shared builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs)."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("q_proj", "k_proj", "v_proj", "out_proj"),
+        "mlp": ("fc_in", "fc_out"),
+    }, history_len)
 
 
 @functools.lru_cache(maxsize=8)
@@ -227,8 +279,9 @@ def make_decode_layer_step(config: GPTJConfig):
     def step(layer, x, positions, kv_cache):
         max_len = max(config.max_position_embeddings, kv_cache[0].shape[1])
         sin, cos = _interleaved_rope_tables(config.rotary_dim, max_len)
-        return _layer_body(config, x, layer, sin, cos, positions, None,
-                           kv_cache)
+        y, cache, _ = _layer_body(config, x, layer, sin, cos, positions,
+                                  None, kv_cache)
+        return y, cache
 
     return step
 

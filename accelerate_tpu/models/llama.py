@@ -199,28 +199,26 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
             else "einsum"
         )
     window = config.sliding_window
-    if window is not None and backend in ("ring", "ulysses") and kv_cache is None:
-        raise NotImplementedError(
-            f"attention_backend={backend!r} does not implement "
-            "sliding-window attention; use 'auto', 'flash', or 'einsum' for "
-            "sliding-window checkpoints (Mistral/Qwen2)"
-        )
     # flash, ring, and ulysses all take [B, S] key-padding masks natively
     # (ring rotates mask chunks with K/V; ulysses all-gathers the mask), so
-    # padded batches keep every fast path
+    # padded batches keep every fast path; all three take `window` too
+    # (ring: exact global-position banding in the einsum fold; ulysses: the
+    # band rides the flash kernel after the head scatter)
     key_mask = mask if mask is None or getattr(mask, "ndim", 0) == 2 else None
     if backend == "ring" and kv_cache is None and (mask is None or key_mask is not None):
         # ring handles GQA itself: un-repeated K/V chunks ride the ring (the
         # repeat factor never touches ICI)
         from ..parallel.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, causal=True, mask=key_mask)
+        out = ring_attention(q, k, v, causal=True, mask=key_mask,
+                             window=window)
     elif backend == "ulysses" and kv_cache is None and (mask is None or key_mask is not None):
         # ulysses also keeps GQA K/V un-repeated on the wire (repeat happens
         # after its all-to-all)
         from ..parallel.ulysses import ulysses_attention
 
-        out = ulysses_attention(q, k, v, causal=True, mask=key_mask)
+        out = ulysses_attention(q, k, v, causal=True, mask=key_mask,
+                                window=window)
     else:
         k = repeat_kv(k, nh // nkv)
         v = repeat_kv(v, nh // nkv)

@@ -51,6 +51,9 @@ class MixtralConfig:
     router_aux_loss_coef: float = 0.02
     remat: bool = False
     attention_backend: str = "auto"
+    # Megatron-style sequence parallelism: seq-dim activation constraints
+    # in the norm/residual regions (models/common.py sp_constrain)
+    sequence_parallel: bool = False
     moe_impl: str = "dense"        # dense (exact) | sparse (capacity) | a2a (token-sharded EP)
     capacity_factor: float = 1.25  # sparse mode: C = ceil(k*S/E * factor)
 
@@ -174,12 +177,7 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array,
     if config.moe_impl == "sparse":
         return moe_block_sparse(config, moe, x, fp8)
     if config.moe_impl == "a2a":
-        if fp8 is not None:
-            raise NotImplementedError(
-                "fp8 is not wired through the moe_impl='a2a' shard_map "
-                "dispatch; use moe_impl='dense' or 'sparse' with fp8"
-            )
-        return moe_block_a2a(config, moe, x) + (None,)
+        return moe_block_a2a(config, moe, x, fp8)
     if config.moe_impl != "dense":
         raise ValueError(f"unknown moe_impl {config.moe_impl!r}; use "
                          "'dense', 'sparse', or 'a2a'")
@@ -217,15 +215,22 @@ def moe_block(config: MixtralConfig, moe: dict, x: jax.Array,
     return out, aux, new_fp8
 
 
-def moe_block_a2a(config: MixtralConfig, moe: dict,
-                  x: jax.Array) -> tuple[jax.Array, jax.Array]:
+def moe_block_a2a(config: MixtralConfig, moe: dict, x: jax.Array,
+                  fp8: dict | None = None) -> tuple:
     """Token-sharded expert-parallel dispatch (parallel/moe.py
     `expert_parallel_moe_a2a`): tokens flatten to [B*S, H] sharded over the
     mesh `expert` axis, routing runs on local shards, and a pair of
     all_to_alls carries exactly the dispatched capacity rows — the
     production EP layout (no replicated [E, C, H] buffer, no all_gather).
     Mixtral's renormalized top-k gates thread through the `topk` override.
-    Falls back to the single-device sort dispatch off-mesh."""
+    Falls back to the single-device sort dispatch off-mesh.
+
+    With `fp8`, the per-expert projections run the same E4M3/E5M2
+    custom-vjp matmul as the dense path: delayed scales ride the dispatch's
+    `expert_aux` channel (replicated in), local amaxes come back
+    max-combined over experts and devices (the per-tensor-scaling reduction
+    for stacked expert weights), and the metas update OUTSIDE shard_map.
+    Returns (out, router_aux_loss, new_fp8_or_None)."""
     from ..parallel.moe import expert_parallel_moe_a2a
 
     b, s, h = x.shape
@@ -235,6 +240,53 @@ def moe_block_a2a(config: MixtralConfig, moe: dict,
     # router_logits only carry the expert count to the dispatcher when the
     # topk override supplies the actual routing
     logits_flat = probs.reshape(b * s, -1).astype(x.dtype)
+    topk_arg = (topk_probs.reshape(b * s, k).astype(jnp.float32),
+                topk_idx.reshape(b * s, k))
+
+    if fp8 is not None:
+        from ..ops.fp8 import _fp8_matmul, update_meta
+
+        scales = {
+            name: {"x": fp8[name]["x"].scale, "w": fp8[name]["w"].scale}
+            for name in ("gate_proj", "up_proj", "down_proj")
+        }
+        stop = jax.lax.stop_gradient
+
+        def expert_fn(p, xs, sc):
+            g = _fp8_matmul(xs, p["gate_proj"]["kernel"],
+                            sc["gate_proj"]["x"], sc["gate_proj"]["w"])
+            u = _fp8_matmul(xs, p["up_proj"]["kernel"],
+                            sc["up_proj"]["x"], sc["up_proj"]["w"])
+            prod = (jax.nn.silu(g.astype(jnp.float32))
+                    * u.astype(jnp.float32)).astype(xs.dtype)
+            d = _fp8_matmul(prod, p["down_proj"]["kernel"],
+                            sc["down_proj"]["x"], sc["down_proj"]["w"])
+            amax = {
+                "gate_proj": {"x": stop(jnp.max(jnp.abs(xs))),
+                              "w": stop(jnp.max(jnp.abs(p["gate_proj"]["kernel"])))},
+                "up_proj": {"x": stop(jnp.max(jnp.abs(xs))),
+                            "w": stop(jnp.max(jnp.abs(p["up_proj"]["kernel"])))},
+                "down_proj": {"x": stop(jnp.max(jnp.abs(prod))),
+                              "w": stop(jnp.max(jnp.abs(p["down_proj"]["kernel"])))},
+            }
+            return d.astype(xs.dtype), amax
+
+        out, extras = expert_parallel_moe_a2a(
+            xt, logits_flat, moe["experts"], expert_fn, mesh=None,
+            capacity_factor=config.capacity_factor, top_k=k,
+            topk=topk_arg, expert_aux=scales,
+        )
+        am = extras["expert_aux"]
+        new_fp8 = {
+            name: {
+                "x": update_meta(fp8[name]["x"],
+                                 am[name]["x"].astype(jnp.float32)),
+                "w": update_meta(fp8[name]["w"],
+                                 am[name]["w"].astype(jnp.float32)),
+            }
+            for name in ("gate_proj", "up_proj", "down_proj")
+        }
+        return out.reshape(b, s, h), aux, new_fp8
 
     def expert_fn(p, xs):
         gate = jax.nn.silu(jnp.einsum(
@@ -247,11 +299,9 @@ def moe_block_a2a(config: MixtralConfig, moe: dict,
 
     out = expert_parallel_moe_a2a(
         xt, logits_flat, moe["experts"], expert_fn, mesh=None,
-        capacity_factor=config.capacity_factor, top_k=k,
-        topk=(topk_probs.reshape(b * s, k).astype(jnp.float32),
-              topk_idx.reshape(b * s, k)),
+        capacity_factor=config.capacity_factor, top_k=k, topk=topk_arg,
     )
-    return out.reshape(b, s, h), aux
+    return out.reshape(b, s, h), aux, None
 
 
 # crossover measured on v5e (benchmarks/bench_moe.py): one-hot einsum
@@ -366,8 +416,11 @@ def forward(
     (see `init_fp8_state`) attention and expert-MLP projections run fp8 and
     the return is (logits, aux, new_fp8_state) — threaded through the fused
     train step like llama's (models/llama.py:345-360)."""
+    from .common import sp_constrain
+
     lcfg = config._as_llama()
-    x = params["embed_tokens"]["embedding"][input_ids]
+    sp = sp_constrain if config.sequence_parallel else (lambda y: y)
+    x = sp(params["embed_tokens"]["embedding"][input_ids])
     positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
     cos, sin = rope_frequencies(config.head_dim, config.max_position_embeddings,
                                 config.rope_theta,
@@ -390,7 +443,7 @@ def forward(
             {"attn": fp8_attn, "moe": fp8_moe}
             if fp8_layer is not None else None
         )
-        return x + moe_out, aux_sum + aux, new_fp8
+        return sp(x + moe_out), aux_sum + aux, new_fp8
 
     if fp8_state is not None:
         def scan_body(carry, xs):
@@ -412,7 +465,7 @@ def forward(
     if config.remat:
         body = jax.checkpoint(body, prevent_cse=False)
     (x, aux_total), scan_ys = jax.lax.scan(body, (x, jnp.float32(0.0)), scan_xs)
-    x = rms_norm(x, params["norm"]["scale"], config.rms_norm_eps)
+    x = sp(rms_norm(x, params["norm"]["scale"], config.rms_norm_eps))
     logits = jnp.einsum("bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
                         preferred_element_type=jnp.float32)
     aux_total = aux_total / config.num_hidden_layers

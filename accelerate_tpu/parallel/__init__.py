@@ -17,10 +17,12 @@ from .ulysses import ulysses_attention
 
 
 def context_attention(q, k, v, causal: bool = True, mode: str | None = None,
-                      mesh=None, axis_name: str = "seq"):
+                      mesh=None, axis_name: str = "seq",
+                      window: int | None = None):
     """Sequence-parallel attention dispatched by `ContextParallelPlugin.mode`
     ('ring' rotates K/V chunks; 'ulysses' head-scatters via all-to-all).
-    With no plugin/mode in scope, defaults to ring."""
+    With no plugin/mode in scope, defaults to ring. `window` applies
+    Mistral-style sliding-window banding in either mode."""
     if mode is None:
         from ..state import AcceleratorState
 
@@ -33,6 +35,6 @@ def context_attention(q, k, v, causal: bool = True, mode: str | None = None,
             mode = "ring"
     if mode == "ulysses":
         return ulysses_attention(q, k, v, causal=causal, mesh=mesh,
-                                 axis_name=axis_name)
+                                 axis_name=axis_name, window=window)
     return ring_attention(q, k, v, causal=causal, mesh=mesh,
-                          axis_name=axis_name)
+                          axis_name=axis_name, window=window)

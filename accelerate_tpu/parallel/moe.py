@@ -102,9 +102,23 @@ def _dropped_fraction(info):
     return 1.0 - jnp.mean(valid.astype(jnp.float32))
 
 
+def _run_experts(expert_fn, expert_params, inputs, expert_aux):
+    """vmap expert_fn over the leading expert dim; with `expert_aux`
+    (replicated pytree, e.g. fp8 scales) the fn returns (out, aux) per
+    expert and aux leaves reduce by max over the experts run here —
+    per-tensor amax semantics over stacked expert weights."""
+    if expert_aux is None:
+        return jax.vmap(expert_fn)(expert_params, inputs), None
+    out, aux = jax.vmap(expert_fn, in_axes=(0, 0, None))(
+        expert_params, inputs, expert_aux
+    )
+    aux = jax.tree_util.tree_map(lambda a: jnp.max(a, axis=0), aux)
+    return out, aux
+
+
 def _moe_local(x, router_logits, expert_params, topk_gate=None,
-               topk_idx=None, *, expert_fn, axis_name, num_experts,
-               capacity, top_k, return_stats=False):
+               topk_idx=None, expert_aux=None, *, expert_fn, axis_name,
+               num_experts, capacity, top_k, return_stats=False):
     """Top-k dispatch with capacity bounding. Runs inside shard_map when
     `axis_name` is set (expert_params then hold only this device's experts).
 
@@ -131,23 +145,32 @@ def _moe_local(x, router_logits, expert_params, topk_gate=None,
         local_in = jax.lax.dynamic_slice_in_dim(
             expert_inputs, idx * e_local, e_local, axis=0
         )  # [e_local, C, H]
-        local_out = jax.vmap(expert_fn)(expert_params, local_in)
+        local_out, aux = _run_experts(expert_fn, expert_params, local_in,
+                                      expert_aux)
+        if aux is not None:
+            aux = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmax(a, axis_name), aux
+            )
         expert_outputs = jax.lax.all_gather(
             local_out, axis_name, axis=0, tiled=True
         )  # [E, C, H]
     else:
-        expert_outputs = jax.vmap(expert_fn)(expert_params, expert_inputs)
+        expert_outputs, aux = _run_experts(expert_fn, expert_params,
+                                           expert_inputs, expert_aux)
 
     out = sort_combine(expert_outputs, info).astype(x.dtype)
+    extras = {}
     if return_stats:
         # routing ran replicated, so the fraction is already global
-        return out, {"moe_dropped_fraction": _dropped_fraction(info)}
-    return out
+        extras["moe_dropped_fraction"] = _dropped_fraction(info)
+    if expert_aux is not None:
+        extras["expert_aux"] = aux
+    return (out, extras) if extras else out
 
 
 def _moe_local_a2a(x, router_logits, expert_params, topk_gate=None,
-                   topk_idx=None, *, expert_fn, axis_name, num_experts,
-                   capacity, top_k, n_dev, return_stats=False):
+                   topk_idx=None, expert_aux=None, *, expert_fn, axis_name,
+                   num_experts, capacity, top_k, n_dev, return_stats=False):
     """Token-sharded dispatch, runs INSIDE shard_map: x/router_logits are
     this device's [T_local, H]/[T_local, E] shard. Routing runs on LOCAL
     tokens only; each device fills its own [E, C_src, H] capacity buffers,
@@ -173,7 +196,13 @@ def _moe_local_a2a(x, router_logits, expert_params, topk_gate=None,
                               concat_axis=0, tiled=True)
     recv = recv.reshape(n_dev, e_local, capacity, h)
     recv = recv.transpose(1, 0, 2, 3).reshape(e_local, n_dev * capacity, h)
-    out = jax.vmap(expert_fn)(expert_params, recv)
+    out, aux = _run_experts(expert_fn, expert_params, recv, expert_aux)
+    if aux is not None:
+        # devices ran disjoint experts on disjoint rows: the global
+        # per-tensor amax is the max over the axis
+        aux = jax.tree_util.tree_map(
+            lambda a: jax.lax.pmax(a, axis_name), aux
+        )
     out = out.reshape(e_local, n_dev, capacity, h)
     out = out.transpose(1, 0, 2, 3).reshape(num_experts, capacity, h)
     # reverse: chunk j = source device j's outputs; each device gets back
@@ -181,11 +210,15 @@ def _moe_local_a2a(x, router_logits, expert_params, topk_gate=None,
     back = jax.lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                               tiled=True)
     combined = sort_combine(back, info).astype(x.dtype)
+    extras = {}
     if return_stats:
         # routing is per-source-device here: average the local fractions
-        frac = jax.lax.pmean(_dropped_fraction(info), axis_name)
-        return combined, {"moe_dropped_fraction": frac}
-    return combined
+        extras["moe_dropped_fraction"] = jax.lax.pmean(
+            _dropped_fraction(info), axis_name
+        )
+    if expert_aux is not None:
+        extras["expert_aux"] = aux
+    return (combined, extras) if extras else combined
 
 
 def expert_parallel_moe_a2a(
@@ -200,6 +233,7 @@ def expert_parallel_moe_a2a(
     topk: tuple | None = None,
     strict: bool = False,
     return_stats: bool = False,
+    expert_aux=None,
 ):
     """Token-sharded top-k EP-MoE: x [T, H] and router_logits [T, E] shard
     their token dim over `axis_name` (the same devices that own the
@@ -226,7 +260,14 @@ def expert_parallel_moe_a2a(
     ``return_stats=True`` returns ``(out, {"moe_dropped_fraction": f})``
     where ``f`` is the in-graph fraction of top-k assignments dropped past
     capacity this step (global mean over devices) — thread it into training
-    metrics to watch routing health."""
+    metrics to watch routing health.
+
+    ``expert_aux`` (requires ``topk``) threads a replicated pytree (e.g.
+    fp8 delayed scales) into ``expert_fn(params, xs, aux) -> (out, aux_out)``;
+    ``aux_out`` leaves must be per-call scalars (e.g. amaxes) and combine by
+    max over experts then over devices, landing replicated in the returned
+    extras dict under ``"expert_aux"`` — the per-tensor-scaling reduction
+    for stacked expert weights (models/mixtral.py a2a fp8 rides this)."""
     if mesh is None:
         from ..state import PartialState
 
@@ -246,11 +287,14 @@ def expert_parallel_moe_a2a(
         if strict:
             raise ValueError(msg)
         warnings.warn(msg, MoEFallbackWarning, stacklevel=2)
+    if expert_aux is not None and topk is None:
+        raise ValueError("expert_aux requires precomputed `topk` routing")
     if n_dev == 1 or num_experts % n_dev or x.shape[0] % n_dev:
         return expert_parallel_moe(
             x, router_logits, expert_params, expert_fn, mesh=mesh,
             axis_name=axis_name, capacity_factor=capacity_factor,
             top_k=top_k, topk=topk, return_stats=return_stats,
+            expert_aux=expert_aux,
         )
     t_local = x.shape[0] // n_dev
     capacity = max(int(capacity_factor * top_k * t_local / num_experts), 1)
@@ -262,10 +306,18 @@ def expert_parallel_moe_a2a(
         num_experts=num_experts, capacity=capacity, top_k=top_k,
         n_dev=n_dev, return_stats=return_stats,
     )
-    out_specs = (
-        (P(axis_name), {"moe_dropped_fraction": P()})
-        if return_stats else P(axis_name)
-    )
+    has_extras = return_stats or expert_aux is not None
+    # P() is a tree-prefix spec: it covers every (replicated) extras leaf
+    out_specs = (P(axis_name), P()) if has_extras else P(axis_name)
+    if expert_aux is not None:
+        aux_spec = jax.tree_util.tree_map(lambda _: P(), expert_aux)
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), expert_spec,
+                      P(axis_name), P(axis_name), aux_spec),
+            out_specs=out_specs,
+            check_vma=False,
+        )(x, router_logits, expert_params, topk[0], topk[1], expert_aux)
     if topk is not None:
         return jax.shard_map(
             fn, mesh=mesh,
@@ -293,13 +345,16 @@ def expert_parallel_moe(
     top_k: int = 1,
     topk: tuple | None = None,
     return_stats: bool = False,
+    expert_aux=None,
 ):
     """Top-k EP-MoE (k=1 gives Switch, k=2 Mixtral-style routing). x: [T, H]
     tokens, router_logits: [T, E], expert_params leaves lead with dim E
     (sharded over `expert`). Gates are the raw top-k softmax probabilities
     unless `topk` = ([T, k] gates, [T, k] ids) supplies the caller's own
     routing (e.g. renormalized gates). ``return_stats=True`` additionally
-    returns ``{"moe_dropped_fraction": f}`` (see expert_parallel_moe_a2a)."""
+    returns ``{"moe_dropped_fraction": f}``; ``expert_aux`` threads a
+    replicated pytree into a 3-arg expert_fn (see
+    expert_parallel_moe_a2a)."""
     if mesh is None:
         from ..state import PartialState
 
@@ -308,6 +363,8 @@ def expert_parallel_moe(
     n_dev = mesh.shape.get(axis_name, 1)
     capacity = max(int(capacity_factor * top_k * x.shape[0] / num_experts), 1)
     tg, ti = (topk if topk is not None else (None, None))
+    if expert_aux is not None and topk is None:
+        raise ValueError("expert_aux requires precomputed `topk` routing")
     if n_dev == 1 or num_experts % n_dev:
         if n_dev > 1:
             # same no-silent-downgrade contract as the a2a path: an
@@ -322,7 +379,7 @@ def expert_parallel_moe(
         # single device — or experts don't shard evenly over the axis:
         # same math with fully replicated experts (no slicing, no gather)
         return _moe_local(
-            x, router_logits, expert_params, tg, ti,
+            x, router_logits, expert_params, tg, ti, expert_aux,
             expert_fn=expert_fn, axis_name=None, num_experts=num_experts,
             capacity=capacity, top_k=top_k, return_stats=return_stats,
         )
@@ -334,9 +391,16 @@ def expert_parallel_moe(
         num_experts=num_experts, capacity=capacity, top_k=top_k,
         return_stats=return_stats,
     )
-    out_specs = (
-        (P(), {"moe_dropped_fraction": P()}) if return_stats else P()
-    )
+    has_extras = return_stats or expert_aux is not None
+    out_specs = (P(), P()) if has_extras else P()
+    if expert_aux is not None:
+        aux_spec = jax.tree_util.tree_map(lambda _: P(), expert_aux)
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(), P(), expert_spec, P(), P(), aux_spec),
+            out_specs=out_specs,
+            check_vma=False,
+        )(x, router_logits, expert_params, tg, ti, expert_aux)
     if topk is not None:
         return jax.shard_map(
             fn, mesh=mesh,

@@ -312,7 +312,8 @@ def _ring_attention_local_masked(q, k, v, mask, *, axis_name: str,
 
 
 def _ring_attention_local_einsum(q, k, v, mask=None, *, axis_name: str,
-                                 axis_size: int, causal: bool, n_rep: int):
+                                 axis_size: int, causal: bool, n_rep: int,
+                                 window: int | None = None):
     my_idx = jax.lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -327,14 +328,20 @@ def _ring_attention_local_einsum(q, k, v, mask=None, *, axis_name: str,
         kf = _repeat_heads(k_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
         vf = _repeat_heads(v_cur, n_rep).astype(jnp.float32).transpose(0, 2, 1, 3)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
-        if causal:
+        if causal or window is not None:
+            # GLOBAL positions: this device's query chunk vs the held key
+            # chunk's owner — the band is exact across chunk boundaries
             q_pos = my_idx * s_local + jax.lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 0
             )
             k_pos = src * s_local + jax.lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 1
             )
-            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+            vis = (q_pos >= k_pos) if causal else (q_pos == q_pos)
+            if window is not None:
+                # Mistral band: keys visible iff q - key < window
+                vis = vis & (q_pos - k_pos < window)
+            s = jnp.where(vis[None, None], s, NEG_INF)
         if m_cur is not None:
             s = jnp.where((m_cur > 0)[:, None, None, :], s, NEG_INF)
         m_new = jnp.maximum(row_max, jnp.max(s, axis=-1))
@@ -381,6 +388,7 @@ def ring_attention(
     mask: jax.Array | None = None,
     mesh=None,
     axis_name: str = AXIS_SEQ,
+    window: int | None = None,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over the mesh `seq` axis.
 
@@ -393,7 +401,18 @@ def ring_attention(
     same `seq` axis and each chunk rotates the ring with its K/V, so padded
     fine-tuning batches keep the ring fast path (the kernel applies it in
     forward AND backward).
+
+    `window` applies Mistral-style sliding-window attention (keys visible
+    iff q - key < window; requires `causal=True`). The windowed ring runs
+    the einsum fold with exact global-position banding — the pallas ring
+    kernel has no cross-chunk band offsets (yet), and at ring scale the
+    window keeps per-chunk score matrices small anyway.
     """
+    if window is not None and not causal:
+        # validated BEFORE the off-mesh fallback so single-device debug runs
+        # fail the same way pod runs do
+        raise ValueError("ring_attention window requires causal=True "
+                         "(Mistral sliding-window semantics)")
     if mesh is None:
         from ..state import PartialState
 
@@ -412,7 +431,7 @@ def ring_attention(
 
         return dot_product_attention(q, _repeat_heads(k, q.shape[2] // k.shape[2]),
                                      _repeat_heads(v, q.shape[2] // v.shape[2]),
-                                     mask=mask, causal=causal)
+                                     mask=mask, causal=causal, window=window)
     if mask is not None and mask.shape != (q.shape[0], k.shape[1]):
         raise ValueError(
             f"ring_attention mask must be a [B, S_k] key-padding mask; got "
@@ -424,7 +443,9 @@ def ring_attention(
     s_local = q.shape[1] // axis_size
     interpret = jax.devices()[0].platform != "tpu"
     blk = _chunk_blocks(s_local)
-    use_kernel = blk >= 16 and s_local % blk == 0
+    # the pallas ring kernel carries no cross-chunk band offsets: windowed
+    # rings run the (exact) einsum fold
+    use_kernel = blk >= 16 and s_local % blk == 0 and window is None
 
     seq_spec = P(None, axis_name, None, None)
     mask_spec = P(None, axis_name)
@@ -448,7 +469,7 @@ def ring_attention(
     else:
         fn = partial(
             _ring_attention_local_einsum, axis_name=axis_name,
-            axis_size=axis_size, causal=causal, n_rep=n_rep,
+            axis_size=axis_size, causal=causal, n_rep=n_rep, window=window,
         )
         if mask is not None:
             return jax.shard_map(

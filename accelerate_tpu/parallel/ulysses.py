@@ -25,7 +25,7 @@ from ..utils.constants import AXIS_SEQ
 
 
 def _ulysses_local(q, k, v, mask=None, *, axis_name: str, causal: bool,
-                   n_rep: int):
+                   n_rep: int, window: int | None = None):
     """Runs INSIDE shard_map. q: [B, S_local, H, D], k/v: [B, S_local,
     Hkv, D] — this device's sequence chunk. all_to_all trades the head dim
     for the sequence dim so attention sees the full sequence; GQA K/V
@@ -56,7 +56,10 @@ def _ulysses_local(q, k, v, mask=None, *, axis_name: str, causal: bool,
         # the [B, S/P] mask chunk is tiny next to K/V: one all_gather
         # rebuilds the full [B, S] key mask every device needs
         mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
-    out = flash_attention(q_full, k_full, v_full, causal=causal, mask=mask)
+    # after the head scatter the device holds the FULL sequence, so the
+    # sliding-window band applies exactly as in single-device flash
+    out = flash_attention(q_full, k_full, v_full, causal=causal, mask=mask,
+                          window=window)
     return gather_heads(out)
 
 
@@ -68,6 +71,7 @@ def ulysses_attention(
     mask: jax.Array | None = None,
     mesh=None,
     axis_name: str = AXIS_SEQ,
+    window: int | None = None,
 ) -> jax.Array:
     """[B, S, H, D] attention with S sharded over the mesh `seq` axis via
     head-scatter all-to-all. K/V may carry fewer (GQA) heads — when the kv
@@ -75,8 +79,15 @@ def ulysses_attention(
     traffic) and repeat locally after the collective; otherwise they repeat
     up-front to keep the all_to_all legal. `mask` is a [B, S] key-padding
     mask (1 = attend), sharded over the seq axis and all-gathered inside.
-    Falls back to plain attention when no seq axis exists or shapes don't
-    divide."""
+    `window` applies Mistral-style sliding-window attention (keys visible
+    iff q - key < window) — the post-scatter attention sees the full
+    sequence, so the band rides the flash kernel unchanged. Falls back to
+    plain attention when no seq axis exists or shapes don't divide."""
+    if window is not None and not causal:
+        # same check as ring_attention, BEFORE any fallback: off-mesh and
+        # on-mesh calls must fail identically for invalid arguments
+        raise ValueError("ulysses_attention window requires causal=True "
+                         "(Mistral sliding-window semantics)")
     if mesh is None:
         from ..state import PartialState
 
@@ -103,7 +114,7 @@ def ulysses_attention(
 
         return dot_product_attention(q, repeat_kv(k, n_rep),
                                      repeat_kv(v, n_rep), mask=mask,
-                                     causal=causal)
+                                     causal=causal, window=window)
     if mask is not None and mask.shape != (q.shape[0], k.shape[1]):
         raise ValueError(
             f"ulysses_attention mask must be a [B, S_k] key-padding mask; "
@@ -112,7 +123,7 @@ def ulysses_attention(
 
     seq_spec = P(None, axis_name, None, None)
     fn = partial(_ulysses_local, axis_name=axis_name, causal=causal,
-                 n_rep=n_rep)
+                 n_rep=n_rep, window=window)
     if mask is not None:
         return jax.shard_map(
             fn, mesh=mesh,

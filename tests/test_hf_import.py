@@ -422,15 +422,23 @@ def test_mistral_generate_parity_beyond_window():
     _assert_greedy_match(hf_model, ids, 10, got, prompt_len=12)
 
 
-def test_ring_backend_refuses_sliding_window():
+def test_ring_and_ulysses_backends_run_sliding_window():
+    """Windowed CP: ring (global-position banding) and ulysses (band after
+    the head scatter) must match the einsum path's sliding-window logits."""
     import jax
 
     from accelerate_tpu.models import llama
 
-    cfg = llama.LlamaConfig.tiny(sliding_window=8, attention_backend="ring")
-    params = llama.init_params(cfg, jax.random.key(0))
-    with pytest.raises(NotImplementedError, match="sliding-window"):
-        llama.forward(cfg, params, np.zeros((1, 16), np.int32))
+    ids = np.random.default_rng(55).integers(0, 256, (1, 16)).astype(np.int32)
+    ref_cfg = llama.LlamaConfig.tiny(sliding_window=8,
+                                     attention_backend="einsum")
+    params = llama.init_params(ref_cfg, jax.random.key(0))
+    ref = np.asarray(llama.forward(ref_cfg, params, ids))
+    for backend in ("ring", "ulysses"):
+        cfg = llama.LlamaConfig.tiny(sliding_window=8,
+                                     attention_backend=backend)
+        got = np.asarray(llama.forward(cfg, params, ids))
+        np.testing.assert_allclose(got, ref, atol=2e-4, err_msg=backend)
 
 
 def test_gptj_logit_parity():

@@ -240,3 +240,23 @@ def test_llama_sequence_parallel_matches_unconstrained():
     for a, b in zip(jax.tree_util.tree_leaves(g),
                     jax.tree_util.tree_leaves(g_sp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_mixtral_sequence_parallel_matches_unconstrained():
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import mixtral
+
+    Accelerator(mesh_config=MeshConfig(axes={"data": 2, "model": 4}))
+    cfg = mixtral.MixtralConfig.tiny()
+    cfg_sp = dc.replace(cfg, sequence_parallel=True)
+    params = mixtral.init_params(cfg, jax.random.key(0))
+    ids = jax.random.randint(jax.random.key(1), (2, 33), 0, cfg.vocab_size)
+    batch = {"input_ids": ids}
+    loss = jax.jit(lambda p: mixtral.causal_lm_loss(cfg, p, batch))(params)
+    loss_sp = jax.jit(lambda p: mixtral.causal_lm_loss(cfg_sp, p, batch))(params)
+    np.testing.assert_allclose(float(loss), float(loss_sp), rtol=1e-5)
