@@ -21,6 +21,7 @@ import numpy as np
 
 from .common import (
     dense,
+    dense_maybe_fp8,
     normal_init,
     rms_norm,
     token_nll,
@@ -139,14 +140,20 @@ def _position_bias(rel_embedding, q_len: int, k_len: int, bidirectional: bool,
     return rel_embedding[buckets].transpose(2, 0, 1)  # [H, q, k]
 
 
-def _t5_attention(config: T5Config, proj, x, kv_src, bias, mask):
-    """T5 attention: NO 1/sqrt(d) scaling; additive position bias."""
+def _t5_attention(config: T5Config, proj, x, kv_src, bias, mask, fp8=None):
+    """T5 attention: NO 1/sqrt(d) scaling; additive position bias. Always
+    returns (out, new_fp8_or_None); with `fp8` ({q,k,v,o} meta pairs) the
+    projections run the delayed-scaled swap point."""
     b, sq, _ = x.shape
     sk = kv_src.shape[1]
     nh, dk = config.num_heads, config.d_kv
-    q = dense(x, proj["q"]["kernel"]).reshape(b, sq, nh, dk)
-    k = dense(kv_src, proj["k"]["kernel"]).reshape(b, sk, nh, dk)
-    v = dense(kv_src, proj["v"]["kernel"]).reshape(b, sk, nh, dk)
+    f = fp8 or {}
+    q, m_q = dense_maybe_fp8(x, proj["q"]["kernel"], f.get("q"))
+    k, m_k = dense_maybe_fp8(kv_src, proj["k"]["kernel"], f.get("k"))
+    v, m_v = dense_maybe_fp8(kv_src, proj["v"]["kernel"], f.get("v"))
+    q = q.reshape(b, sq, nh, dk)
+    k = k.reshape(b, sk, nh, dk)
+    v = v.reshape(b, sk, nh, dk)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32)
     if bias is not None:
@@ -156,22 +163,35 @@ def _t5_attention(config: T5Config, proj, x, kv_src, bias, mask):
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
                      preferred_element_type=jnp.float32).astype(x.dtype)
-    return dense(out.reshape(b, sq, nh * dk), proj["o"]["kernel"])
+    o, m_o = dense_maybe_fp8(out.reshape(b, sq, nh * dk), proj["o"]["kernel"],
+                             f.get("o"))
+    new_fp8 = (
+        {"q": m_q, "k": m_k, "v": m_v, "o": m_o} if fp8 is not None else None
+    )
+    return o, new_fp8
 
 
-def _t5_mlp(config: T5Config, layer, x):
+def _t5_mlp(config: T5Config, layer, x, fp8=None):
+    f = fp8 or {}
     if config.is_gated_act:
-        g = jax.nn.gelu(
-            dense(x, layer["wi_0"]["kernel"]).astype(jnp.float32),
-            approximate=True,
-        ).astype(x.dtype)
-        y = g * dense(x, layer["wi_1"]["kernel"])
+        g0, m_0 = dense_maybe_fp8(x, layer["wi_0"]["kernel"], f.get("wi_0"))
+        g = jax.nn.gelu(g0.astype(jnp.float32), approximate=True).astype(x.dtype)
+        u, m_1 = dense_maybe_fp8(x, layer["wi_1"]["kernel"], f.get("wi_1"))
+        y = g * u.astype(x.dtype)
+        new_fp8 = {"wi_0": m_0, "wi_1": m_1} if fp8 is not None else None
     else:
-        y = jax.nn.relu(dense(x, layer["wi"]["kernel"]))
-    return dense(y, layer["wo"]["kernel"])
+        y0, m_i = dense_maybe_fp8(x, layer["wi"]["kernel"], f.get("wi"))
+        y = jax.nn.relu(y0)
+        new_fp8 = {"wi": m_i} if fp8 is not None else None
+    o, m_o = dense_maybe_fp8(y, layer["wo"]["kernel"], f.get("wo"))
+    if fp8 is not None:
+        new_fp8["wo"] = m_o
+    return o, new_fp8
 
 
-def _encoder(config: T5Config, params, input_ids, enc_mask):
+def _encoder(config: T5Config, params, input_ids, enc_mask, fp8=None):
+    """Encoded states; with `fp8` (the "encoder" subtree of
+    init_fp8_state's layout) returns (enc, new_fp8)."""
     eps = config.layer_norm_epsilon
     x = params["shared"]["embedding"][input_ids]
     s = input_ids.shape[1]
@@ -182,16 +202,26 @@ def _encoder(config: T5Config, params, input_ids, enc_mask):
     )
     pad = enc_mask[:, None, None, :] if enc_mask is not None else None
 
-    def body(carry, layer):
+    def body(carry, xs):
+        layer, f = xs if fp8 is not None else (xs, None)
         x = carry
         h = rms_norm(x, layer["ln_attn"]["scale"], eps)
-        x = x + _t5_attention(config, layer["attn"], h, h, bias, pad)
-        x = x + _t5_mlp(config, layer["mlp"],
-                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
-        return x, None
+        a, m_a = _t5_attention(config, layer["attn"], h, h, bias, pad,
+                               fp8=None if f is None else f["attn"])
+        x = x + a
+        m, m_m = _t5_mlp(config, layer["mlp"],
+                         rms_norm(x, layer["ln_mlp"]["scale"], eps),
+                         fp8=None if f is None else f["mlp"])
+        ys = {"attn": m_a, "mlp": m_m} if f is not None else None
+        return x + m, ys
 
-    x, _ = jax.lax.scan(body, x, params["encoder"]["layers"])
-    return rms_norm(x, params["encoder"]["final_ln"]["scale"], eps)
+    xs = (
+        (params["encoder"]["layers"], fp8["layers"])
+        if fp8 is not None else params["encoder"]["layers"]
+    )
+    x, new_fp8 = jax.lax.scan(body, x, xs)
+    out = rms_norm(x, params["encoder"]["final_ln"]["scale"], eps)
+    return (out, {"layers": new_fp8}) if fp8 is not None else out
 
 
 def forward(
@@ -200,22 +230,30 @@ def forward(
     input_ids: jax.Array,
     decoder_input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
-) -> jax.Array:
+    fp8_state: dict | None = None,
+) -> jax.Array | tuple:
     """Logits [B, S_dec, V] of the decoder given encoder inputs.
 
     Runs under float32 matmul precision: T5's unscaled attention and
     large activation magnitudes (the same property behind torch-side fp16
     T5 overflow) amplify the TPU's default bf16-input matmul rounding to
-    ~0.15 absolute logit error; full f32 restores HF parity to ~3e-4."""
+    ~0.15 absolute logit error; full f32 restores HF parity to ~3e-4.
+
+    With `fp8_state` (see `init_fp8_state`), encoder/decoder projections
+    run the delayed-scaled fp8 matmul (its own scale management makes the
+    f32-precision note moot for those matmuls) and the result is
+    (logits, new_fp8_state)."""
     with jax.default_matmul_precision("float32"):
         return _forward_f32(config, params, input_ids, decoder_input_ids,
-                            attention_mask)
+                            attention_mask, fp8_state)
 
 
 def _forward_f32(config, params, input_ids, decoder_input_ids,
-                 attention_mask):
+                 attention_mask, fp8_state=None):
     eps = config.layer_norm_epsilon
-    enc = _encoder(config, params, input_ids, attention_mask)
+    enc_out = _encoder(config, params, input_ids, attention_mask,
+                       fp8=None if fp8_state is None else fp8_state["encoder"])
+    enc, enc_fp8 = enc_out if fp8_state is not None else (enc_out, None)
 
     x = params["shared"]["embedding"][decoder_input_ids]
     sd = decoder_input_ids.shape[1]
@@ -229,31 +267,56 @@ def _forward_f32(config, params, input_ids, decoder_input_ids,
         attention_mask[:, None, None, :] if attention_mask is not None else None
     )
 
-    def body(carry, layer):
-        x = carry
+    def layer_step(x, layer, f):
+        sub = (lambda k: None if f is None else f[k])  # noqa: E731
         h = rms_norm(x, layer["ln_self"]["scale"], eps)
-        x = x + _t5_attention(config, layer["self_attn"], h, h, self_bias,
-                              causal)
+        a, m_s = _t5_attention(config, layer["self_attn"], h, h, self_bias,
+                               causal, fp8=sub("self_attn"))
+        x = x + a
         h = rms_norm(x, layer["ln_cross"]["scale"], eps)
-        x = x + _t5_attention(config, layer["cross_attn"], h, enc, None,
-                              cross_mask)
-        x = x + _t5_mlp(config, layer["mlp"],
-                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
-        return x, None
+        c, m_c = _t5_attention(config, layer["cross_attn"], h, enc, None,
+                               cross_mask, fp8=sub("cross_attn"))
+        x = x + c
+        m, m_m = _t5_mlp(config, layer["mlp"],
+                         rms_norm(x, layer["ln_mlp"]["scale"], eps),
+                         fp8=sub("mlp"))
+        new_fp8 = (
+            {"self_attn": m_s, "cross_attn": m_c, "mlp": m_m}
+            if f is not None else None
+        )
+        return x + m, new_fp8
 
-    x, _ = jax.lax.scan(body, x, params["decoder"]["layers"])
+    if fp8_state is not None:
+        def body(carry, xs):
+            layer, f = xs
+            return layer_step(carry, layer, f)
+
+        x, dec_fp8 = jax.lax.scan(
+            body, x, (params["decoder"]["layers"],
+                      fp8_state["decoder"]["layers"])
+        )
+    else:
+        def body(carry, layer):
+            return layer_step(carry, layer, None)
+
+        x, _ = jax.lax.scan(body, x, params["decoder"]["layers"])
     x = rms_norm(x, params["decoder"]["final_ln"]["scale"], eps)
     if config.tie_word_embeddings:
         # tied head scales hidden by d_model^-0.5 (HF T5 convention)
         x = x * (config.d_model ** -0.5)
-        return jnp.einsum(
+        logits = jnp.einsum(
             "bsh,vh->bsv", x, params["shared"]["embedding"].astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
-    return jnp.einsum(
-        "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    if fp8_state is not None:
+        return logits, {"encoder": enc_fp8,
+                        "decoder": {"layers": dec_fp8}}
+    return logits
 
 
 # --- incremental decode (the T0pp row of the reference's benchmark, ref
@@ -369,7 +432,7 @@ def _decode_step_f32(config, params, decoder_ids, positions, state):
                               xk_l.astype(h.dtype), xv_l.astype(h.dtype),
                               cross_mask)
         x = x + _t5_mlp(config, layer["mlp"],
-                        rms_norm(x, layer["ln_mlp"]["scale"], eps))
+                        rms_norm(x, layer["ln_mlp"]["scale"], eps))[0]
         return x, (nk, nv)
 
     xs = (params["decoder"]["layers"], state["self_k"], state["self_v"],
@@ -460,9 +523,9 @@ def _enc_layer_program(config: T5Config):
     def enc_layer(layer, x, bias, pad):
         with jax.default_matmul_precision("float32"):
             h = rms_norm(x, layer["ln_attn"]["scale"], eps)
-            x = x + _t5_attention(config, layer["attn"], h, h, bias, pad)
+            x = x + _t5_attention(config, layer["attn"], h, h, bias, pad)[0]
             x = x + _t5_mlp(config, layer["mlp"],
-                            rms_norm(x, layer["ln_mlp"]["scale"], eps))
+                            rms_norm(x, layer["ln_mlp"]["scale"], eps))[0]
         return x
 
     return enc_layer
@@ -534,14 +597,39 @@ def streamed_generate(config: T5Config, params: dict, input_ids,
     return decode_all(dec_params, state, start, steps, keys)
 
 
-def seq2seq_loss(config: T5Config, params: dict, batch: dict) -> jax.Array:
-    """batch: input_ids, decoder_input_ids, labels, attention_mask?"""
-    logits = forward(config, params, batch["input_ids"],
-                     batch["decoder_input_ids"],
-                     batch.get("attention_mask"))
+def init_fp8_state(config: T5Config, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for every encoder/decoder projection
+    (shared builder: ops/fp8.py stacked_fp8_metas per stack; honors the
+    Accelerator's FP8RecipeKwargs). Layout mirrors the param tree:
+    {"encoder": {"layers": ...}, "decoder": {"layers": ...}}."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    attn = ("q", "k", "v", "o")
+    mlp = ("wi_0", "wi_1", "wo") if config.is_gated_act else ("wi", "wo")
+    return {
+        "encoder": stacked_fp8_metas(
+            config.num_layers, {"attn": attn, "mlp": mlp}, history_len),
+        "decoder": stacked_fp8_metas(
+            config.num_decoder_layers,
+            {"self_attn": attn, "cross_attn": attn, "mlp": mlp},
+            history_len),
+    }
+
+
+def seq2seq_loss(config: T5Config, params: dict, batch: dict,
+                 fp8_state: dict | None = None) -> jax.Array | tuple:
+    """batch: input_ids, decoder_input_ids, labels, attention_mask?
+    With `fp8_state` (mixed_precision="fp8") returns
+    (loss, new_fp8_state)."""
+    out = forward(config, params, batch["input_ids"],
+                  batch["decoder_input_ids"],
+                  batch.get("attention_mask"), fp8_state=fp8_state)
+    logits, new_fp8 = out if fp8_state is not None else (out, None)
     nll = token_nll(logits, batch["labels"])
     mask = batch.get("labels_mask")
     if mask is None:
-        return jnp.mean(nll)
-    m = mask.astype(jnp.float32)
-    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
+        loss = jnp.mean(nll)
+    else:
+        m = mask.astype(jnp.float32)
+        loss = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
+    return (loss, new_fp8) if fp8_state is not None else loss

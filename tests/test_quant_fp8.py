@@ -559,3 +559,75 @@ def test_zoo_fp8_decode_refused(family):
     with pytest.raises(ValueError, match="fp8"):
         mod.forward(cfg, params, jnp.zeros((2, 4), jnp.int32),
                     kv_caches=caches, fp8_state=mod.init_fp8_state(cfg))
+
+
+def test_t5_fp8_train_step_converges():
+    """fp8 across the enc-dec T5 family: the seq2seq loss threads
+    encoder/decoder metas and trains under mixed_precision='fp8'."""
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import t5
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    cfg = t5.T5Config.tiny()
+    acc = Accelerator(mixed_precision="fp8")
+    params = t5.init_params(cfg, jax.random.key(6))
+    ts = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(5e-3),
+        fp8_state=t5.init_fp8_state(cfg),
+    )
+    rng = np.random.default_rng(6)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)),
+                                 jnp.int32),
+        "decoder_input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (4, 12)), jnp.int32),
+        "labels": jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 12)),
+                              jnp.int32),
+    }
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: t5.seq2seq_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    losses = []
+    for _ in range(12):
+        ts, m = step(ts, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.5, losses
+    scale = ts.fp8_state["decoder"]["layers"]["cross_attn"]["q"]["x"].scale
+    assert scale.shape == (cfg.num_decoder_layers,)
+    assert not np.allclose(np.asarray(scale), 1.0)
+
+
+def test_t5_fp8_forward_close_to_f32():
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny()
+    params = t5.init_params(cfg, jax.random.key(7))
+    rng = np.random.default_rng(7)
+    enc_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    dec_ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32)
+    ref = t5.forward(cfg, params, enc_ids, dec_ids)
+    out, new_state = t5.forward(cfg, params, enc_ids, dec_ids,
+                                fp8_state=t5.init_fp8_state(cfg))
+    err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+    assert err < 0.35, err
+    assert "encoder" in new_state and "decoder" in new_state
+
+
+def test_t5_fp8_ungated_variant():
+    """relu (non-gated) T5 has a different mlp projection set — the metas
+    layout must follow is_gated_act."""
+    from accelerate_tpu.models import t5
+
+    cfg = t5.T5Config.tiny(is_gated_act=False)
+    st = t5.init_fp8_state(cfg)
+    assert set(st["encoder"]["layers"]["mlp"]) == {"wi", "wo"}
+    params = t5.init_params(cfg, jax.random.key(8))
+    ids = jnp.zeros((1, 8), jnp.int32)
+    out, _ = t5.forward(cfg, params, ids, ids, fp8_state=st)
+    assert np.isfinite(np.asarray(out)).all()
