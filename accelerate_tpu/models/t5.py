@@ -203,7 +203,7 @@ def _encoder(config: T5Config, params, input_ids, enc_mask, fp8=None):
     pad = enc_mask[:, None, None, :] if enc_mask is not None else None
 
     def body(carry, xs):
-        layer, f = xs if fp8 is not None else (xs, None)
+        layer, f = xs
         x = carry
         h = rms_norm(x, layer["ln_attn"]["scale"], eps)
         a, m_a = _t5_attention(config, layer["attn"], h, h, bias, pad,
@@ -215,11 +215,12 @@ def _encoder(config: T5Config, params, input_ids, enc_mask, fp8=None):
         ys = {"attn": m_a, "mlp": m_m} if f is not None else None
         return x + m, ys
 
-    xs = (
-        (params["encoder"]["layers"], fp8["layers"])
-        if fp8 is not None else params["encoder"]["layers"]
+    # None is an empty pytree: one body serves both paths
+    x, new_fp8 = jax.lax.scan(
+        body, x,
+        (params["encoder"]["layers"],
+         None if fp8 is None else fp8["layers"]),
     )
-    x, new_fp8 = jax.lax.scan(body, x, xs)
     out = rms_norm(x, params["encoder"]["final_ln"]["scale"], eps)
     return (out, {"layers": new_fp8}) if fp8 is not None else out
 
@@ -286,20 +287,17 @@ def _forward_f32(config, params, input_ids, decoder_input_ids,
         )
         return x + m, new_fp8
 
-    if fp8_state is not None:
-        def body(carry, xs):
-            layer, f = xs
-            return layer_step(carry, layer, f)
+    def body(carry, xs):
+        layer, f = xs
+        return layer_step(carry, layer, f)
 
-        x, dec_fp8 = jax.lax.scan(
-            body, x, (params["decoder"]["layers"],
-                      fp8_state["decoder"]["layers"])
-        )
-    else:
-        def body(carry, layer):
-            return layer_step(carry, layer, None)
-
-        x, _ = jax.lax.scan(body, x, params["decoder"]["layers"])
+    # None is an empty pytree: scan slices only the layer leaves when fp8
+    # is off, so one body serves both paths (same shape as _encoder)
+    x, dec_fp8 = jax.lax.scan(
+        body, x,
+        (params["decoder"]["layers"],
+         None if fp8_state is None else fp8_state["decoder"]["layers"]),
+    )
     x = rms_norm(x, params["decoder"]["final_ln"]["scale"], eps)
     if config.tie_word_embeddings:
         # tied head scales hidden by d_model^-0.5 (HF T5 convention)

@@ -367,8 +367,25 @@ def _ring_attention_local_einsum(q, k, v, mask=None, *, axis_name: str,
         if m_cur is not None:
             m_cur = jax.lax.ppermute(m_cur, axis_name, perm)
         src = (my_idx - step) % axis_size  # owner of the chunk we now hold
-        acc, row_max, row_sum = fold_chunk(acc, row_max, row_sum, k_cur,
-                                           v_cur, m_cur, src)
+
+        # skip chunks with NO visible pair: future chunks under causality,
+        # and chunks entirely past the sliding window's reach — the latter
+        # turns the windowed ring's compute from O(S^2/P) into O(S*W/P)
+        vis = jnp.bool_(True)
+        if causal:
+            vis = src <= my_idx
+        if window is not None:
+            # closest pair of the chunk: (my-src)*s_local - (s_local-1)
+            vis = vis & ((my_idx - src) * s_local < window + s_local - 1)
+
+        def live(_):
+            return fold_chunk(acc, row_max, row_sum, k_cur, v_cur, m_cur,
+                              src)
+
+        def dead(_):
+            return acc, row_max, row_sum
+
+        acc, row_max, row_sum = jax.lax.cond(vis, live, dead, None)
         return (acc, row_max, row_sum, k_cur, v_cur, m_cur), None
 
     if axis_size > 1:

@@ -1212,3 +1212,21 @@ def test_ulysses_attention_sliding_window_matches_reference():
     ref = dot_product_attention(q, k, v, causal=True, window=9)
     out = ulysses_attention(q, k, v, causal=True, mesh=mesh, window=9)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_window_with_padding_mask():
+    """Sliding window + key-padding mask combine in the banded ring (and
+    out-of-band chunk skipping changes nothing numerically)."""
+    from accelerate_tpu.models.common import dot_product_attention
+    from accelerate_tpu.parallel import ring_attention
+
+    mesh = MeshConfig(axes={"seq": 8}).build()
+    q, k, v = make_qkv(jax.random.key(97), s=64)
+    mask = jnp.ones((2, 64), jnp.int32).at[:, 50:].set(0)
+    ref = dot_product_attention(q, k, v, causal=True, window=12,
+                                mask=mask)
+    out = ring_attention(q, k, v, causal=True, mesh=mesh, window=12,
+                         mask=mask)
+    # padded queries attend nothing; compare real-token rows
+    np.testing.assert_allclose(np.asarray(out)[:, :50],
+                               np.asarray(ref)[:, :50], atol=2e-5)
