@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from .common import (
     cross_entropy_loss,
     dense,
+    dense_maybe_fp8,
     dot_product_attention,
     init_dense,
     layer_norm,
@@ -89,25 +90,41 @@ def init_params(config: BertConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     }
 
 
-def _layer_body(config: BertConfig, x, layer, mask):
+def _layer_body(config: BertConfig, x, layer, mask, fp8=None):
     b, s, h = x.shape
     nh, hd = config.num_attention_heads, config.head_dim
     a = layer["attn"]
-    q = dense(x, a["q_proj"]["kernel"], a["q_proj"]["bias"]).reshape(b, s, nh, hd)
-    k = dense(x, a["k_proj"]["kernel"], a["k_proj"]["bias"]).reshape(b, s, nh, hd)
-    v = dense(x, a["v_proj"]["kernel"], a["v_proj"]["bias"]).reshape(b, s, nh, hd)
+    fa = fp8["attn"] if fp8 is not None else {}
+    fm = fp8["mlp"] if fp8 is not None else {}
+    q, m_q = dense_maybe_fp8(x, a["q_proj"]["kernel"], fa.get("q_proj"),
+                             a["q_proj"]["bias"])
+    k, m_k = dense_maybe_fp8(x, a["k_proj"]["kernel"], fa.get("k_proj"),
+                             a["k_proj"]["bias"])
+    v, m_v = dense_maybe_fp8(x, a["v_proj"]["kernel"], fa.get("v_proj"),
+                             a["v_proj"]["bias"])
+    q, k, v = (t.reshape(b, s, nh, hd) for t in (q, k, v))
     attn = dot_product_attention(q, k, v, mask=mask).reshape(b, s, h)
-    attn = dense(attn, a["o_proj"]["kernel"], a["o_proj"]["bias"])
+    attn, m_o = dense_maybe_fp8(attn, a["o_proj"]["kernel"],
+                                fa.get("o_proj"), a["o_proj"]["bias"])
     x = layer_norm(x + attn, layer["attention_layernorm"]["scale"],
                    layer["attention_layernorm"]["bias"], config.layer_norm_eps)
     m = layer["mlp"]
     # exact (erf) GELU — what BERT checkpoints were trained with; the tanh
     # approximation diverges enough to break logit parity with HF weights
-    hmid = jax.nn.gelu(dense(x, m["up_proj"]["kernel"], m["up_proj"]["bias"]),
-                       approximate=False)
-    out = dense(hmid, m["down_proj"]["kernel"], m["down_proj"]["bias"])
+    hmid, m_up = dense_maybe_fp8(x, m["up_proj"]["kernel"],
+                                 fm.get("up_proj"), m["up_proj"]["bias"])
+    hmid = jax.nn.gelu(hmid, approximate=False)
+    out, m_dn = dense_maybe_fp8(hmid, m["down_proj"]["kernel"],
+                                fm.get("down_proj"), m["down_proj"]["bias"])
+    new_fp8 = (
+        {"attn": {"q_proj": m_q, "k_proj": m_k, "v_proj": m_v,
+                  "o_proj": m_o},
+         "mlp": {"up_proj": m_up, "down_proj": m_dn}}
+        if fp8 is not None else None
+    )
     return layer_norm(x + out, layer["output_layernorm"]["scale"],
-                      layer["output_layernorm"]["bias"], config.layer_norm_eps)
+                      layer["output_layernorm"]["bias"],
+                      config.layer_norm_eps), new_fp8
 
 
 def forward(
@@ -116,8 +133,11 @@ def forward(
     input_ids: jax.Array,
     attention_mask: jax.Array | None = None,
     token_type_ids: jax.Array | None = None,
-) -> jax.Array:
-    """Pooled logits [B, num_labels]."""
+    fp8_state=None,
+) -> jax.Array | tuple:
+    """Pooled logits [B, num_labels]; with `fp8_state` (see
+    `init_fp8_state`) layer projections run fp8 and the result is
+    (logits, new_fp8_state)."""
     b, s = input_ids.shape
     x = params["embed_tokens"]["embedding"][input_ids]
     x = x + params["position_embeddings"]["embedding"][jnp.arange(s)][None]
@@ -128,20 +148,50 @@ def forward(
                    params["embeddings_layernorm"]["bias"], config.layer_norm_eps)
     mask = attention_mask.astype(jnp.bool_) if attention_mask is not None else None
 
-    def scan_body(carry, layer):
-        return _layer_body(config, carry, layer, mask), None
+    def scan_body(carry, xs):
+        layer, f = xs
+        y, nf = _layer_body(config, carry, layer, mask, fp8=f)
+        return y, nf
 
     if config.remat:
         scan_body = jax.checkpoint(scan_body, prevent_cse=False)
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    # None is an empty pytree: one body serves the fp8 and plain paths
+    x, new_fp8 = jax.lax.scan(
+        scan_body, x,
+        (params["layers"],
+         None if fp8_state is None else fp8_state["layers"]),
+    )
     pooled = jnp.tanh(dense(x[:, 0], params["pooler"]["kernel"], params["pooler"]["bias"]))
-    return dense(pooled, params["classifier"]["kernel"], params["classifier"]["bias"])
+    logits = dense(pooled, params["classifier"]["kernel"], params["classifier"]["bias"])
+    if fp8_state is not None:
+        return logits, {"layers": new_fp8}
+    return logits
 
 
-def classification_loss(config: BertConfig, params: dict, batch: dict) -> jax.Array:
-    logits = forward(
+def init_fp8_state(config: BertConfig, history_len: int | None = None) -> dict:
+    """Per-layer delayed-scaling metas for the six layer projections
+    (shared builder: ops/fp8.py stacked_fp8_metas; honors the Accelerator's
+    FP8RecipeKwargs). The pooler/classifier heads stay full precision —
+    they are tiny and feed the loss directly."""
+    from ..ops.fp8 import stacked_fp8_metas
+
+    return stacked_fp8_metas(config.num_hidden_layers, {
+        "attn": ("q_proj", "k_proj", "v_proj", "o_proj"),
+        "mlp": ("up_proj", "down_proj"),
+    }, history_len)
+
+
+def classification_loss(config: BertConfig, params: dict, batch: dict,
+                        fp8_state=None) -> jax.Array | tuple:
+    """Cross-entropy over pooled logits; with `fp8_state`
+    (mixed_precision="fp8") returns (loss, new_fp8_state)."""
+    out = forward(
         config, params, batch["input_ids"],
         attention_mask=batch.get("attention_mask"),
         token_type_ids=batch.get("token_type_ids"),
+        fp8_state=fp8_state,
     )
-    return cross_entropy_loss(logits, batch["labels"])
+    if fp8_state is not None:
+        logits, new_fp8 = out
+        return cross_entropy_loss(logits, batch["labels"]), new_fp8
+    return cross_entropy_loss(out, batch["labels"])

@@ -1,0 +1,84 @@
+"""Feature: low-precision training — fp8 matmuls + int8 Adam moments.
+
+The reference reaches fp8 through transformer-engine kwargs and 8-bit Adam
+through bitsandbytes (ref accelerator.py fp8 recipe handling, utils/bnb.py);
+here both are native: `mixed_precision="fp8"` drives the delayed-scaling
+fp8 path of any bundled model (the loss fn takes an `fp8_state` kwarg and
+returns `(loss, new_fp8_state)`), and `accelerate_tpu.adamw_8bit` stores
+Adam moments as int8 blocks (~2.06 bytes/param), the recipe that fits
+multi-billion-parameter training on one 16 GB chip
+(docs/performance.md, benchmarks/mfu_table.py 1.5B/2B rows).
+
+Run: python examples/by_feature/low_precision_training.py [--no_fp8]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+from accelerate_tpu import TrainState, adamw_8bit
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils import FP8RecipeKwargs, set_seed
+
+
+def training_function(args) -> dict:
+    use_fp8 = not args.no_fp8
+    accelerator = Accelerator(
+        mixed_precision="fp8" if use_fp8 else "bf16",
+        gradient_clipping=1.0,
+        # the recipe handler reaches every family's init_fp8_state
+        kwargs_handlers=[FP8RecipeKwargs(amax_history_len=16)],
+    )
+    set_seed(args.seed)
+
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(args.seed))
+    ts = accelerator.prepare(TrainState.create(
+        apply_fn=None, params=params,
+        tx=adamw_8bit(args.lr, weight_decay=0.01),   # int8 moments
+        fp8_state=llama.init_fp8_state(cfg) if use_fp8 else None,
+    ))
+
+    rng = np.random.default_rng(args.seed)
+    ids = rng.integers(0, cfg.vocab_size, (args.batch_size, 65)).astype(np.int32)
+    loader = accelerator.prepare([{"input_ids": ids}] * 8)
+
+    if use_fp8:
+        step = accelerator.train_step(
+            lambda p, b, fp8_state=None: llama.causal_lm_loss(
+                cfg, p, b, fp8_state=fp8_state))
+    else:
+        step = accelerator.train_step(
+            lambda p, b: llama.causal_lm_loss(cfg, p, b))
+    losses = []
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            ts, metrics = step(ts, batch)
+            losses.append(float(metrics["loss"]))
+        accelerator.print(f"epoch {epoch}: loss {losses[-1]:.4f}")
+    if use_fp8:
+        # delayed-scaling state really adapted
+        scale = ts.fp8_state["layers"]["attn"]["q_proj"]["x"].scale
+        accelerator.print(f"fp8 q_proj x-scale (per layer): {np.asarray(scale)}")
+    return {"first_loss": losses[0], "last_loss": losses[-1]}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--no_fp8", action="store_true",
+                        help="bf16 matmuls (int8 moments either way)")
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=5e-3)
+    parser.add_argument("--seed", type=int, default=42)
+    out = training_function(parser.parse_args())
+    assert out["last_loss"] < out["first_loss"], out
+    print("low_precision_training OK:", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,11 @@ def test_gspmd_gpt_pretraining_example():
         num_epochs=1, lr=3e-4, seed=0, tiny=True,
     ))
     assert metrics["lm_loss"] < 20
+
+
+def test_low_precision_training_example():
+    mod = _load("by_feature/low_precision_training.py")
+    metrics = mod.training_function(_Args(
+        no_fp8=False, batch_size=4, num_epochs=2, lr=5e-3, seed=0,
+    ))
+    assert metrics["last_loss"] < metrics["first_loss"]

@@ -631,3 +631,40 @@ def test_t5_fp8_ungated_variant():
     ids = jnp.zeros((1, 8), jnp.int32)
     out, _ = t5.forward(cfg, params, ids, ids, fp8_state=st)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_bert_fp8_train_step_converges():
+    """The classifier example model trains under mixed_precision='fp8' —
+    no family-level exceptions remain."""
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import bert
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    cfg = bert.BertConfig.tiny()
+    acc = Accelerator(mixed_precision="fp8")
+    params = bert.init_params(cfg, jax.random.key(9))
+    ts = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(5e-3),
+        fp8_state=bert.init_fp8_state(cfg),
+    )
+    rng = np.random.default_rng(9)
+    ids = rng.integers(4, cfg.vocab_size, (16, 24)).astype(np.int32)
+    labels = rng.integers(0, 2, (16,)).astype(np.int32)
+    ids[labels == 1, 4:8] = 20  # learnable signal
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: bert.classification_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    losses = []
+    for _ in range(20):
+        ts, m = step(ts, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] * 0.6, losses
+    scale = ts.fp8_state["layers"]["mlp"]["up_proj"]["x"].scale
+    assert not np.allclose(np.asarray(scale), 1.0)
