@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 capture queue: poll the TPU tunnel; when it answers, run the
+# queued benchmark captures in priority order. Safe to re-run; each capture
+# appends to bench_results/. Log: bench_results/capture_loop.log
+cd "$(dirname "$0")/.." || exit 1
+LOG=bench_results/capture_loop.log
+mkdir -p bench_results
+echo "[$(date)] capture loop start" >> "$LOG"
+for i in $(seq 1 72); do  # up to ~12h at 10-min intervals
+  if timeout 120 python -c "import jax; d=jax.devices()[0]; assert 'tpu' in (d.platform + getattr(d,'device_kind','')).lower()" 2>/dev/null; then
+    echo "[$(date)] TPU is back — capturing" >> "$LOG"
+    timeout 1200 python bench.py > bench_results/bench_r4.json 2>> "$LOG" \
+      && echo "[$(date)] bench.py done: $(cat bench_results/bench_r4.json)" >> "$LOG"
+    timeout 600 python benchmarks/tunnel_probe.py >> bench_results/tunnel_probe.jsonl 2>> "$LOG" \
+      && echo "[$(date)] tunnel_probe done" >> "$LOG"
+    timeout 900 python benchmarks/nlp_steps.py >> bench_results/nlp_steps.jsonl 2>> "$LOG" \
+      && echo "[$(date)] nlp_steps done" >> "$LOG"
+    timeout 3600 python benchmarks/mfu_table.py 1.5B 2B 2B-s4k >> bench_results/mfu_table_r4.txt 2>> "$LOG" \
+      && echo "[$(date)] mfu_table done" >> "$LOG"
+    timeout 5400 python benchmarks/run_big_model_rows.py gptj-6b --new_tokens 8 >> "$LOG" 2>&1
+    timeout 7200 python benchmarks/run_big_model_rows.py t0pp --new_tokens 8 >> "$LOG" 2>&1
+    timeout 14400 python benchmarks/run_big_model_rows.py gpt-neox-20b --new_tokens 1 >> "$LOG" 2>&1
+    timeout 18000 python benchmarks/run_big_model_rows.py opt-30b --new_tokens 1 >> "$LOG" 2>&1
+    echo "[$(date)] capture queue complete" >> "$LOG"
+    exit 0
+  fi
+  echo "[$(date)] tunnel still down (attempt $i)" >> "$LOG"
+  sleep 480
+done
+echo "[$(date)] gave up waiting for the tunnel" >> "$LOG"
