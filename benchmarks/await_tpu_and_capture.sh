@@ -9,8 +9,11 @@ echo "[$(date)] capture loop start" >> "$LOG"
 for i in $(seq 1 72); do  # up to ~12h at 10-min intervals
   if timeout 120 python -c "import jax; d=jax.devices()[0]; assert 'tpu' in (d.platform + getattr(d,'device_kind','')).lower()" 2>/dev/null; then
     echo "[$(date)] TPU is back — capturing" >> "$LOG"
-    timeout 1200 python bench.py > bench_results/bench_r4.json 2>> "$LOG" \
-      && echo "[$(date)] bench.py done: $(cat bench_results/bench_r4.json)" >> "$LOG"
+    # temp + mv: a timeout/crash must not truncate the last good capture
+    if timeout 1200 python bench.py > bench_results/.bench_r4.tmp 2>> "$LOG"; then
+      mv bench_results/.bench_r4.tmp bench_results/bench_r4.json
+      echo "[$(date)] bench.py done: $(cat bench_results/bench_r4.json)" >> "$LOG"
+    fi
     timeout 600 python benchmarks/tunnel_probe.py >> bench_results/tunnel_probe.jsonl 2>> "$LOG" \
       && echo "[$(date)] tunnel_probe done" >> "$LOG"
     timeout 900 python benchmarks/nlp_steps.py >> bench_results/nlp_steps.jsonl 2>> "$LOG" \

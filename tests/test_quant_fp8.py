@@ -668,3 +668,41 @@ def test_bert_fp8_train_step_converges():
     assert losses[-1] < losses[0] * 0.6, losses
     scale = ts.fp8_state["layers"]["mlp"]["up_proj"]["x"].scale
     assert not np.allclose(np.asarray(scale), 1.0)
+
+
+def test_mixtral_fp8_with_remat_trains():
+    """remat wraps the scan body AROUND the fp8 meta threading — the
+    combination must train (activation recompute replays the fp8 casts)."""
+    import dataclasses
+
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import mixtral
+    from accelerate_tpu.state import PartialState
+
+    PartialState._reset_state()
+    cfg = dataclasses.replace(mixtral.MixtralConfig.tiny(), remat=True)
+    acc = Accelerator(mixed_precision="fp8")
+    params = mixtral.init_params(cfg, jax.random.key(10))
+    ts = TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(5e-3),
+        fp8_state=mixtral.init_fp8_state(cfg),
+    )
+    ids = np.random.default_rng(10).integers(0, cfg.vocab_size,
+                                             (4, 17)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids)}
+    step = acc.train_step(
+        lambda p, b, fp8_state=None: mixtral.causal_lm_loss(
+            cfg, p, b, fp8_state=fp8_state
+        )
+    )
+    losses = []
+    for _ in range(9):
+        ts, m = step(ts, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    # the guarded regression: remat must not drop the fp8 meta updates
+    scale = ts.fp8_state["layers"]["attn"]["q_proj"]["x"].scale
+    assert not np.allclose(np.asarray(scale), 1.0)
