@@ -66,11 +66,19 @@ def are_the_same_tensors(tensor) -> bool:
     return bool(np.all(stacked == stacked[0:1]))
 
 
-def execute_subprocess(cmd: list[str], env: dict | None = None) -> str:
+def execute_subprocess(cmd: list[str], env: dict | None = None,
+                       timeout: int | None = None) -> str:
     """Run a launch command, raise with captured output on failure
-    (ref testing.py:542-561 execute_subprocess_async)."""
+    (ref testing.py:542-561 execute_subprocess_async).
+
+    `timeout` (default: ACCELERATE_TPU_TEST_LAUNCH_TIMEOUT or 1200 s) turns
+    a wedged multi-process world into a diagnosable failure instead of a
+    CI hang — a 2-process rendezvous that lost a peer blocks forever."""
     import subprocess
 
+    if timeout is None:
+        timeout = int(os.environ.get("ACCELERATE_TPU_TEST_LAUNCH_TIMEOUT",
+                                     "1200"))
     merged = dict(os.environ)
     # Child processes must import accelerate_tpu even when the package is not
     # pip-installed (running from a source checkout): prepend the package's
@@ -81,7 +89,28 @@ def execute_subprocess(cmd: list[str], env: dict | None = None) -> str:
     )
     if env:
         merged.update(env)
-    proc = subprocess.run(cmd, capture_output=True, text=True, env=merged)
+    # own session: on timeout the WHOLE process group dies (SIGKILLing just
+    # the launcher would skip its finally-block terminate and leak the
+    # wedged worker ranks it spawned — still bound to the coordinator port)
+    popen = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True, env=merged,
+                             start_new_session=True)
+    try:
+        stdout, stderr = popen.communicate(timeout=timeout)
+        proc = subprocess.CompletedProcess(cmd, popen.returncode, stdout,
+                                           stderr)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(popen.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, err = popen.communicate()
+        raise RuntimeError(
+            f"command {' '.join(cmd)} hung >{timeout}s (wedged world?)\n"
+            f"--- stdout ---\n{out or ''}\n--- stderr ---\n{err or ''}"
+        ) from None
     if proc.returncode != 0:
         raise RuntimeError(
             f"command {' '.join(cmd)} failed with code {proc.returncode}\n"

@@ -54,12 +54,16 @@ def set_virtual_host_devices(n: int, env: dict | None = None) -> None:
     host CPU devices — the no-hardware stand-in for a TPU slice
     (SURVEY.md §4: replaces the reference's gloo debug_launcher worlds).
 
-    Must run before the process's JAX backend initializes.
+    Must run before the process's JAX backend initializes. When ``env`` is
+    a partial overlay dict (launcher child-env assembly), the substitution
+    starts from the PARENT's XLA_FLAGS — otherwise the overlay would later
+    replace the inherited variable wholesale and silently drop every other
+    XLA flag the parent had set (e.g. --xla_dump_to).
     """
     import re
 
     env = os.environ if env is None else env
-    flags = env.get("XLA_FLAGS", "")
+    flags = env.get("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
     want = f"--xla_force_host_platform_device_count={n}"
     if "xla_force_host_platform_device_count" in flags:
         flags = re.sub(r"--xla_force_host_platform_device_count=\d+", want, flags)

@@ -64,7 +64,13 @@ def test_script_two_process_world(script):
                     "inside a launched world nests coordinators")
     if script in SMOKE_SCRIPTS:
         pytest.skip("runs in default CI via test_script_two_process_smoke")
-    cmd = launch_command_for(bundled_script_path(script), num_processes=2)
+    # one virtual device per process: the surface under test is the
+    # 2-process world (rendezvous + cross-process collectives). Children
+    # otherwise inherit pytest's 8-device XLA_FLAGS and build a 16-rank
+    # gloo mesh whose loopback latency puts the heavy scripts
+    # (test_performance: 18 training epochs) past any sane timeout.
+    cmd = launch_command_for(bundled_script_path(script), num_processes=2,
+                             extra=["--num_virtual_devices", "1"])
     out = execute_subprocess(cmd)
     # test_cli mirrors the reference's success line; everything else prints
     # the shared marker

@@ -115,3 +115,24 @@ def test_release_memory():
     x, y = np.ones(10), np.ones(10)
     x, y = release_memory(x, y)
     assert x is None and y is None
+
+
+def test_set_virtual_host_devices_preserves_sibling_flags(monkeypatch):
+    """Overlay-env substitution must start from the parent's XLA_FLAGS, not
+    drop sibling flags (round-4 review find)."""
+    from accelerate_tpu.utils.environment import set_virtual_host_devices
+
+    monkeypatch.setenv(
+        "XLA_FLAGS",
+        "--xla_dump_to=/tmp/d --xla_force_host_platform_device_count=8",
+    )
+    overlay = {}
+    set_virtual_host_devices(2, overlay)
+    assert overlay["XLA_FLAGS"] == (
+        "--xla_dump_to=/tmp/d --xla_force_host_platform_device_count=2"
+    )
+    # direct os.environ use still substitutes in place
+    set_virtual_host_devices(4)
+    import os
+    assert "--xla_dump_to=/tmp/d" in os.environ["XLA_FLAGS"]
+    assert "device_count=4" in os.environ["XLA_FLAGS"]
