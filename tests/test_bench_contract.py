@@ -45,7 +45,10 @@ def test_bench_child_env_contract():
 @pytest.mark.slow
 def test_bench_emits_one_json_line_when_tpu_hangs():
     """End-to-end: with an effectively-zero TPU budget the bench must still
-    print one parseable JSON line carrying an error field, rc=0."""
+    print one parseable JSON line carrying an error field, rc=0 — and a
+    degraded (CPU-fallback) run must NOT report a headline number in the
+    real metric's unit: value/vs_baseline are null, the smoke reading
+    lives under extra.cpu_smoke_tokens_per_sec."""
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         env={**os.environ, "BENCH_TPU_TIMEOUT": "3"},
@@ -57,3 +60,37 @@ def test_bench_emits_one_json_line_when_tpu_hangs():
     payload = json.loads(lines[0])
     assert payload["metric"] == "llama_train_tokens_per_sec_per_chip"
     assert "error" in payload
+    assert payload["value"] is None
+    assert payload["vs_baseline"] is None
+    if "extra" in payload:  # absent only on the hand-built last-resort line
+        assert payload["extra"]["cpu_smoke_tokens_per_sec"] > 0
+
+
+def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
+    """ADVICE r4: an operator who exported JAX_PLATFORMS=cpu must not pay
+    the TPU hang budget. Behavioral: run main() with subprocess stubbed —
+    exactly ONE child may be spawned, pinned to CPU and marked skipped
+    (not error: a deliberate pin is not an outage)."""
+    bench = _load_bench()
+    calls = []
+
+    class FakeOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": None, "vs_baseline": None, "skipped": "pin"}) + "\n"
+
+    def fake_run(cmd, env=None, **kw):
+        calls.append(env)
+        return FakeOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    assert len(calls) == 1, "TPU child must not be spawned under a cpu pin"
+    assert calls[0]["JAX_PLATFORMS"] == "cpu"
+    assert calls[0]["BENCH_TPU_SKIPPED"] == "1"
+    line = json.loads(capsys.readouterr().out.strip())
+    assert "skipped" in line and "error" not in line
