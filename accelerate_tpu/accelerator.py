@@ -25,6 +25,7 @@ import dataclasses
 import functools
 import math
 import os
+import warnings
 from typing import Any, Callable, Iterable
 
 import jax
@@ -90,6 +91,66 @@ def _is_dataloader(obj: Any) -> bool:
     if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
         return True
     return hasattr(obj, "__iter__") and not isinstance(obj, (dict, str, bytes))
+
+
+class _CompiledTrainStep:
+    """Jit wrapper that pins the output TrainState's shardings to the
+    input's shardings.
+
+    Without the pin, XLA is free to pick output shardings for the new
+    state (normalized specs, replicated-in sharded-out small leaves), the
+    second call sees differently-sharded inputs, and the whole program
+    compiles twice — minutes of wasted compile at real model sizes and a
+    layout reshuffle between steps. Pinning out == in makes step 1 the
+    steady state and keeps donation layouts exact.
+
+    The pin is keyed by the input state's sharding layout, so a step
+    reused after re-preparing under a different mesh/plan (new Accelerator
+    in a notebook, differently-laid-out checkpoint restore) gets a fresh
+    jit with matching pins rather than outputs silently forced back to a
+    stale layout.
+    """
+
+    def __init__(self, step_fn: Callable, donate: bool):
+        self._step_fn = step_fn
+        self._donate = donate
+        self._by_layout: dict = {}
+
+    def _ensure(self, state):
+        # pin only mesh-placed leaves (NamedSharding, i.e. the state went
+        # through prepare): an unprepared state's single-device leaves must
+        # stay unspecified or they'd conflict with mesh-wide shard_map
+        # calls inside the model (mixtral a2a)
+        pins = jax.tree_util.tree_map(
+            lambda x: x.sharding
+            if isinstance(x, jax.Array)
+            and isinstance(x.sharding, jax.sharding.NamedSharding)
+            else None,
+            state,
+        )
+        key = tuple(
+            jax.tree_util.tree_leaves(pins, is_leaf=lambda x: x is None)
+        )
+        jitted = self._by_layout.get(key)
+        if jitted is None:
+            # metrics stay unspecified (None) — constraining a potentially
+            # large user aux pytree to replicated would force a gather
+            jitted = jax.jit(
+                self._step_fn,
+                donate_argnums=(0,) if self._donate else (),
+                out_shardings=(pins, None),
+            )
+            self._by_layout[key] = jitted
+        return jitted
+
+    def __call__(self, state, *batch):
+        return self._ensure(state)(state, *batch)
+
+    def lower(self, state, *batch):
+        return self._ensure(state).lower(state, *batch)
+
+    def _cache_size(self) -> int:
+        return sum(j._cache_size() for j in self._by_layout.values())
 
 
 class Accelerator:
@@ -161,8 +222,34 @@ class Accelerator:
                     "MeshConfig)."
                 )
 
+        # --- plugin resolution from the launch env protocol ------------------
+        # `accelerate-tpu config`/`launch` serialize ZeRO/FSDP/CP choices as
+        # ACCELERATE_TPU_* env (utils/constants.py) so a saved yaml is
+        # launch-ready with no hand-edits (replaces ref env promotion
+        # ACCELERATE_USE_* state.py:892-910). Explicit plugins always win.
+        from .utils.constants import (
+            ENV_CP_DEGREE,
+            ENV_CP_MODE,
+            ENV_FSDP_STRATEGY,
+            ENV_ZERO_STAGE,
+        )
+
+        if deepspeed_plugin is None and os.environ.get(ENV_ZERO_STAGE):
+            deepspeed_plugin = DeepSpeedPlugin(
+                zero_stage=int(os.environ[ENV_ZERO_STAGE])
+            )
+        if fsdp_plugin is None and os.environ.get(ENV_FSDP_STRATEGY):
+            fsdp_plugin = FullyShardedDataParallelPlugin(
+                sharding_strategy=os.environ[ENV_FSDP_STRATEGY]
+            )
+        env_cp_mode = os.environ.get(ENV_CP_MODE)
+        if context_parallel_plugin is None and env_cp_mode and env_cp_mode != "none":
+            context_parallel_plugin = ContextParallelPlugin(
+                mode=env_cp_mode,
+                seq_degree=int(os.environ.get(ENV_CP_DEGREE, "2")),
+            )
+
         # --- mesh resolution: explicit > env > plugins > default DP ----------
-        # (replaces ref env promotion ACCELERATE_USE_* state.py:892-910)
         self.deepspeed_plugin = deepspeed_plugin
         self.fsdp_plugin = fsdp_plugin
         self.megatron_lm_plugin = megatron_lm_plugin
@@ -216,6 +303,8 @@ class Accelerator:
         self._models: list = []
         self._custom_objects: list = []
         self._prepared_params_sharding = None
+        self._opt_plan_source = None
+        self._shard_opt = True
         self.flag_tensor = None
         self.step = 0
 
@@ -368,16 +457,51 @@ class Accelerator:
                 results[i] = self.prepare_data_loader(obj)
         return results[0] if len(results) == 1 else tuple(results)
 
-    def prepare_params(self, params: Any) -> Any:
-        """Plan + place a parameter pytree (replaces model.to(device) + wrap,
-        ref :1411-1550)."""
+    def _plan_param_and_opt_sharding(self, params: Any) -> tuple[Any, Any]:
+        """(param_plan, opt_plan_source) per the active plugins — the ONE
+        place the ZeRO-stage decision tree lives:
+
+        - ZeRO-3 / FSDP FULL_SHARD: params shard; optimizer state follows.
+        - ZeRO-1/2: params replicate but the optimizer moments shard —
+          planned as if params were fsdp-sharded (GSPMD reduce-scatters
+          grads into moment shards and all-gathers only the update delta).
+          Without this the stages degenerate to DDP.
+        - stage 0 / NO_SHARD / shard_optimizer_state=False: both replicate.
+
+        Also records both plans for the separate `prepare_optimizer` path.
+        """
         shard = True
         if self.fsdp_plugin is not None:
             shard = self.fsdp_plugin.shard_params
         elif self.deepspeed_plugin is not None:
             shard = self.deepspeed_plugin.shard_params
-        plan = plan_sharding(params, self.mesh, self.sharding_rules, shard_params=shard)
-        self._prepared_params_sharding = plan
+        shard_opt = True
+        if self.deepspeed_plugin is not None:
+            shard_opt = self.deepspeed_plugin.shard_optimizer_state
+        param_plan = plan_sharding(
+            params, self.mesh, self.sharding_rules, shard_params=shard
+        )
+        if not shard_opt:
+            opt_plan_source = jax.tree_util.tree_map(
+                lambda _: jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()),
+                param_plan,
+            )
+        elif shard:
+            opt_plan_source = param_plan
+        else:
+            opt_plan_source = plan_sharding(
+                params, self.mesh, self.sharding_rules, shard_params=True
+            )
+        self._prepared_params_sharding = param_plan
+        self._opt_plan_source = opt_plan_source
+        self._shard_opt = shard_opt
+        return param_plan, opt_plan_source
+
+    def prepare_params(self, params: Any) -> Any:
+        """Plan + place a parameter pytree (replaces model.to(device) + wrap,
+        ref :1411-1550)."""
+        plan, _ = self._plan_param_and_opt_sharding(params)
         if not self.device_placement:
             return params
         return shard_pytree(params, plan)
@@ -392,33 +516,70 @@ class Accelerator:
         return model
 
     def prepare_train_state(self, ts: TrainState) -> TrainState:
-        shard = True
-        if self.fsdp_plugin is not None:
-            shard = self.fsdp_plugin.shard_params
-        elif self.deepspeed_plugin is not None:
-            shard = self.deepspeed_plugin.shard_params
-        param_plan = plan_sharding(ts.params, self.mesh, self.sharding_rules,
-                                   shard_params=shard)
-        self._prepared_params_sharding = param_plan
-        params = shard_pytree(ts.params, param_plan)
-        shard_opt = True
-        if self.deepspeed_plugin is not None:
-            shard_opt = self.deepspeed_plugin.shard_optimizer_state
-        opt_plan_source = param_plan if shard_opt else jax.tree_util.tree_map(
-            lambda _: jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
-            param_plan,
+        param_plan, opt_plan_source = self._plan_param_and_opt_sharding(
+            ts.params
         )
+        params = shard_pytree(ts.params, param_plan)
         opt_plan = plan_optimizer_sharding(ts.tx, ts.opt_state, opt_plan_source, self.mesh)
+        self._warn_unsharded_quantized_moments(opt_plan)
         opt_state = shard_pytree(ts.opt_state, opt_plan)
         needs_scale = self.state.mixed_precision == PrecisionType.FP16
+        # Place the remaining leaves on the mesh too: a stray
+        # SingleDeviceSharding leaf forces train_step to recompile on its
+        # second call when XLA's output shardings replace it
+        # (tests/test_compiled_contracts.py::TestJitCacheStability).
+        replicated = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec()
+        )
+        place_rep = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: jax.device_put(x, replicated), tree
+        )
+        loss_scale = (
+            ts.loss_scale
+            if ts.loss_scale is not None or not needs_scale
+            else DynamicLossScale.create()
+        )
         return dataclasses.replace(
             ts,
             params=params,
             opt_state=opt_state,
-            loss_scale=ts.loss_scale
-            if ts.loss_scale is not None or not needs_scale
-            else DynamicLossScale.create(),
+            step=jax.device_put(ts.step, replicated),
+            # grads shard like the optimizer moments (ZeRO-2 semantics:
+            # the accumulation buffer is the persistent gradient store)
+            grad_accum=(
+                shard_pytree(ts.grad_accum, opt_plan_source)
+                if ts.grad_accum is not None
+                else None
+            ),
+            loss_scale=place_rep(loss_scale),
+            fp8_state=place_rep(ts.fp8_state),
         )
+
+    def _warn_unsharded_quantized_moments(self, opt_plan: Any) -> None:
+        """8-bit Adam x ZeRO composition check, surfaced at prepare() time
+        (ADVICE r4): quantized moments shard along their blocks dim on the
+        fsdp axis; if a block count doesn't divide, that moment replicates
+        and the ZeRO memory saving silently shrinks — tell the user here,
+        not in a rank-0 log line after the first step."""
+        from .sharding.planner import count_replicated_quantized
+        from .utils.constants import AXIS_FSDP
+
+        if not getattr(self, "_shard_opt", True):
+            return  # replication was requested; nothing to warn about
+        fsdp_size = dict(self.mesh.shape).get(AXIS_FSDP, 1)
+        if fsdp_size <= 1:
+            return
+        n_replicated, n_total = count_replicated_quantized(opt_plan)
+        if n_replicated:
+            warnings.warn(
+                f"{n_replicated} of {n_total} adamw_8bit quantized "
+                f"moments have block counts that do not divide the fsdp axis "
+                f"({fsdp_size}) and will REPLICATE — the optimizer-state "
+                "memory saving of ZeRO shrinks accordingly. Pad parameter "
+                "sizes to multiples of 256*fsdp or use plain optax.adamw "
+                "under ZeRO.",
+                stacklevel=3,
+            )
 
     def prepare_optimizer(
         self, tx, params: Any = None, device_placement: bool | None = None
@@ -427,9 +588,14 @@ class Accelerator:
         opt_sharding = None
         if params is not None and self._prepared_params_sharding is not None:
             opt_state = tx.init(params)
+            # _opt_plan_source already encodes the full ZeRO decision tree
+            # (_plan_param_and_opt_sharding), including the replicate-all
+            # case for shard_optimizer_state=False
+            source = self._opt_plan_source or self._prepared_params_sharding
             opt_sharding = plan_optimizer_sharding(
-                tx, opt_state, self._prepared_params_sharding, self.mesh
+                tx, opt_state, source, self.mesh
             )
+            self._warn_unsharded_quantized_moments(opt_sharding)
             opt_state = shard_pytree(opt_state, opt_sharding)
             opt = AcceleratedOptimizer(
                 tx, params=params, opt_state=opt_state,
@@ -734,7 +900,7 @@ class Accelerator:
                 metrics["aux"] = aux
             return new_state, metrics
 
-        return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+        return _CompiledTrainStep(step_fn, donate=donate)
 
     def eval_step(self, eval_fn: Callable) -> Callable:
         """Compile an inference/eval function with the precision policy."""

@@ -118,6 +118,24 @@ class LlamaConfig:
         return cls(**defaults)
 
 
+def select_attention_backend(
+    backend: str, *, on_tpu: bool, decoding: bool, seq_len: int
+) -> str:
+    """Resolve 'auto' to a concrete attention backend.
+
+    The einsum path materializes [B,H,S,S] f32 scores in HBM and is
+    bandwidth-bound from ~1k context; the pallas flash kernel measures
+    >=2x faster from s=1024 on v5e (benchmarks/sweep_attn.py). Decode
+    (kv_cache) keeps the mask-capable einsum path. Pure so the selection
+    is contract-testable without TPU hardware
+    (tests/test_compiled_contracts.py)."""
+    if backend != "auto":
+        return backend
+    return (
+        "flash" if on_tpu and not decoding and seq_len >= 1024 else "einsum"
+    )
+
+
 def init_params(config: LlamaConfig, key: jax.Array, dtype=jnp.float32) -> dict:
     """Stacked-layer param pytree."""
     keys = jax.random.split(key, 8)
@@ -187,17 +205,12 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
         causal = False
     else:
         causal = True
-    backend = config.attention_backend
-    if backend == "auto":
-        # the einsum path materializes [B,H,S,S] f32 scores in HBM and is
-        # bandwidth-bound from ~1k context; the pallas flash kernel measures
-        # >=2x faster from s=1024 on v5e (benchmarks/sweep_attn.py). Decode
-        # (kv_cache) keeps the mask-capable einsum path.
-        on_tpu = jax.devices()[0].platform == "tpu"
-        backend = (
-            "flash" if on_tpu and kv_cache is None and s >= 1024
-            else "einsum"
-        )
+    backend = select_attention_backend(
+        config.attention_backend,
+        on_tpu=jax.devices()[0].platform == "tpu",
+        decoding=kv_cache is not None,
+        seq_len=s,
+    )
     window = config.sliding_window
     # flash, ring, and ulysses all take [B, S] key-padding masks natively
     # (ring rotates mask chunks with K/V; ulysses all-gathers the mask), so

@@ -16,11 +16,18 @@ At multi-host scale the preferred memory recipe is ZeRO/FSDP sharding
 (sharding/planner.py plan_optimizer_sharding): 8B params x 16 bytes / 64
 chips is 2 GB/chip — host-offload is unnecessary on TPU pods, so it is
 deliberately not implemented. Under `plan_optimizer_sharding` the
-quantized moments REPLICATE (with a logged warning): their [blocks, 256]
-payload layout cannot adopt a param-shaped PartitionSpec, and at the
-scale where moment sharding matters, plain `optax.adamw` + ZeRO is the
-better tool — this transform's niche is fitting multi-billion-param
-training on ONE chip (benchmarks/mfu_table.py 1.5B/2B rows).
+quantized moments SHARD along their blocks dim on the fsdp axis (the
+[blocks, 256] payload cannot adopt a param-shaped PartitionSpec, but the
+blocks dim divides cleanly whenever the parameter count is a multiple of
+256*fsdp — true for every stacked transformer layer at production sizes),
+so 8-bit Adam and ZeRO compose. A moment whose block count does not
+divide replicates, with a warning at `Accelerator.prepare()` time.
+
+Checkpoint compatibility: the second moment changed domain (linear `nu`
+-> sqrt-domain `nu_sqrt`) in round 4; old adamw_8bit optimizer states
+fail loudly on restore (tree-structure mismatch) and must be
+re-initialized — the stored values would be wrong in the new domain
+anyway. See docs/performance.md.
 """
 
 from __future__ import annotations

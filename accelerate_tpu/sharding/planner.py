@@ -144,10 +144,57 @@ def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Me
 
     Uses `optax.tree_map_params` so param-shaped leaves (e.g. Adam mu/nu)
     adopt the param's sharding while step counters replicate.
+
+    Block-quantized moments (`optimizers.adamw_8bit`) carry a
+    ``[blocks, 256]`` payload that cannot adopt a param-shaped spec;
+    they shard along the blocks dim on the fsdp axis instead whenever the
+    plan wants sharding and the block count divides — composing 8-bit Adam
+    with ZeRO instead of silently replicating (r4 weak-spot #5).
     """
     import optax
 
+    from ..optimizers import _Quantized
+
     replicated = NamedSharding(mesh, PartitionSpec())
+    is_quant = lambda x: isinstance(x, _Quantized)  # noqa: E731
+    has_quant = any(
+        is_quant(leaf)
+        for leaf in jax.tree_util.tree_leaves(opt_state, is_leaf=is_quant)
+    )
+    if has_quant:
+        fsdp_size = _axis_sizes(mesh).get(AXIS_FSDP, 1)
+        plan_wants_sharding = any(
+            any(s is not None for s in ns.spec)
+            for ns in jax.tree_util.tree_leaves(
+                param_plan, is_leaf=lambda x: isinstance(x, NamedSharding)
+            )
+        )
+        blocks_spec = (
+            NamedSharding(mesh, PartitionSpec(AXIS_FSDP, None))
+            if fsdp_size > 1
+            else replicated
+        )
+
+        def quant_or_replicate(node):
+            if not is_quant(node):
+                return replicated  # counts/scalars of the surrounding state
+            blocks = node.q.shape[0]
+            if (
+                plan_wants_sharding
+                and fsdp_size > 1
+                and blocks % fsdp_size == 0
+            ):
+                return _Quantized(q=blocks_spec, scale=blocks_spec)
+            if plan_wants_sharding and fsdp_size > 1:
+                logger.warning(
+                    "adamw_8bit moment with %d blocks does not divide the "
+                    "fsdp axis (%d); this moment replicates", blocks, fsdp_size,
+                )
+            return _Quantized(q=replicated, scale=replicated)
+
+        return jax.tree_util.tree_map(
+            quant_or_replicate, opt_state, is_leaf=is_quant
+        )
     try:
         mapped = optax.tree_map_params(
             optimizer,
@@ -161,6 +208,23 @@ def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Me
         # fallback: shape-match each leaf against nothing -> replicate
         logger.warning("optax.tree_map_params failed; replicating optimizer state")
         return jax.tree_util.tree_map(lambda _: replicated, opt_state)
+
+
+def count_replicated_quantized(opt_plan: Any) -> tuple[int, int]:
+    """(#replicated, #total) block-quantized moment entries in an
+    optimizer-sharding plan — the single source for the 8-bit-Adam x ZeRO
+    composition warning (`Accelerator._warn_unsharded_quantized_moments`)."""
+    from ..optimizers import _Quantized
+
+    is_q = lambda x: isinstance(x, _Quantized)  # noqa: E731
+    qplans = [
+        n for n in jax.tree_util.tree_leaves(opt_plan, is_leaf=is_q)
+        if is_q(n)
+    ]
+    replicated = [
+        n for n in qplans if not any(s is not None for s in n.q.spec)
+    ]
+    return len(replicated), len(qplans)
 
 
 def batch_spec(mesh: Mesh, batch_axes=BATCH_AXES, extra_dims: int = 0) -> PartitionSpec:

@@ -1,0 +1,85 @@
+"""Launch-and-assert: distributed gradient parity (VERDICT r4 #4).
+
+The data-parallel world must produce EXACTLY the full-batch gradient: one
+SGD step on a fixed batch through the sharded `train_step` must land on
+the same parameters as a single-device reference computed locally. This
+pins the cross-process/cross-device gradient averaging that the multichip
+dryrun's `data>1` mesh relies on — in a real launched world, not just the
+virtual mesh (runs in the default-CI SMOKE set,
+tests/test_launched_scripts.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _fixed_batch(cfg, rows: int):
+    rng = np.random.default_rng(1234)
+    return rng.integers(0, cfg.vocab_size, (rows, 33)).astype(np.int32)
+
+
+def check_one_step_parity(state):
+    import jax
+    import optax
+
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.test_utils import host_values
+
+    cfg = llama.LlamaConfig.tiny()
+    lr = 0.1
+
+    # ---- distributed: sharded batch, GSPMD-averaged grads, one SGD step
+    acc = Accelerator(mixed_precision="no")
+    rows = 2 * max(state.num_processes, jax.device_count())
+    ids = _fixed_batch(cfg, rows)
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=params, tx=optax.sgd(lr))
+    )
+    loader = acc.prepare([{"input_ids": ids}])
+    (batch,) = list(loader)
+    step = acc.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+    ts, metrics = step(ts, batch)
+    dist = jax.tree_util.tree_map(
+        lambda x: np.asarray(host_values(x)), ts.params
+    )
+
+    # ---- reference: same batch, same init, single device, plain jax
+    ref_params = llama.init_params(cfg, jax.random.key(0))
+    grads = jax.grad(lambda p: llama.causal_lm_loss(
+        cfg, p, {"input_ids": ids}))(ref_params)
+    ref = jax.tree_util.tree_map(
+        lambda p, g: np.asarray(p) - lr * np.asarray(g), ref_params, grads
+    )
+
+    flat_d = jax.tree_util.tree_leaves_with_path(dist)
+    flat_r = dict(jax.tree_util.tree_leaves_with_path(ref))
+    assert flat_d, "no parameters compared"
+    for path, d in flat_d:
+        r = flat_r[path]
+        np.testing.assert_allclose(
+            d, r, rtol=1e-4, atol=1e-6,
+            err_msg=f"grad parity broken at {jax.tree_util.keystr(path)} "
+            f"({state.num_processes} process(es), "
+            f"{jax.device_count()} device(s))",
+        )
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def main() -> None:
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    check_one_step_parity(state)
+    if state.is_main_process:
+        print(
+            f"test_grad_parity: ALL CHECKS PASSED "
+            f"({state.num_processes} process(es))"
+        )
+
+
+if __name__ == "__main__":
+    main()

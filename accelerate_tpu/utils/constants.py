@@ -30,6 +30,12 @@ ENV_MESH_SHAPE = ENV_PREFIX + "MESH_SHAPE"            # e.g. "data=8,model=4"
 ENV_DEBUG_MODE = ENV_PREFIX + "DEBUG"                 # collective shape checks
 ENV_CPU = ENV_PREFIX + "USE_CPU"
 ENV_FORCE_HOST_DEVICES = ENV_PREFIX + "HOST_DEVICE_COUNT"  # virtual CPU devices
+# engine/plugin selection (serialized by `accelerate-tpu config`/`launch`,
+# resolved to plugins in Accelerator.__init__ — a saved yaml is launch-ready)
+ENV_ZERO_STAGE = ENV_PREFIX + "ZERO_STAGE"            # 0-3 -> DeepSpeedPlugin
+ENV_FSDP_STRATEGY = ENV_PREFIX + "FSDP_SHARDING_STRATEGY"  # FULL_SHARD|...
+ENV_CP_MODE = ENV_PREFIX + "CONTEXT_PARALLEL_MODE"    # none|ring|ulysses
+ENV_CP_DEGREE = ENV_PREFIX + "CONTEXT_PARALLEL_DEGREE"  # seq-axis size
 
 # Legacy names also honoured so `RANK/WORLD_SIZE`-style launchers keep working
 # (ref state.py:215-237 rendezvous env protocol).

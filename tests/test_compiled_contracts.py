@@ -1,0 +1,320 @@
+"""Compiled-program performance contracts (VERDICT r4 #2).
+
+The TPU tunnel is flaky, so throughput numbers can go stale for rounds at
+a time. These tests are the hardware-independent guardrail: they lower the
+key programs to optimized HLO on the virtual 8-device CPU mesh and assert
+the *structure* GSPMD must produce — the collective pattern is what sets
+the performance class of each parallelism mode, and it is identical on the
+CPU and TPU SPMD partitioners even though wall-clock isn't measured.
+
+Contracts (pattern: the reference's threshold-gate idea,
+ref test_utils/scripts/external_deps/test_performance.py:195-203, applied
+to program text instead of accuracy):
+
+1. ZeRO-3 fwd+bwd all-gathers params and reduce-scatters grads — it must
+   NOT degenerate to a replicated all-reduce step.
+2. ZeRO-1 fwd+bwd is pure data-parallel: grads all-reduce, params are
+   never all-gathered (they are already replicated).
+3. ZeRO-1's full train step still shards the optimizer moments: the
+   update path reduce-scatters grads into moment shards and all-gathers
+   only the param delta.
+4. One ring-attention rotation is exactly one collective-permute per
+   rotated buffer (K and V) — and the ring never all-gathers the sequence.
+5. `attention_backend='auto'` selects the pallas flash kernel at/beyond
+   1024 tokens on TPU (pure-function contract; the kernel itself needs
+   hardware).
+6. Repeated `train_step` calls with same-shaped inputs hit the jit cache —
+   no recompile.
+
+Note: XLA's CPU backend lowers reduce-scatter to all-to-all(+local reduce)
+in optimized HLO, so the reduce-scatter assertions accept either spelling.
+"""
+
+import re
+from collections import Counter
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils import MeshConfig
+from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
+
+_COLLECTIVE = re.compile(
+    r"(all-gather|reduce-scatter|all-reduce|collective-permute|all-to-all)\b"
+)
+
+
+def collective_counts(hlo_text: str) -> Counter:
+    return Counter(m.group(1) for m in _COLLECTIVE.finditer(hlo_text))
+
+
+def _zero_step_and_batch(stage: int):
+    acc = Accelerator(deepspeed_plugin=DeepSpeedPlugin(zero_stage=stage))
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ts = acc.prepare(
+        TrainState.create(apply_fn=None, params=params, tx=optax.adamw(1e-3))
+    )
+    ids = np.zeros((8, 65), dtype=np.int32)
+    loader = acc.prepare([{"input_ids": ids}])
+    (batch,) = list(loader)
+    step = acc.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+    grad_only = jax.jit(jax.grad(lambda p, b: llama.causal_lm_loss(cfg, p, b)))
+    return cfg, ts, batch, step, grad_only
+
+
+class TestZeroCollectiveStructure:
+    def test_zero3_gathers_params_and_scatters_grads(self):
+        _, ts, batch, step, grad_only = _zero_step_and_batch(3)
+        fwd_bwd = collective_counts(
+            grad_only.lower(ts.params, batch).compile().as_text()
+        )
+        # params sharded on fsdp: the forward/backward must materialize
+        # them via all-gather ...
+        assert fwd_bwd["all-gather"] > 0, (
+            "ZeRO-3 fwd+bwd has no all-gather: params are not actually "
+            f"sharded (collectives: {dict(fwd_bwd)})"
+        )
+        # ... and grads must come back sharded (reduce-scatter; the CPU
+        # partitioner spells it all-to-all + local reduce), NOT as a
+        # replicated all-reduce-only step.
+        assert fwd_bwd["reduce-scatter"] + fwd_bwd["all-to-all"] > 0, (
+            "ZeRO-3 fwd+bwd grad sync degenerated to replicated "
+            f"all-reduce (collectives: {dict(fwd_bwd)})"
+        )
+
+    def test_zero1_fwd_bwd_never_gathers_params(self):
+        _, ts, batch, step, grad_only = _zero_step_and_batch(1)
+        fwd_bwd = collective_counts(
+            grad_only.lower(ts.params, batch).compile().as_text()
+        )
+        assert fwd_bwd["all-gather"] == 0, (
+            "ZeRO-1 params are replicated; an all-gather in fwd+bwd means "
+            f"the planner sharded them (collectives: {dict(fwd_bwd)})"
+        )
+        assert fwd_bwd["all-to-all"] == 0, dict(fwd_bwd)
+        assert fwd_bwd["all-reduce"] > 0, (
+            "ZeRO-1 fwd+bwd must all-reduce grads across the data shards "
+            f"(collectives: {dict(fwd_bwd)})"
+        )
+
+    def test_zero1_update_shards_moments(self):
+        """The full ZeRO-1 step shards optimizer moments even though params
+        replicate: grads reduce-scatter into moment shards and only the
+        param delta is all-gathered (the r5 fix — before it, stages 1/2
+        silently degenerated to DDP with replicated moments)."""
+        _, ts, batch, step, _ = _zero_step_and_batch(1)
+        # moments actually sharded on device
+        big_moments = [
+            leaf
+            for leaf in jax.tree_util.tree_leaves(ts.opt_state)
+            if hasattr(leaf, "sharding") and leaf.size > 1000
+        ]
+        assert big_moments, "no large optimizer-state leaves found"
+        sharded = [
+            leaf
+            for leaf in big_moments
+            if any(s is not None for s in leaf.sharding.spec)
+        ]
+        assert sharded, (
+            "ZeRO-1 optimizer moments are fully replicated — the stage "
+            "degenerated to DDP"
+        )
+        full = collective_counts(step.lower(ts, batch).compile().as_text())
+        assert full["all-gather"] > 0, (
+            "ZeRO-1 full step should all-gather the param delta from "
+            f"moment shards (collectives: {dict(full)})"
+        )
+        assert full["reduce-scatter"] + full["all-to-all"] > 0, (
+            "ZeRO-1 full step should reduce-scatter grads into moment "
+            f"shards (collectives: {dict(full)})"
+        )
+
+    def test_zero3_step_executes(self):
+        """The contract programs must also run (shape/dtype sanity)."""
+        _, ts, batch, step, _ = _zero_step_and_batch(3)
+        ts2, metrics = step(ts, batch)
+        assert jnp.isfinite(metrics["loss"])
+
+
+class TestRingCollectiveStructure:
+    def _qkv(self):
+        B, S, H, D = 2, 1024, 4, 32
+        q = jnp.ones((B, S, H, D))
+        k = jnp.ones((B, S, 2, D))  # GQA: fewer K/V heads ride the ring
+        v = jnp.ones((B, S, 2, D))
+        return q, k, v
+
+    def test_ring_forward_is_two_permutes_no_gather(self):
+        from accelerate_tpu.parallel.ring_attention import ring_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q, k, v = self._qkv()
+        fwd = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, causal=True, mesh=mesh)
+        )
+        counts = collective_counts(fwd.lower(q, k, v).compile().as_text())
+        # one rotation = one permute each for the K and V buffers, inside
+        # the scan body (so the program text carries them exactly once)
+        assert counts["collective-permute"] == 2, dict(counts)
+        # the ring must never fall back to gathering the full sequence
+        assert counts["all-gather"] == 0, dict(counts)
+        assert counts["all-to-all"] == 0, dict(counts)
+
+    def test_ring_backward_keeps_ring_structure(self):
+        from accelerate_tpu.parallel.ring_attention import ring_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        q, k, v = self._qkv()
+        bwd = jax.jit(
+            jax.grad(
+                lambda q, k, v: ring_attention(
+                    q, k, v, causal=True, mesh=mesh
+                ).sum(),
+                argnums=(0, 1, 2),
+            )
+        )
+        counts = collective_counts(bwd.lower(q, k, v).compile().as_text())
+        # fwd K/V + bwd recompute K/V/mask-free + dK/dV return rings: the
+        # exact figure is pinned so a rewrite that silently gathers or
+        # doubles rotations fails here
+        assert counts["collective-permute"] == 8, dict(counts)
+        assert counts["all-gather"] == 0, dict(counts)
+
+
+class TestAttentionAutoSelection:
+    """Pure-function contract for the auto backend threshold; the pallas
+    kernel itself is validated on hardware (benchmarks/sweep_attn.py)."""
+
+    def test_long_context_on_tpu_selects_flash(self):
+        sel = llama.select_attention_backend
+        assert sel("auto", on_tpu=True, decoding=False, seq_len=1024) == "flash"
+        assert sel("auto", on_tpu=True, decoding=False, seq_len=8192) == "flash"
+
+    def test_short_context_keeps_einsum(self):
+        sel = llama.select_attention_backend
+        assert sel("auto", on_tpu=True, decoding=False, seq_len=512) == "einsum"
+
+    def test_decode_keeps_einsum(self):
+        sel = llama.select_attention_backend
+        assert sel("auto", on_tpu=True, decoding=True, seq_len=4096) == "einsum"
+
+    def test_cpu_keeps_einsum(self):
+        sel = llama.select_attention_backend
+        assert sel("auto", on_tpu=False, decoding=False, seq_len=4096) == "einsum"
+
+    def test_explicit_backend_is_passed_through(self):
+        sel = llama.select_attention_backend
+        for b in ("einsum", "flash", "ring", "ulysses"):
+            assert sel(b, on_tpu=False, decoding=False, seq_len=64) == b
+
+
+class TestJitCacheStability:
+    def test_train_step_does_not_recompile(self):
+        """Same-shaped batches must reuse the compiled executable: a shape
+        or dtype leak in the step (python scalars captured as weak types,
+        re-built closures, ...) shows up here as a growing cache."""
+        acc = Accelerator(mesh_config=MeshConfig(axes={"fsdp": 8}))
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.key(0))
+        ts = acc.prepare(
+            TrainState.create(
+                apply_fn=None, params=params, tx=optax.adamw(1e-3)
+            )
+        )
+        rng = np.random.default_rng(0)
+        step = acc.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+        for _ in range(3):
+            ids = rng.integers(0, cfg.vocab_size, (8, 65)).astype(np.int32)
+            loader = acc.prepare([{"input_ids": ids}])
+            (batch,) = list(loader)
+            ts, metrics = step(ts, batch)
+        assert step._cache_size() == 1, (
+            f"train_step compiled {step._cache_size()} times for "
+            "identically-shaped batches"
+        )
+
+    def test_eval_step_does_not_recompile(self):
+        acc = Accelerator()
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.key(0))
+        params = acc.prepare_params(params)
+        ev = acc.eval_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+        ids = np.zeros((4, 33), dtype=np.int32)
+        loader = acc.prepare([{"input_ids": ids}])
+        (batch,) = list(loader)
+        for _ in range(3):
+            ev(params, batch)
+        assert ev._cache_size() == 1
+
+
+class TestTensorParallelStructure:
+    def test_tp_fwd_syncs_activations_not_params(self):
+        """Megatron-style TP: column/row-parallel matmuls communicate
+        *activations* (all-reduce / reduce-scatter of the row-parallel
+        output), never gather whole weight matrices."""
+        acc = Accelerator(
+            mesh_config=MeshConfig(axes={"fsdp": 2, "model": 4})
+        )
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.key(0))
+        params = acc.prepare_params(params)
+        ids = np.zeros((8, 65), dtype=np.int32)
+        loader = acc.prepare([{"input_ids": ids}])
+        (batch,) = list(loader)
+        grad_only = jax.jit(
+            jax.grad(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+        )
+        counts = collective_counts(
+            grad_only.lower(params, batch).compile().as_text()
+        )
+        assert counts["all-reduce"] > 0, dict(counts)
+
+
+class TestStepReuseAcrossLayouts:
+    def test_step_repins_for_a_new_mesh_layout(self):
+        """A train_step reused after re-preparing under a different mesh
+        must get fresh output pins (new jit entry), not outputs silently
+        forced back onto the first layout (r5 review finding)."""
+        from accelerate_tpu.state import PartialState
+
+        cfg = llama.LlamaConfig.tiny()
+        ids = np.zeros((8, 65), dtype=np.int32)
+
+        acc1 = Accelerator(mesh_config=MeshConfig(axes={"fsdp": 8}))
+        params = llama.init_params(cfg, jax.random.key(0))
+        ts1 = acc1.prepare(TrainState.create(
+            apply_fn=None, params=params, tx=optax.adamw(1e-3)))
+        loader = acc1.prepare([{"input_ids": ids}])
+        (batch1,) = list(loader)
+        step = acc1.train_step(lambda p, b: llama.causal_lm_loss(cfg, p, b))
+        ts1, _ = step(ts1, batch1)
+
+        PartialState._reset_state()
+        acc2 = Accelerator(mesh_config=MeshConfig(axes={"data": 8}))
+        params = llama.init_params(cfg, jax.random.key(0))
+        ts2 = acc2.prepare(TrainState.create(
+            apply_fn=None, params=params, tx=optax.adamw(1e-3)))
+        loader = acc2.prepare([{"input_ids": ids}])
+        (batch2,) = list(loader)
+        ts2, m = step(ts2, batch2)
+        assert jnp.isfinite(m["loss"])
+        # outputs keep the SECOND layout (replicated params on the data
+        # mesh), not the first (fsdp-sharded)
+        big = max(
+            jax.tree_util.tree_leaves(ts2.params), key=lambda x: x.size
+        )
+        assert not any(s is not None for s in big.sharding.spec), (
+            f"output forced onto a stale layout: {big.sharding.spec}"
+        )
+        # and the steady state holds per layout: one more call, no growth
+        before = step._cache_size()
+        ts2, _ = step(ts2, batch2)
+        assert step._cache_size() == before

@@ -27,6 +27,7 @@ SCRIPTS = [
     "external_deps/test_peak_memory_usage.py",
     "external_deps/test_pipeline_inference.py",
     "external_deps/test_zero3_integration.py",
+    "test_grad_parity.py",
 ]
 
 # a real 2-process `accelerate-tpu launch` world runs in DEFAULT CI for this
@@ -36,9 +37,14 @@ SMOKE_SCRIPTS = [
     "test_ops.py",
     "test_uneven_inputs.py",
     # checkpointing + metrics are precisely where multi-host regressions
-    # hide (round-2 review); the rest of the matrix stays nightly
+    # hide (round-2 review); pipeline-inference + zero3 + grad-parity
+    # promoted r5 now the 2-process matrix is fast and hang-proofed
+    # (VERDICT r4 #4/#5); the rest of the matrix stays nightly
     "external_deps/test_checkpointing.py",
     "external_deps/test_metrics.py",
+    "external_deps/test_pipeline_inference.py",
+    "external_deps/test_zero3_integration.py",
+    "test_grad_parity.py",
 ]
 
 

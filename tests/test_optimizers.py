@@ -157,3 +157,64 @@ def test_accelerator_prepare_trains_with_adamw_8bit():
         ts, m = step(ts, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0] * 0.2
+
+
+def test_adamw_8bit_moments_shard_on_fsdp_axis():
+    """VERDICT r4 #9: the [blocks, 256] moment payload shards along the
+    blocks dim under ZeRO instead of replicating, and the sharded update
+    matches the replicated one numerically."""
+    from accelerate_tpu.optimizers import _Quantized
+    from accelerate_tpu.sharding.planner import (
+        plan_optimizer_sharding,
+        plan_sharding,
+        shard_pytree,
+    )
+
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    # 16*1024 = 16384 params -> 64 blocks, divisible by fsdp=8
+    params = {"w": jax.random.normal(jax.random.key(5), (16, 1024))}
+    tx = adamw_8bit(1e-2)
+    state = tx.init(params)
+    param_plan = plan_sharding(params, mesh)
+    plan = plan_optimizer_sharding(tx, state, param_plan, mesh)
+    assert plan.mu["w"].q.spec == jax.sharding.PartitionSpec("fsdp", None)
+    assert plan.nu_sqrt["w"].scale.spec == jax.sharding.PartitionSpec(
+        "fsdp", None
+    )
+    sharded = shard_pytree(state, plan)
+    assert len(sharded.mu["w"].q.sharding.device_set) == 8
+
+    g = jax.tree_util.tree_map(jnp.ones_like, params)
+    up_sharded, st_sharded = jax.jit(tx.update)(g, sharded, params)
+    up_repl, _ = jax.jit(tx.update)(g, state, params)
+    np.testing.assert_allclose(
+        np.asarray(up_sharded["w"]), np.asarray(up_repl["w"]),
+        rtol=1e-6, atol=1e-7,
+    )
+    assert isinstance(st_sharded.mu["w"], _Quantized)
+
+
+def test_adamw_8bit_zero_composition_warns_on_indivisible_blocks():
+    """Tiny (single-block) moments can't divide the fsdp axis; the user
+    hears about it at prepare() time, not from a buried rank-0 log
+    (ADVICE r4)."""
+    import warnings as _warnings
+
+    from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
+
+    acc = Accelerator(deepspeed_plugin=DeepSpeedPlugin(zero_stage=2))
+    params = {
+        "big": jax.random.normal(jax.random.key(6), (64, 256)),  # 64 blocks
+        "tiny": jnp.ones((8,)),  # 1 block -> cannot shard
+    }
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        ts = acc.prepare(
+            TrainState.create(apply_fn=None, params=params, tx=adamw_8bit(1e-3))
+        )
+    msgs = [str(w.message) for w in caught]
+    assert any("adamw_8bit" in m and "REPLICATE" in m for m in msgs), msgs
+    # the big moment sharded anyway
+    assert any(
+        s is not None for s in ts.opt_state.mu["big"].q.sharding.spec
+    )
