@@ -104,9 +104,9 @@ def register_subcommand(subparsers) -> None:
     )
     p.add_argument("verb", choices=["create", "delete", "describe", "launch"])
     p.add_argument("script", nargs="?", help="training script (verb=launch)")
-    p.add_argument("--name", default="accelerate-tpu", dest="tpu_name")
-    p.add_argument("--accelerator_type", default="v5litepod-8")
-    p.add_argument("--zone", default="us-central1-a")
+    p.add_argument("--name", default=None, dest="tpu_name")
+    p.add_argument("--accelerator_type", default=None)
+    p.add_argument("--zone", default=None)
     p.add_argument("--project", default=None)
     p.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
     p.add_argument("--spot", action="store_true")
@@ -119,11 +119,25 @@ def register_subcommand(subparsers) -> None:
 
 
 def cloud_command(args: argparse.Namespace) -> int:
+    # CLI > saved `accelerate-tpu config` yaml > hard defaults, so the
+    # questionnaire's pod-topology answers (tpu_name/zone/project/
+    # tpu_accelerator_type) reach provisioning without re-typing
+    from .config.config_args import load_config
+
+    saved = load_config()
+    def _pick(cli, cfg_value, default):
+        if cli is not None:
+            return cli
+        return cfg_value if cfg_value is not None else default
+
     cfg = TPUCloudConfig(
-        tpu_name=args.tpu_name,
-        accelerator_type=args.accelerator_type,
-        zone=args.zone,
-        project=args.project,
+        tpu_name=_pick(args.tpu_name, saved and saved.tpu_name,
+                       "accelerate-tpu"),
+        accelerator_type=_pick(args.accelerator_type,
+                               saved and saved.tpu_accelerator_type,
+                               "v5litepod-8"),
+        zone=_pick(args.zone, saved and saved.tpu_zone, "us-central1-a"),
+        project=_pick(args.project, saved and saved.tpu_project, None),
         runtime_version=args.runtime_version,
         spot=args.spot,
         reserved=args.reserved,

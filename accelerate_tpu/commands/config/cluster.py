@@ -44,14 +44,65 @@ def interactive_config() -> LaunchConfig:
         config.main_process_ip = _ask("Coordinator (host 0) IP", "127.0.0.1")
         config.main_process_port = _ask("Coordinator port", "29500", int)
         config.machine_rank = _ask("Rank of this host", "0", int)
+    # pod topology (ref cluster.py's TPU question block): lets `launch`
+    # fan out over gcloud SSH and `estimate`/docs reason about chip count
+    if num_machines > 1 or _ask_bool(
+        "Is this a Cloud TPU pod launch (gcloud SSH fan-out)?", False
+    ):
+        config.tpu_name = _ask("TPU name (enter to skip)", "") or None
+        if config.tpu_name:
+            config.tpu_zone = _ask("TPU zone (e.g. us-central2-b)", "") or None
+            config.tpu_project = _ask("GCP project (enter for default)", "") or None
+            config.tpu_accelerator_type = _ask(
+                "Accelerator type / topology (e.g. v5p-64, v5litepod-8)",
+                "v5litepod-8",
+            ) or None
+
     config.mixed_precision = _ask_choice(
         "Mixed precision?", ["no", "bf16", "fp16", "fp8"], "bf16"
     )
-    mesh = _ask(
-        "Mesh shape (e.g. 'data=-1', 'fsdp=8,model=4'; enter for pure data-parallel)",
-        "",
+
+    # engine selection (ref cluster.py's DDP/FSDP/DeepSpeed/Megatron walk):
+    # each choice lowers to mesh axes + sharding toggles via its plugin
+    engine = _ask_choice(
+        "Distributed engine?",
+        [
+            "data-parallel",          # DDP: replicate, average grads
+            "zero",                   # ZeRO 1/2/3 via DeepSpeedPlugin
+            "fsdp",                   # FSDP strategies via FSDP plugin
+            "custom-mesh",            # raw mesh axes, rules decide the rest
+        ],
+        "data-parallel",
     )
-    config.mesh_shape = mesh or None
+    if engine == "zero":
+        config.zero_stage = int(_ask_choice(
+            "ZeRO stage? (1/2: optimizer+grad sharding, params replicated; "
+            "3: full parameter sharding)",
+            ["1", "2", "3"], "2",
+        ))
+    elif engine == "fsdp":
+        config.fsdp_sharding_strategy = _ask_choice(
+            "FSDP sharding strategy?",
+            ["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"],
+            "FULL_SHARD",
+        )
+    elif engine == "custom-mesh":
+        mesh = _ask(
+            "Mesh shape (e.g. 'data=-1', 'fsdp=8,model=4')", "data=-1"
+        )
+        config.mesh_shape = mesh or None
+
+    # long-context sequence parallelism (no reference equivalent; ours)
+    cp = _ask_choice(
+        "Context parallelism for long sequences?",
+        ["none", "ring", "ulysses"], "none",
+    )
+    if cp != "none":
+        config.context_parallel_mode = cp
+        config.context_parallel_degree = _ask(
+            "Context-parallel degree (size of the seq mesh axis)", "2", int
+        )
+
     config.gradient_accumulation_steps = _ask(
         "Gradient accumulation steps", "1", int
     )

@@ -40,6 +40,12 @@ class LaunchConfig:
     mixed_precision: str | None = "bf16"
     mesh_shape: str | None = None        # e.g. "data=-1" / "fsdp=8,model=4"
     gradient_accumulation_steps: int | None = None
+    # engines (ref cluster.py's DeepSpeed/FSDP/Megatron question blocks):
+    # resolved to plugins by Accelerator via the ACCELERATE_TPU_* env
+    zero_stage: int | None = None               # 0-3
+    fsdp_sharding_strategy: str | None = None   # FULL_SHARD|SHARD_GRAD_OP|...
+    context_parallel_mode: str | None = None    # none|ring|ulysses
+    context_parallel_degree: int | None = None  # seq-axis size
     num_virtual_devices: int | None = None  # CPU-mesh debugging worlds
     max_restarts: int | None = None      # relaunch a failed world N times
     use_cpu: bool = False
@@ -47,6 +53,7 @@ class LaunchConfig:
     tpu_name: str | None = None
     tpu_zone: str | None = None
     tpu_project: str | None = None
+    tpu_accelerator_type: str | None = None  # pod topology, e.g. "v5p-64"
 
     def to_dict(self) -> dict:
         out = dataclasses.asdict(self)

@@ -66,6 +66,20 @@ def add_launch_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--mesh_shape", default=None,
                         help="e.g. 'data=-1' or 'fsdp=8,model=4'")
     parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    # engines (serialized into the ACCELERATE_TPU_* env; Accelerator resolves
+    # them to DeepSpeed/FSDP/ContextParallel plugins — utils/constants.py)
+    parser.add_argument("--zero_stage", type=int, default=None,
+                        choices=[0, 1, 2, 3],
+                        help="ZeRO stage: 0=DP, 1/2=optimizer(+grad) state "
+                             "sharding, 3=full parameter sharding")
+    parser.add_argument("--fsdp_sharding_strategy", default=None,
+                        choices=["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD",
+                                 "HYBRID_SHARD"])
+    parser.add_argument("--context_parallel_mode", default=None,
+                        choices=["none", "ring", "ulysses"],
+                        help="Long-context sequence parallelism backend")
+    parser.add_argument("--context_parallel_degree", type=int, default=None,
+                        help="Size of the seq mesh axis (ring/ulysses)")
     parser.add_argument("--cpu", "--use_cpu", dest="cpu", action="store_true",
                         default=None, help="Force the CPU backend")
     parser.add_argument("--max_restarts", type=int, default=None,
@@ -100,6 +114,8 @@ def _merge_config(args: argparse.Namespace) -> argparse.Namespace:
         "num_machines", "machine_rank", "main_process_ip", "main_process_port",
         "mixed_precision", "mesh_shape", "gradient_accumulation_steps",
         "num_virtual_devices", "debug", "max_restarts", "tpu_name", "tpu_zone", "tpu_project",
+        "zero_stage", "fsdp_sharding_strategy", "context_parallel_mode",
+        "context_parallel_degree",
     ):
         if getattr(args, field_name, None) is None:
             setattr(args, field_name, getattr(config, field_name, None))

@@ -187,7 +187,7 @@ def resolve_history_len(explicit: int | None = None) -> int:
 
     if AcceleratorState._shared_state:
         recipe = AcceleratorState._shared_state.get("fp8_recipe_handler")
-        if recipe is not None:
+        if recipe is not None and recipe.amax_history_len is not None:
             return recipe.amax_history_len
     return 16
 
@@ -234,11 +234,17 @@ def init_fp8_state(params, recipe: FP8RecipeKwargs | None = None):
     structure (the functional analogue of TE's per-module buffers)."""
     recipe = recipe or FP8RecipeKwargs()
 
+    h = (
+        recipe.amax_history_len
+        if recipe.amax_history_len is not None
+        else resolve_history_len()
+    )
+
     def _leaf(p):
         if hasattr(p, "ndim") and p.ndim >= 2:
             return {
-                "x": Fp8Meta.init(recipe.amax_history_len),
-                "w": Fp8Meta.init(recipe.amax_history_len),
+                "x": Fp8Meta.init(h),
+                "w": Fp8Meta.init(h),
             }
         return None
 

@@ -141,7 +141,12 @@ class FP8RecipeKwargs(KwargsHandler):
     backend: str = "native"
     margin: int = 0
     fp8_format: str = "E4M3"
-    amax_history_len: int = 1024
+    # None = unset -> resolves to this backend's 16-step window
+    # (ops/fp8.py resolve_history_len). TE's 1024 default would silently
+    # switch every stacked meta to [L, 1024] histories for users who pass
+    # FP8RecipeKwargs() merely to pick a backend/format (ADVICE r4); pass
+    # an explicit value to get TE-style long windows.
+    amax_history_len: int | None = None
     amax_compute_algo: str = "max_along_history"
 
 

@@ -16,14 +16,18 @@ from typing import Any
 
 from .constants import (
     ENV_COORDINATOR,
+    ENV_CP_DEGREE,
+    ENV_CP_MODE,
     ENV_DEBUG_MODE,
     ENV_FORCE_HOST_DEVICES,
+    ENV_FSDP_STRATEGY,
     ENV_GRAD_ACCUM_STEPS,
     ENV_MESH_SHAPE,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_CPU,
+    ENV_ZERO_STAGE,
 )
 
 
@@ -53,6 +57,18 @@ def prepare_launch_env(args: Any) -> dict[str, str]:
         env[ENV_DEBUG_MODE] = "1"
     if _flag(args, "cpu", False) or _flag(args, "use_cpu", False):
         env[ENV_CPU] = "1"
+    zero_stage = _flag(args, "zero_stage")
+    if zero_stage is not None:
+        env[ENV_ZERO_STAGE] = str(zero_stage)
+    fsdp_strategy = _flag(args, "fsdp_sharding_strategy")
+    if fsdp_strategy:
+        env[ENV_FSDP_STRATEGY] = str(fsdp_strategy)
+    cp_mode = _flag(args, "context_parallel_mode")
+    if cp_mode and cp_mode != "none":
+        env[ENV_CP_MODE] = str(cp_mode)
+        cp_degree = _flag(args, "context_parallel_degree")
+        if cp_degree is not None:
+            env[ENV_CP_DEGREE] = str(cp_degree)
     host_devices = _flag(args, "num_virtual_devices")
     if host_devices is not None:
         env[ENV_FORCE_HOST_DEVICES] = str(host_devices)
@@ -131,6 +147,18 @@ def pod_relaunch_command(args: Any) -> str:
     grad_accum = _flag(args, "gradient_accumulation_steps")
     if grad_accum is not None:
         parts += ["--gradient_accumulation_steps", str(grad_accum)]
+    zero_stage = _flag(args, "zero_stage")
+    if zero_stage is not None:
+        parts += ["--zero_stage", str(zero_stage)]
+    fsdp_strategy = _flag(args, "fsdp_sharding_strategy")
+    if fsdp_strategy:
+        parts += ["--fsdp_sharding_strategy", str(fsdp_strategy)]
+    cp_mode = _flag(args, "context_parallel_mode")
+    if cp_mode and cp_mode != "none":
+        parts += ["--context_parallel_mode", str(cp_mode)]
+        cp_degree = _flag(args, "context_parallel_degree")
+        if cp_degree is not None:
+            parts += ["--context_parallel_degree", str(cp_degree)]
     if _flag(args, "debug", False):
         parts += ["--debug"]
     if getattr(args, "module", False):

@@ -188,3 +188,103 @@ def test_cli_registers_all_subcommands():
                if isinstance(a, argparse._SubParsersAction))
     for name in ("env", "config", "launch", "test", "estimate", "tpu-config"):
         assert name in sub.choices, name
+
+
+def test_questionnaire_zero3_ring_cp_roundtrip(tmp_path, monkeypatch):
+    """VERDICT r4 #6: config -> launch round-trip with NO hand-editing.
+    The questionnaire emits a ZeRO-3 + ring-CP yaml; `launch` lowers it to
+    the env protocol; Accelerator resolves that env into real plugins and a
+    mesh with the seq axis."""
+    import io
+    import os
+    import sys
+
+    from accelerate_tpu.commands.config.cluster import interactive_config
+    from accelerate_tpu.commands.launch import _merge_config
+    from accelerate_tpu.utils.constants import (
+        ENV_CP_DEGREE,
+        ENV_CP_MODE,
+        ENV_ZERO_STAGE,
+    )
+
+    answers = [
+        "1",   # hosts
+        "",    # pod launch? -> default no
+        "1",   # mixed precision menu -> bf16
+        "1",   # engine menu -> zero
+        "2",   # ZeRO stage menu index 2 -> stage 3
+        "1",   # CP menu -> ring
+        "2",   # CP degree
+        "1",   # gradient accumulation steps
+        "",    # debug? -> default no
+    ]
+    monkeypatch.setattr(sys, "stdin", io.StringIO("\n".join(answers) + "\n"))
+    config = interactive_config()
+    assert config.zero_stage == 3
+    assert config.context_parallel_mode == "ring"
+    assert config.context_parallel_degree == 2
+    assert config.mixed_precision == "bf16"
+
+    path = config.save(tmp_path / "cfg.yaml")
+    args = parse_launch(["--config_file", str(path), "train.py"])
+    args = _merge_config(args)
+    env = prepare_launch_env(args)
+    assert env[ENV_ZERO_STAGE] == "3"
+    assert env[ENV_CP_MODE] == "ring"
+    assert env[ENV_CP_DEGREE] == "2"
+
+    # the launched script's process: env -> plugins -> mesh
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    from accelerate_tpu.accelerator import Accelerator
+
+    acc = Accelerator()
+    assert acc.deepspeed_plugin is not None
+    assert acc.deepspeed_plugin.zero_stage == 3
+    assert acc.deepspeed_plugin.shard_params
+    assert acc.context_parallel_plugin is not None
+    assert acc.context_parallel_plugin.mode == "ring"
+    assert acc.mesh.shape["seq"] == 2
+    assert acc.mesh.shape["fsdp"] == 4  # -1 fill over the remaining devices
+
+
+def test_launch_env_engine_flags():
+    """CLI engine flags lower to the env protocol directly."""
+    from accelerate_tpu.utils.constants import (
+        ENV_CP_DEGREE,
+        ENV_CP_MODE,
+        ENV_FSDP_STRATEGY,
+        ENV_ZERO_STAGE,
+    )
+
+    args = parse_launch(["--zero_stage", "2", "train.py"])
+    env = prepare_launch_env(args)
+    assert env[ENV_ZERO_STAGE] == "2"
+    assert ENV_CP_MODE not in env
+
+    args = parse_launch(
+        ["--fsdp_sharding_strategy", "SHARD_GRAD_OP",
+         "--context_parallel_mode", "ulysses",
+         "--context_parallel_degree", "4", "train.py"]
+    )
+    env = prepare_launch_env(args)
+    assert env[ENV_FSDP_STRATEGY] == "SHARD_GRAD_OP"
+    assert env[ENV_CP_MODE] == "ulysses"
+    assert env[ENV_CP_DEGREE] == "4"
+
+    # 'none' must NOT serialize (the child would build a seq axis for it)
+    args = parse_launch(["--context_parallel_mode", "none", "train.py"])
+    env = prepare_launch_env(args)
+    assert ENV_CP_MODE not in env
+
+
+def test_pod_relaunch_carries_engine_flags():
+    args = parse_launch(
+        ["--tpu_name", "pod-1", "--zero_stage", "3",
+         "--context_parallel_mode", "ring", "--context_parallel_degree", "2",
+         "train.py"]
+    )
+    relaunch = pod_relaunch_command(args)
+    assert "--zero_stage 3" in relaunch
+    assert "--context_parallel_mode ring" in relaunch
+    assert "--context_parallel_degree 2" in relaunch
