@@ -49,10 +49,13 @@ def test_bench_emits_one_json_line_when_tpu_hangs():
     degraded (CPU-fallback) run must NOT report a headline number in the
     real metric's unit: value/vs_baseline are null, the smoke reading
     lives under extra.cpu_smoke_tokens_per_sec."""
+    # pytest's conftest exports JAX_PLATFORMS=cpu, which bench.py treats
+    # as a deliberate operator pin (-> "skipped"); clear it so this test
+    # exercises the hang->error path the driver would hit
+    env = {**os.environ, "BENCH_TPU_TIMEOUT": "3", "JAX_PLATFORMS": ""}
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
-        env={**os.environ, "BENCH_TPU_TIMEOUT": "3"},
-        capture_output=True, text=True, timeout=600,
+        env=env, capture_output=True, text=True, timeout=600,
     )
     assert out.returncode == 0, out.stderr[-500:]
     lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]

@@ -55,13 +55,19 @@ def collective_counts(hlo_text: str) -> Counter:
     return Counter(m.group(1) for m in _COLLECTIVE.finditer(hlo_text))
 
 
-def _zero_step_and_batch(stage: int):
-    acc = Accelerator(deepspeed_plugin=DeepSpeedPlugin(zero_stage=stage))
+def _zero_step_and_batch(
+    stage: int, grad_accum_steps: int = 1, use_grad_accum_buffer: bool = False
+):
+    acc = Accelerator(
+        deepspeed_plugin=DeepSpeedPlugin(zero_stage=stage),
+        gradient_accumulation_steps=grad_accum_steps,
+    )
     cfg = llama.LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.key(0))
-    ts = acc.prepare(
-        TrainState.create(apply_fn=None, params=params, tx=optax.adamw(1e-3))
-    )
+    ts = acc.prepare(TrainState.create(
+        apply_fn=None, params=params, tx=optax.adamw(1e-3),
+        use_grad_accum_buffer=use_grad_accum_buffer,
+    ))
     ids = np.zeros((8, 65), dtype=np.int32)
     loader = acc.prepare([{"input_ids": ids}])
     (batch,) = list(loader)
@@ -318,3 +324,92 @@ class TestStepReuseAcrossLayouts:
         before = step._cache_size()
         ts2, _ = step(ts2, batch2)
         assert step._cache_size() == before
+
+
+class TestUlyssesCollectiveStructure:
+    def test_ulysses_rides_all_to_all_only(self):
+        """Ulysses scatters heads with all-to-all (sequence re-gathered
+        per-head, never as a whole): the program must carry all-to-alls
+        and NO sequence all-gather or ring permute. Counts are not pinned
+        — XLA's CPU backend decomposes one logical a2a into per-pair ops."""
+        from accelerate_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("seq",))
+        B, S, H, D = 2, 1024, 8, 32
+        q = jnp.ones((B, S, H, D))
+        k = jnp.ones((B, S, 8, D))
+        v = jnp.ones((B, S, 8, D))
+        for fn in (
+            jax.jit(lambda q, k, v: ulysses_attention(
+                q, k, v, causal=True, mesh=mesh)),
+            jax.jit(jax.grad(
+                lambda q, k, v: ulysses_attention(
+                    q, k, v, causal=True, mesh=mesh).sum(),
+                argnums=(0, 1, 2),
+            )),
+        ):
+            counts = collective_counts(fn.lower(q, k, v).compile().as_text())
+            assert counts["all-to-all"] > 0, dict(counts)
+            assert counts["all-gather"] == 0, dict(counts)
+            assert counts["collective-permute"] == 0, dict(counts)
+
+
+class TestZero2GradAccumSharding:
+    def test_grad_accum_buffer_shards_like_moments(self):
+        """ZeRO-2: the persistent gradient store (the accumulation buffer)
+        shards on the fsdp axis along with the moments, while params stay
+        replicated — and the accumulating step still runs."""
+        cfg, ts, batch, step, _ = _zero_step_and_batch(
+            2, grad_accum_steps=2, use_grad_accum_buffer=True
+        )
+        big_params = [
+            leaf for leaf in jax.tree_util.tree_leaves(ts.params)
+            if leaf.size > 1000
+        ]
+        assert all(
+            not any(s is not None for s in leaf.sharding.spec)
+            for leaf in big_params
+        ), "ZeRO-2 params must replicate"
+        big_accum = [
+            leaf for leaf in jax.tree_util.tree_leaves(ts.grad_accum)
+            if leaf.size > 1000
+        ]
+        assert big_accum and all(
+            any(s is not None for s in leaf.sharding.spec)
+            for leaf in big_accum
+        ), "ZeRO-2 grad-accum buffer must shard on the fsdp axis"
+        for _ in range(4):  # two full accumulation windows
+            ts, m = step(ts, batch)
+        assert jnp.isfinite(m["loss"])
+        assert step._cache_size() == 1
+
+
+class TestPipelineCollectiveStructure:
+    def test_schedules_shift_activations_never_gather(self):
+        """GPipe and 1F1B move activations stage-to-stage with
+        collective-permute (one fwd shift + one bwd shift in the loop
+        bodies) and must never all-gather activations or params across
+        the stage axis; grads sync with all-reduce only."""
+        from accelerate_tpu.parallel import (
+            pipeline_value_and_grad,
+            stack_layers_into_stages,
+        )
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "stage"))
+        staged = stack_layers_into_stages(
+            {"w": jax.random.normal(jax.random.key(1), (4, 16, 16)) * 0.1}, 4
+        )
+        x = jax.random.normal(jax.random.key(2), (8, 16))
+        t = jax.random.normal(jax.random.key(3), (8, 16))
+        for sched in ("gpipe", "1f1b"):
+            fn = jax.jit(lambda sp, x, t, s=sched: pipeline_value_and_grad(
+                lambda p, xx: jnp.tanh(xx @ p["w"][0]),
+                lambda y, tt: jnp.mean((y - tt) ** 2),
+                sp, x, t, num_micro_batches=4, mesh=mesh, schedule=s))
+            counts = collective_counts(
+                fn.lower(staged, x, t).compile().as_text()
+            )
+            assert counts["collective-permute"] == 2, (sched, dict(counts))
+            assert counts["all-gather"] == 0, (sched, dict(counts))
+            assert counts["all-to-all"] == 0, (sched, dict(counts))
+            assert counts["all-reduce"] > 0, (sched, dict(counts))
