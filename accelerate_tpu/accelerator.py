@@ -262,9 +262,29 @@ class Accelerator:
                 if plugin is not None:
                     for a, s in plugin.to_mesh_axes().items():
                         axes[a] = s
+            from .utils.constants import AXIS_DATA
+
             wilds = [a for a, s in axes.items() if s == -1]
-            for a in wilds[:-1]:
-                axes.pop(a)
+            if len(wilds) > 1:
+                # Two fill-the-rest axes (e.g. FSDP's fsdp=-1 plus a
+                # default-degree CP plugin's seq=-1) is ambiguous. Keep the
+                # FIRST — plugin order puts the memory-critical sharding
+                # axes (fsdp/zero) before seq — and say what was dropped,
+                # instead of silently losing parameter sharding.
+                for a in wilds[1:]:
+                    axes.pop(a)
+                warnings.warn(
+                    f"multiple plugins asked for a fill-the-rest mesh axis "
+                    f"({wilds}); keeping {wilds[0]!r} and dropping "
+                    f"{wilds[1:]} — pass an explicit degree (e.g. "
+                    "ContextParallelPlugin(seq_degree=2)) to combine them.",
+                    stacklevel=2,
+                )
+            if axes and not wilds:
+                # a plugin set with only fixed-size axes (e.g. a lone
+                # ContextParallelPlugin's seq=N) must still cover every
+                # device: data fills the remainder
+                axes.setdefault(AXIS_DATA, -1)
             resolved_mesh = MeshConfig(axes=axes) if axes else None
         state_kwargs: dict = {}
         if self.init_handler is not None and self.init_handler.timeout is not None:

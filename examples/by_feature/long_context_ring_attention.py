@@ -1,0 +1,102 @@
+"""Feature: long-context training with ring-attention context parallelism.
+
+No reference equivalent (the reference has no context parallelism —
+SURVEY.md §2.2 marks CP absent); this is the long-context answer built on
+`parallel/ring_attention.py`: the sequence dim shards over the mesh `seq`
+axis, K/V chunks rotate with `lax.ppermute` (exactly one collective-permute
+per rotated buffer — pinned in tests/test_compiled_contracts.py), and per
+chunk the attention is flash-rate.
+
+Two equivalent ways to turn it on:
+
+1. In code (this script): `ContextParallelPlugin(mode="ring", seq_degree=N)`
+   plus `LlamaConfig(attention_backend="ring")`.
+2. From the launcher, with no code change:
+     accelerate-tpu launch --context_parallel_mode ring \\
+         --context_parallel_degree 2 train.py
+   (the env protocol resolves the plugin inside `Accelerator.__init__`).
+
+Run: python examples/by_feature/long_context_ring_attention.py --tiny
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import TrainState
+from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils import ContextParallelPlugin, set_seed
+
+
+def training_function(args) -> dict:
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_clipping=1.0,
+        context_parallel_plugin=ContextParallelPlugin(
+            mode=args.cp_mode, seq_degree=args.cp_degree
+        ),
+    )
+    # the seq axis must divide the sequence; everything else is the
+    # ordinary causal-LM loop — the ring rides inside the attention op
+    if args.tiny:
+        cfg = llama.LlamaConfig.tiny(
+            attention_backend=args.cp_mode,
+            max_position_embeddings=max(256, args.seq_len),
+        )
+    else:
+        cfg = llama.LlamaConfig(
+            attention_backend=args.cp_mode,
+            max_position_embeddings=args.seq_len,
+        )
+    params = llama.init_params(cfg, jax.random.key(args.seed))
+    state = accelerator.prepare(
+        TrainState.create(apply_fn=None, params=params, tx=optax.adamw(args.lr))
+    )
+    rng = np.random.default_rng(args.seed)
+    ids = rng.integers(
+        0, cfg.vocab_size, (args.batch_size, args.seq_len + 1)
+    ).astype(np.int32)
+    loader = accelerator.prepare([{"input_ids": ids}])
+    step = accelerator.train_step(
+        lambda p, b: llama.causal_lm_loss(cfg, p, b)
+    )
+    losses = []
+    for _ in range(args.steps):
+        for batch in loader:
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    accelerator.print(
+        f"cp_mode={args.cp_mode} seq={args.seq_len} "
+        f"mesh={dict(accelerator.mesh.shape)} "
+        f"loss {losses[0]:.4f} -> {losses[-1]:.4f}"
+    )
+    return {"loss": losses[-1], "first_loss": losses[0]}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--cp_mode", choices=["ring", "ulysses"],
+                        default="ring")
+    parser.add_argument("--cp_degree", type=int, default=2,
+                        help="size of the seq mesh axis")
+    parser.add_argument("--seq_len", type=int, default=512)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--steps", type=int, default=8)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--mixed_precision", default="no",
+                        choices=["no", "bf16", "fp16"])
+    parser.add_argument("--tiny", action="store_true",
+                        help="tiny model (CI/CPU smoke)")
+    args = parser.parse_args()
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

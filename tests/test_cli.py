@@ -288,3 +288,38 @@ def test_pod_relaunch_carries_engine_flags():
     assert "--zero_stage 3" in relaunch
     assert "--context_parallel_mode ring" in relaunch
     assert "--context_parallel_degree 2" in relaunch
+
+
+def test_ambiguous_plugin_wildcards_keep_sharding_axis():
+    """FSDP (fsdp=-1) + a default-degree CP plugin (seq=-1) is ambiguous:
+    the memory-critical sharding axis must survive (previously the
+    last-wins rule silently dropped fsdp, losing all parameter sharding)
+    and the user is told what was dropped."""
+    import warnings as _warnings
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.utils import (
+        ContextParallelPlugin,
+        FullyShardedDataParallelPlugin,
+    )
+
+    with _warnings.catch_warnings(record=True) as caught:
+        _warnings.simplefilter("always")
+        acc = Accelerator(
+            fsdp_plugin=FullyShardedDataParallelPlugin(),
+            context_parallel_plugin=ContextParallelPlugin(),
+        )
+    assert acc.mesh.shape["fsdp"] == 8
+    assert "seq" not in acc.mesh.shape
+    assert any("fill-the-rest" in str(w.message) for w in caught)
+
+
+def test_lone_cp_plugin_fills_data_axis():
+    """A lone fixed-degree CP plugin must still cover every device."""
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.utils import ContextParallelPlugin
+
+    acc = Accelerator(
+        context_parallel_plugin=ContextParallelPlugin(seq_degree=2)
+    )
+    assert dict(acc.mesh.shape) == {"data": 4, "seq": 2}

@@ -139,3 +139,13 @@ def test_low_precision_training_example():
         no_fp8=False, batch_size=4, num_epochs=2, lr=5e-3, seed=0,
     ))
     assert metrics["last_loss"] < metrics["first_loss"]
+
+
+def test_long_context_ring_attention_example():
+    mod = _load("by_feature/long_context_ring_attention.py")
+    for mode in ("ring", "ulysses"):
+        metrics = mod.training_function(_Args(
+            cp_mode=mode, cp_degree=2, seq_len=256, batch_size=2, steps=4,
+            lr=3e-4, seed=0, mixed_precision="no", tiny=True,
+        ))
+        assert metrics["loss"] < metrics["first_loss"], mode
