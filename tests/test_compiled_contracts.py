@@ -413,3 +413,39 @@ class TestPipelineCollectiveStructure:
             assert counts["all-gather"] == 0, (sched, dict(counts))
             assert counts["all-to-all"] == 0, (sched, dict(counts))
             assert counts["all-reduce"] > 0, (sched, dict(counts))
+
+
+class TestFp8StepStability:
+    def test_fp8_train_step_does_not_recompile(self):
+        """The fp8 metas thread through TrainState like optimizer state:
+        repeated steps must reuse one executable (a meta that changed
+        shape/dtype across steps would force a retrace here)."""
+        acc = Accelerator(
+            mixed_precision="fp8",
+            mesh_config=MeshConfig(axes={"fsdp": 8}),
+        )
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.key(0))
+        ts = acc.prepare(TrainState.create(
+            apply_fn=None, params=params, tx=optax.adamw(1e-3),
+            fp8_state=llama.init_fp8_state(cfg),
+        ))
+        ids = np.zeros((8, 65), dtype=np.int32)
+        loader = acc.prepare([{"input_ids": ids}])
+        (batch,) = list(loader)
+        step = acc.train_step(
+            lambda p, b, **kw: llama.causal_lm_loss(cfg, p, b, **kw)
+        )
+        for _ in range(3):
+            ts, m = step(ts, batch)
+        assert jnp.isfinite(m["loss"])
+        assert step._cache_size() == 1
+        # the delayed-scaling state actually moved: a regression that
+        # drops new_fp8 from the returned state would leave it identical
+        # to a fresh init (scales at ones, histories at zeros)
+        fresh = jax.tree_util.tree_leaves(llama.init_fp8_state(cfg))
+        moved = [
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree_util.tree_leaves(ts.fp8_state), fresh)
+        ]
+        assert any(moved), "fp8 metas never updated across steps" 
