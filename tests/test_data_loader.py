@@ -756,3 +756,37 @@ def test_batch_size_majority_dim_beats_key_order():
         "y": np.ones((8,)),
     }
     assert _batch_size(batch) == 8
+
+
+def test_even_batches_property_equal_counts_and_full_coverage():
+    """Property pin (from an r5 400-config fuzz; 120 pinned here): with
+    even_batches=True
+    every rank yields the SAME number of batches and every real sample
+    appears on some rank (duplication for padding allowed). With
+    even_batches=False ranks may legitimately differ (join_uneven_inputs
+    exists for that) — not asserted here."""
+    import random
+
+    rng = random.Random(7)
+    for _ in range(120):
+        n = rng.randint(1, 50)
+        bs = rng.randint(1, 8)
+        world = rng.choice([2, 4])
+        drop_last = rng.random() < 0.5
+        base = [list(range(i, min(i + bs, n))) for i in range(0, n, bs)]
+        if drop_last and base and len(base[-1]) < bs:
+            base = base[:-1]
+        shards = [
+            [list(b) for b in BatchSamplerShard(
+                base, num_processes=world, process_index=rank,
+                split_batches=False, even_batches=True)]
+            for rank in range(world)
+        ]
+        counts = {len(s) for s in shards}
+        assert len(counts) == 1, (n, bs, world, drop_last,
+                                  [len(s) for s in shards])
+        if base:
+            seen = {x for s in shards for b in s for x in b}
+            want = {x for b in base for x in b}
+            assert want <= seen, (n, bs, world, drop_last,
+                                  sorted(want - seen))
