@@ -52,6 +52,11 @@ AXIS_SEQ = "seq"          # sequence/context parallel (ring attention)
 AXIS_EXPERT = "expert"    # MoE expert parallel
 AXIS_STAGE = "stage"      # pipeline parallel
 MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_STAGE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+# axis-size sentinel: "one per DCN domain" — resolved by MeshConfig.build
+# against the live topology (slice count on TPU pods; process count in
+# multi-process CPU worlds; dropped entirely when there is one domain).
+# -1 ("fill with remaining devices") stays the ordinary wildcard.
+DCN_FILL = -2
 
 # Axes over which a batch is split (data-like axes): gradients are averaged
 # over these; per-host data loading shards over them.

@@ -25,6 +25,7 @@ from .constants import (
     AXIS_MODEL,
     AXIS_SEQ,
     AXIS_STAGE,
+    DCN_FILL,
     ENV_MESH_SHAPE,
     ENV_MIXED_PRECISION,
     MESH_AXES,
@@ -155,6 +156,20 @@ class FP8RecipeKwargs(KwargsHandler):
 # ---------------------------------------------------------------------------
 
 
+def count_dcn_domains(devices) -> int:
+    """How many slow-link (DCN) domains the devices span: distinct slices
+    on a TPU pod; distinct owning processes elsewhere (multi-process CPU
+    worlds talk over sockets — slow by the same measure; CPU devices DO
+    carry a vacuous slice_index=0 in distributed mode, so the slice notion
+    is only trusted on TPU). One domain = everything rides ICI/memory."""
+    if any(
+        getattr(d, "platform", "") == "tpu" and hasattr(d, "slice_index")
+        for d in devices
+    ):
+        return len({getattr(d, "slice_index", 0) for d in devices})
+    return len({getattr(d, "process_index", 0) for d in devices})
+
+
 @dataclass
 class MeshConfig:
     """Declarative device-mesh request.
@@ -180,6 +195,15 @@ class MeshConfig:
         wild = [a for a, s in self.axes.items() if s == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one axis may be -1, got {wild}")
+        bad = [
+            (a, s) for a, s in self.axes.items()
+            if s < -1 and s != DCN_FILL
+        ]
+        if bad:
+            raise ValueError(
+                f"invalid axis sizes {bad}; use positive ints, -1 (fill), "
+                f"or DCN_FILL ({DCN_FILL}, one per DCN domain)"
+            )
 
     # -- constructors --------------------------------------------------------
     @classmethod
@@ -205,9 +229,25 @@ class MeshConfig:
         return cls(axes=parse_mesh_shape(spec))
 
     # -- resolution ----------------------------------------------------------
-    def resolved_axes(self, num_devices: int) -> dict[str, int]:
-        """Concrete {axis: size} in canonical order, -1 filled in."""
-        axes = {a: s for a, s in self.axes.items() if s != 0}
+    def resolved_axes(
+        self, num_devices: int, axes: dict[str, int] | None = None
+    ) -> dict[str, int]:
+        """Concrete {axis: size} in canonical order, -1 filled in.
+        ``axes`` overrides ``self.axes`` (used by `build` after resolving
+        the DCN_FILL sentinel against the live device topology)."""
+        axes = {
+            a: s
+            for a, s in (self.axes if axes is None else axes).items()
+            if s != 0
+        }
+        unresolved = [a for a, s in axes.items() if s == DCN_FILL]
+        if unresolved:
+            # sign cancellation would otherwise let DCN_FILL slip through
+            # the coverage check as a garbage negative size
+            raise ValueError(
+                f"axes {unresolved} use DCN_FILL, which needs the live "
+                "device topology: resolve through MeshConfig.build()"
+            )
         if not axes:
             axes = {AXIS_DATA: -1}
         known = 1
@@ -246,10 +286,36 @@ class MeshConfig:
         from jax.experimental import mesh_utils
 
         devices = devices if devices is not None else (self.devices or jax.devices())
-        axes = self.resolved_axes(len(devices))
+        axes_in = dict(self.axes)
+        if any(s == DCN_FILL for s in axes_in.values()):
+            domains = count_dcn_domains(devices)
+            for a, s in list(axes_in.items()):
+                if s == DCN_FILL:
+                    if domains > 1:
+                        axes_in[a] = domains
+                    else:  # one ICI domain: nothing slow to replicate over
+                        axes_in.pop(a)
+            if domains == len(devices):
+                import warnings
+
+                warnings.warn(
+                    "DCN_FILL resolved to one domain per device "
+                    f"({domains}): the shard axis will be size 1 (pure "
+                    "replication). One-process-per-device launches have no "
+                    "visible fast-link grouping — pass an explicit "
+                    "mesh_shape (e.g. data=<hosts>,fsdp=-1) instead.",
+                    stacklevel=2,
+                )
+        axes = self.resolved_axes(len(devices), axes_in)
         names = tuple(axes)
         shape = tuple(axes.values())
-        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+        # the physical hybrid-mesh layout needs REAL slice structure (TPU
+        # only). This deliberately differs from count_dcn_domains on other
+        # platforms: CPU-world "domains" are processes, whose devices are
+        # already process-contiguous in jax.devices(), so the plain
+        # reshape below aligns the outer axis with process boundaries.
+        is_tpu = any(getattr(d, "platform", "") == "tpu" for d in devices)
+        num_slices = count_dcn_domains(devices) if is_tpu else 1
         if num_slices > 1:
             dcn_shape, ici_shape = self._split_dcn(axes, num_slices)
             arr = mesh_utils.create_hybrid_device_mesh(
@@ -388,6 +454,16 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     def to_mesh_axes(self) -> dict[str, int]:
         if self.sharding_strategy == "NO_SHARD":
             return {AXIS_DATA: -1}
+        if self.sharding_strategy == "HYBRID_SHARD":
+            # torch-FSDP hybrid = shard within a node, replicate across
+            # nodes. TPU-native reading: replicate across DCN *domains*
+            # (slices on a pod; processes in a CPU world) and shard over
+            # the ICI-connected chips inside each — param gathers never
+            # cross the slow link. DCN_FILL resolves at MeshConfig.build
+            # time against the live topology; a single-domain world (one
+            # slice, however many hosts) degenerates to FULL_SHARD, which
+            # is the right call since everything is ICI-connected.
+            return {AXIS_DATA: DCN_FILL, AXIS_FSDP: -1}
         return {AXIS_FSDP: -1}
 
     @property
