@@ -309,13 +309,13 @@ class MeshConfig:
         axes = self.resolved_axes(len(devices), axes_in)
         names = tuple(axes)
         shape = tuple(axes.values())
-        # the physical hybrid-mesh layout needs REAL slice structure (TPU
-        # only). This deliberately differs from count_dcn_domains on other
-        # platforms: CPU-world "domains" are processes, whose devices are
-        # already process-contiguous in jax.devices(), so the plain
-        # reshape below aligns the outer axis with process boundaries.
-        is_tpu = any(getattr(d, "platform", "") == "tpu" for d in devices)
-        num_slices = count_dcn_domains(devices) if is_tpu else 1
+        # Real slice structure (differing slice_index values) routes
+        # through the DCN-aware hybrid mesh. This intentionally differs
+        # from count_dcn_domains: that helper's process fallback covers
+        # CPU worlds whose devices carry a vacuously-0 slice_index (one
+        # "slice" here — correct, since the plain reshape below already
+        # aligns the outer axis with the process-contiguous device order).
+        num_slices = len({getattr(d, "slice_index", 0) for d in devices})
         if num_slices > 1:
             dcn_shape, ici_shape = self._split_dcn(axes, num_slices)
             arr = mesh_utils.create_hybrid_device_mesh(
