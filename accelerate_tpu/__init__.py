@@ -35,6 +35,7 @@ _LAZY = {
     "skip_first_batches": ".data",
     "DataLoaderShard": ".data",
     "DataLoaderDispatcher": ".data",
+    "DevicePrefetchIterator": ".data",
     "init_empty_weights": ".big_modeling",
     "infer_auto_device_map": ".big_modeling",
     "get_balanced_memory": ".big_modeling",

@@ -26,6 +26,7 @@ import functools
 import math
 import os
 import warnings
+import weakref
 from typing import Any, Callable, Iterable
 
 import jax
@@ -95,7 +96,8 @@ def _is_dataloader(obj: Any) -> bool:
 
 class _CompiledTrainStep:
     """Jit wrapper that pins the output TrainState's shardings to the
-    input's shardings.
+    input's shardings, with cached (near-zero host cost) steady-state
+    dispatch.
 
     Without the pin, XLA is free to pick output shardings for the new
     state (normalized specs, replicated-in sharded-out small leaves), the
@@ -104,35 +106,56 @@ class _CompiledTrainStep:
     layout reshuffle between steps. Pinning out == in makes step 1 the
     steady state and keeps donation layouts exact.
 
-    The pin is keyed by the input state's sharding layout, so a step
-    reused after re-preparing under a different mesh/plan (new Accelerator
-    in a notebook, differently-laid-out checkpoint restore) gets a fresh
-    jit with matching pins rather than outputs silently forced back to a
-    stale layout.
+    The pin is keyed by the input state's (treedef, per-leaf sharding)
+    layout, so a step reused after re-preparing under a different mesh/plan
+    (new Accelerator in a notebook, differently-laid-out checkpoint restore)
+    gets a fresh jit with matching pins rather than outputs silently forced
+    back to a stale layout. The treedef is part of the key: two states with
+    different structures but identical flattened shardings must not share a
+    jit whose out_shardings pytree was built from the first structure.
+
+    Dispatch cost: because out == in is pinned, the state RETURNED by a call
+    is guaranteed to have the layout of the state passed in — so the common
+    `state, m = step(state, batch)` loop is recognized by object identity
+    (a weakref to the last output) and skips the per-leaf layout walk
+    entirely. The pin tree itself is computed only on a layout-cache miss
+    (`_pin_computations` counts these; it stays at 1 for a fixed state
+    structure no matter how many steps run).
+
+    `warmup()` AOT-compiles eagerly (e.g. while the input pipeline fills)
+    and the resulting executable serves subsequent calls, so step 1 of the
+    training loop pays dispatch only, not trace+compile.
     """
 
     def __init__(self, step_fn: Callable, donate: bool):
         self._step_fn = step_fn
         self._donate = donate
-        self._by_layout: dict = {}
+        self._by_layout: dict = {}   # (treedef, leaf shardings) -> jitted
+        self._aot: dict = {}         # same key -> (batch signature, compiled)
+        self._last: tuple | None = None  # (weakref(last out state), fn, jitted)
+        self._pin_computations = 0   # pin-tree builds (cache misses)
 
-    def _ensure(self, state):
+    def _layout_key(self, state):
+        leaves, treedef = jax.tree_util.tree_flatten(state)
         # pin only mesh-placed leaves (NamedSharding, i.e. the state went
         # through prepare): an unprepared state's single-device leaves must
         # stay unspecified or they'd conflict with mesh-wide shard_map
         # calls inside the model (mixtral a2a)
-        pins = jax.tree_util.tree_map(
-            lambda x: x.sharding
-            if isinstance(x, jax.Array)
-            and isinstance(x.sharding, jax.sharding.NamedSharding)
-            else None,
-            state,
+        pins = tuple(
+            leaf.sharding
+            if isinstance(leaf, jax.Array)
+            and isinstance(leaf.sharding, jax.sharding.NamedSharding)
+            else None
+            for leaf in leaves
         )
-        key = tuple(
-            jax.tree_util.tree_leaves(pins, is_leaf=lambda x: x is None)
-        )
+        return (treedef, pins)
+
+    def _ensure(self, state):
+        key = self._layout_key(state)
         jitted = self._by_layout.get(key)
         if jitted is None:
+            self._pin_computations += 1
+            pins = jax.tree_util.tree_unflatten(key[0], list(key[1]))
             # metrics stay unspecified (None) — constraining a potentially
             # large user aux pytree to replicated would force a gather
             jitted = jax.jit(
@@ -141,13 +164,71 @@ class _CompiledTrainStep:
                 out_shardings=(pins, None),
             )
             self._by_layout[key] = jitted
-        return jitted
+        return jitted, key
+
+    @staticmethod
+    def _batch_sig(batch):
+        return (
+            jax.tree_util.tree_structure(batch),
+            tuple(
+                (tuple(leaf.shape), str(leaf.dtype))
+                if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+                else repr(leaf)
+                for leaf in jax.tree_util.tree_leaves(batch)
+            ),
+        )
+
+    def warmup(self, state, *batch):
+        """Eagerly AOT-compile for this state layout and batch shape WITHOUT
+        executing a step (no buffers are donated, no arrays change). Returns
+        the compiled executable; subsequent `__call__`s with matching
+        shapes dispatch straight to it. With the persistent compilation
+        cache enabled (utils.environment.configure_compilation_cache), a
+        relaunch's warmup deserializes instead of recompiling."""
+        jitted, key = self._ensure(state)
+        sig = self._batch_sig(batch)
+        entry = self._aot.get(key)
+        if entry is None or entry[0] != sig:
+            self._aot[key] = (sig, jitted.lower(state, *batch).compile())
+            # drop the identity fast path: it would keep dispatching to the
+            # callable captured before this warmup and never consult the
+            # fresh executable (e.g. warming up for an upcoming batch-shape
+            # change mid-loop)
+            self._last = None
+        return self._aot[key][1]
 
     def __call__(self, state, *batch):
-        return self._ensure(state)(state, *batch)
+        last = self._last
+        if last is not None and last[0]() is state:
+            # steady state: this state object IS our previous output, whose
+            # layout the out_shardings pin fixed — no tree walk needed
+            fn, jitted = last[1], last[2]
+        else:
+            jitted, key = self._ensure(state)
+            fn = jitted
+            aot = self._aot.get(key)
+            if aot is not None and aot[0] == self._batch_sig(batch):
+                fn = aot[1]
+        try:
+            out = fn(state, *batch)
+        except (TypeError, ValueError):
+            if fn is jitted:
+                raise
+            # batch shape/dtype drifted from the warmed-up signature (the
+            # identity fast path skips the signature check); the AOT
+            # executable rejects the args before any donation, so falling
+            # back to the jit path is safe
+            fn = jitted
+            out = jitted(state, *batch)
+        try:
+            ref = weakref.ref(out[0])
+        except TypeError:  # plain-container states (dicts) aren't weakref-able
+            ref = None
+        self._last = None if ref is None else (ref, fn, jitted)
+        return out
 
     def lower(self, state, *batch):
-        return self._ensure(state).lower(state, *batch)
+        return self._ensure(state)[0].lower(state, *batch)
 
     def _cache_size(self) -> int:
         return sum(j._cache_size() for j in self._by_layout.values())
@@ -542,7 +623,17 @@ class Accelerator:
         params = shard_pytree(ts.params, param_plan)
         opt_plan = plan_optimizer_sharding(ts.tx, ts.opt_state, opt_plan_source, self.mesh)
         self._warn_unsharded_quantized_moments(opt_plan)
-        opt_state = shard_pytree(ts.opt_state, opt_plan)
+        # Optimizers whose init returns the params THEMSELVES as state
+        # (optax.contrib.schedule_free's z, lookahead's slow weights) make
+        # the donated fused step hand XLA the same buffer twice ("Attempt to
+        # donate the same buffer twice"), and on the CPU collective backend
+        # the failed replicated Execute wedges every later collective. Copy
+        # exactly the aliased leaves before placement.
+        param_ids = {id(l) for l in jax.tree_util.tree_leaves(ts.params)}
+        opt_state_src = jax.tree_util.tree_map(
+            lambda x: jnp.array(x) if id(x) in param_ids else x, ts.opt_state
+        )
+        opt_state = shard_pytree(opt_state_src, opt_plan)
         needs_scale = self.state.mixed_precision == PrecisionType.FP16
         # Place the remaining leaves on the mesh too: a stray
         # SingleDeviceSharding leaf forces train_step to recompile on its

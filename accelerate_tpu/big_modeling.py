@@ -204,6 +204,20 @@ def _place_flat(
         nonlocal pending_bytes
         kind, dev = _resolve_target(target)
         if kind == "device":
+            if (
+                isinstance(arr, jax.Array)
+                and getattr(arr, "_committed", False)
+                and all(
+                    d.platform != "cpu" for d in arr.sharding.device_set
+                )
+            ):
+                # already resident on an accelerator (e.g. re-dispatching a
+                # loaded model): np.asarray here would pull it device->host
+                # and re-upload through the staging batches. device_put moves
+                # it device->device (or leaves it in place) instead.
+                setter(_place_one(key, arr, target, offload_folder,
+                                  offload_index))
+                return
             arr = np.asarray(arr)
             pending.append((setter, arr, dev))
             pending_bytes += arr.nbytes

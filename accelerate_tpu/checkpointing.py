@@ -145,6 +145,36 @@ def _restore_pytree(path: str, like: Any) -> Any:
     return jax.tree_util.tree_map(_replace, restored, like)
 
 
+def _restore_fp8_state(fp8_dir: str, live_fp8_state):
+    """Restore delayed-scaling state, adapting `amax_history` window-length
+    mismatches instead of failing on shape mismatch: checkpoints written
+    under a different `FP8RecipeKwargs.amax_history_len` (notably the old
+    TE-style 1024 default) restore with their newest entries truncated (or
+    zero-padded) into the live window. See docs/checkpointing.md "Migration
+    notes"."""
+    from .ops.fp8 import adapt_history_len, fp8_state_history_len
+
+    live_len = fp8_state_history_len(live_fp8_state)
+    saved_len = live_len
+    meta_path = fp8_dir + ".json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            saved_len = json.load(f).get("amax_history_len", live_len)
+    like = live_fp8_state
+    if saved_len is not None and live_len is not None and saved_len != live_len:
+        logger.warning(
+            "fp8 amax_history_len mismatch: checkpoint has %d, live state "
+            "wants %d; restoring the newest %d entries (%s).",
+            saved_len, live_len, min(saved_len, live_len),
+            "truncating" if saved_len > live_len else "zero-padding the tail",
+        )
+        like = adapt_history_len(live_fp8_state, saved_len)
+    restored = _restore_pytree(fp8_dir, {"fp8_state": like})["fp8_state"]
+    if saved_len is not None and live_len is not None and saved_len != live_len:
+        restored = adapt_history_len(restored, live_len)
+    return restored
+
+
 def _train_state_payload(ts) -> dict:
     payload = {"step": ts.step, "params": ts.params, "opt_state": ts.opt_state}
     if ts.loss_scale is not None:
@@ -176,6 +206,23 @@ def save_accelerator_state(
         _save_pytree(_train_state_payload(ts),
                      os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
                      async_save=async_save)
+        if getattr(ts, "fp8_state", None) is not None:
+            # separate dir + window-length sidecar: restore builds its
+            # like-tree against the ON-DISK amax window, so a recipe change
+            # (e.g. the old 1024 default -> today's 16) adapts instead of
+            # failing orbax's shape check
+            from .ops.fp8 import fp8_state_history_len
+
+            _save_pytree({"fp8_state": ts.fp8_state},
+                         os.path.join(output_dir, f"{MODEL_NAME}_{i}_fp8"),
+                         async_save=async_save)
+            if state.is_main_process:
+                with open(os.path.join(output_dir,
+                                       f"{MODEL_NAME}_{i}_fp8.json"), "w") as f:
+                    json.dump(
+                        {"amax_history_len": fp8_state_history_len(ts.fp8_state)},
+                        f,
+                    )
     for i, opt in enumerate(optimizers):
         payload = {}
         if opt.opt_state is not None:
@@ -257,6 +304,9 @@ def load_accelerator_state(
                 scale=payload["loss_scale"]["scale"],
                 growth_tracker=payload["loss_scale"]["growth_tracker"],
             )
+        fp8_dir = os.path.join(input_dir, f"{MODEL_NAME}_{i}_fp8")
+        if getattr(ts, "fp8_state", None) is not None and os.path.isdir(fp8_dir):
+            ts.fp8_state = _restore_fp8_state(fp8_dir, ts.fp8_state)
         out["train_states"].append(ts)
 
     for i, opt in enumerate(optimizers):

@@ -17,13 +17,23 @@ Sharding across hosts keeps the reference's `BatchSamplerShard` /
 mid-epoch resume via `skip_first_batches` (ref :1082). The uneven-tail
 `remainder` feeds `gather_for_metrics` (ref accelerator.py:2331).
 
-Async host->device prefetch (the reference's one-batch-ahead lookahead,
-ref data_loader.py:445-476) runs on a background thread feeding a bounded
-queue; `jax.device_put` is itself asynchronous, so compute overlaps transfer.
+Async input pipeline, two stages (the reference's one-batch-ahead lookahead,
+ref data_loader.py:445-476, plus the torch-xla `MpDeviceLoader` double
+buffer):
+
+1. a background thread runs the HOST work (collate -> numpy -> pad) into a
+   bounded queue (`_PrefetchIterator`), and
+2. the consumer side keeps up to `device_prefetch_depth` batches' host->device
+   transfers in flight (`DevicePrefetchIterator`) — `jax.device_put` /
+   `make_array_from_process_local_data` are asynchronous, so batch i+1's
+   transfer overlaps step i's on-device compute and the steady-state step
+   never stalls on input.
 """
 
 from __future__ import annotations
 
+import collections
+import functools
 import itertools
 import math
 import queue
@@ -584,6 +594,26 @@ class IterableDatasetShard:
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=64)
+def _mesh_batch_layout(mesh, batch_axes: tuple):
+    """(batch NamedSharding, replicated NamedSharding, dp) for a mesh — the
+    per-batch sharding objects are identical every step, so they are resolved
+    once per (mesh, axes) instead of rebuilt per leaf per batch (host-dispatch
+    cost on the hot input path)."""
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    dp = 1
+    for a in axes:
+        dp *= mesh.shape[a]
+    spec = jax.sharding.PartitionSpec(
+        axes if len(axes) > 1 else axes[0] if axes else None
+    )
+    return (
+        jax.sharding.NamedSharding(mesh, spec),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        dp,
+    )
+
+
 def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
     """Assemble per-host numpy batches into global `jax.Array`s sharded over
     the mesh's batch axes (the TPU replacement for `send_to_device`,
@@ -593,10 +623,7 @@ def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
     """
     if mesh is None:
         mesh = PartialState().mesh
-    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
-    dp = 1
-    for a in axes:
-        dp *= mesh.shape[a]
+    sharded, replicated, dp = _mesh_batch_layout(mesh, tuple(batch_axes))
 
     def _make(x):
         x = _to_numpy(x)
@@ -611,10 +638,9 @@ def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
                     f"not divisible by dp={dp}; pad the batch (see pad_batch_to) "
                     "before make_global_batch on multi-host runs"
                 )
-            spec = jax.sharding.PartitionSpec()
+            sharding = replicated
         else:
-            spec = jax.sharding.PartitionSpec(axes if len(axes) > 1 else axes[0] if axes else None)
-        sharding = jax.sharding.NamedSharding(mesh, spec)
+            sharding = sharded
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(_make, batch)
@@ -687,6 +713,55 @@ class _PrefetchIterator:
         return item
 
 
+class DevicePrefetchIterator:
+    """Keep up to `depth` batches' host->device transfers in flight ahead of
+    the consumer (the device-side half of the input double buffer).
+
+    `place` issues the async transfer (typically `make_global_batch`, i.e.
+    `jax.device_put` onto the mesh `NamedSharding`); because JAX transfers are
+    asynchronous, calling it here only *enqueues* the copy — batch i+1 (and
+    deeper, up to `depth`) streams into HBM while the compiled step for batch
+    i executes, so a steady-state step finds its input already resident
+    instead of paying a synchronous host->device copy at dispatch time.
+
+    `depth=2` is classic double buffering; deeper pipelines trade HBM for
+    tolerance to jittery host-side batch times. ``depth`` is floored to 1 —
+    this class IS the buffer, so it cannot express "no buffering"; to
+    disable device-side prefetch entirely use the loader knob
+    (``DataLoaderConfiguration.device_prefetch_depth = 0``), which bypasses
+    this iterator and issues each transfer at hand-out time.
+    """
+
+    def __init__(self, source: Iterable, place: Callable, depth: int = 2):
+        self._source = iter(source)
+        self._place = place
+        self._depth = max(1, int(depth))
+        self._buffer: collections.deque = collections.deque()
+        self._exhausted = False
+
+    def __iter__(self):
+        return self
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._buffer) < self._depth:
+            try:
+                item = next(self._source)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._buffer.append(self._place(item))
+
+    def __next__(self):
+        self._fill()
+        if not self._buffer:
+            raise StopIteration
+        item = self._buffer.popleft()
+        # enqueue the NEXT transfer before handing this batch out, so it is
+        # in flight for the whole duration of the consumer's step
+        self._fill()
+        return item
+
+
 class DataLoaderStateMixin:
     """end_of_dataloader / remainder bookkeeping hooked into GradientState
     (ref data_loader.py:355-390)."""
@@ -710,6 +785,9 @@ class DataLoaderShard(DataLoaderStateMixin):
     - uneven final batch padded by wraparound; true sample count recorded in
       `remainder` for `gather_for_metrics`
     - per-epoch host RNG sync for torch/numpy-driven pipelines
+    - device-side double buffering: host prep runs on the background thread,
+      and up to `device_prefetch_depth` batches' async device transfers stay
+      in flight ahead of the training step (`DevicePrefetchIterator`)
     """
 
     def __init__(
@@ -722,6 +800,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         prefetch_size: int = 2,
         even_batches: bool = True,
         generator=None,
+        device_prefetch_depth: int = 2,
     ):
         self.loader = loader
         self.mesh = mesh
@@ -731,6 +810,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.prefetch_size = prefetch_size
         self.even_batches = even_batches
         self.generator = generator
+        self.device_prefetch_depth = device_prefetch_depth
         self.gradient_state = GradientState()
         self.epoch = 0
 
@@ -758,7 +838,12 @@ class DataLoaderShard(DataLoaderStateMixin):
             if obj is not None and hasattr(obj, "set_epoch"):
                 obj.set_epoch(epoch)
 
-    def _prepare(self, batch):
+    def _prepare_host(self, batch):
+        """Host half of batch prep (runs on the background prefetch thread):
+        numpy conversion + tail padding + remainder bookkeeping. No device
+        work happens here — the transfer is issued by the consumer-side
+        `DevicePrefetchIterator` so its depth (not the host queue's) bounds
+        in-flight HBM."""
         batch = batch_to_numpy(batch)
         n = _batch_size(batch)
         per_host = self.dp_size // jax.process_count()
@@ -778,9 +863,18 @@ class DataLoaderShard(DataLoaderStateMixin):
             remainder = n * jax.process_count()
             tail_layout = (jax.process_count(), target, n)
             batch = pad_batch_to(batch, target, rows=n)
-        if self.put_on_device:
-            batch = make_global_batch(batch, self.mesh, self.batch_axes)
         return batch, remainder, tail_layout
+
+    def _place(self, item):
+        """Device half: issue the async transfer onto the mesh sharding."""
+        batch, remainder, tail_layout = item
+        return make_global_batch(batch, self.mesh, self.batch_axes), remainder, tail_layout
+
+    def _prepare(self, batch):
+        """Full prep for one batch (host + device) — kept as the single-shot
+        path for callers that bypass the pipelined iterator."""
+        item = self._prepare_host(batch)
+        return self._place(item) if self.put_on_device else item
 
     def __iter__(self):
         if self.rng_types is not None:
@@ -788,7 +882,16 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.begin()
         try:
             source = iter(self.loader)
-            prepared = _PrefetchIterator(source, self._prepare, self.prefetch_size)
+            prepared = _PrefetchIterator(
+                source, self._prepare_host, self.prefetch_size
+            )
+            if self.put_on_device:
+                if self.device_prefetch_depth > 0:
+                    prepared = DevicePrefetchIterator(
+                        prepared, self._place, self.device_prefetch_depth
+                    )
+                else:
+                    prepared = map(self._place, prepared)
             current = next(prepared, _SENTINEL)
             while current is not _SENTINEL:
                 nxt = next(prepared, _SENTINEL)
@@ -997,23 +1100,48 @@ def prepare_data_loader(
     mesh=None,
     batch_axes=BATCH_AXES,
     config: DataLoaderConfiguration | None = None,
+    prefetch_size: int | None = None,
+    device_prefetch_depth: int | None = None,
 ):
     """Shard any batch iterable across hosts and emit global sharded arrays.
 
     Accepts a torch `DataLoader` (rebuilt around a `BatchSamplerShard` over
     its dataset — ref data_loader.py:887-1000), a plain iterable of batches,
     or an iterable dataset (wrapped in `IterableDatasetShard`).
+
+    An explicit ``prefetch_size``/``device_prefetch_depth`` argument wins
+    over ``config``; unset (None) falls back to the config (or its
+    defaults). The prefetch knobs apply to the sharded path only — the
+    dispatcher (``dispatch_batches=True``) is broadcast-driven and does not
+    prefetch (eager rank-0 fetches would reorder its collectives against
+    the training step's on multi-host worlds).
     """
+    explicit_prefetch = (prefetch_size, device_prefetch_depth) != (None, None)
     if config is not None:
         split_batches = config.split_batches
         dispatch_batches = config.dispatch_batches
         even_batches = config.even_batches
         use_seedable_sampler = config.use_seedable_sampler
+    if prefetch_size is None:
+        prefetch_size = config.prefetch_size if config is not None else 2
+    if device_prefetch_depth is None:
+        device_prefetch_depth = (
+            config.device_prefetch_depth if config is not None else 2
+        )
     state = PartialState()
     num_processes = num_processes if num_processes is not None else state.num_processes
     process_index = process_index if process_index is not None else state.process_index
 
     if dispatch_batches:
+        if explicit_prefetch:
+            import warnings
+
+            warnings.warn(
+                "prefetch_size/device_prefetch_depth have no effect with "
+                "dispatch_batches=True: the dispatcher is broadcast-driven "
+                "and fetches in lockstep with the step loop.",
+                stacklevel=2,
+            )
         return DataLoaderDispatcher(
             dataloader,
             mesh=mesh,
@@ -1053,6 +1181,8 @@ def prepare_data_loader(
         rng_types=rng_types,
         put_on_device=put_on_device,
         even_batches=even_batches,
+        prefetch_size=prefetch_size,
+        device_prefetch_depth=device_prefetch_depth,
     )
 
 

@@ -176,6 +176,55 @@ def fp8_expert_dense(
     return out, new_meta
 
 
+def fp8_state_history_len(fp8_state) -> int | None:
+    """The amax-history window length of a delayed-scaling state tree (from
+    its first `Fp8Meta` leaf), or None when the tree holds none."""
+    for leaf in jax.tree_util.tree_leaves(
+        fp8_state, is_leaf=lambda x: isinstance(x, Fp8Meta)
+    ):
+        if isinstance(leaf, Fp8Meta):
+            return int(leaf.amax_history.shape[-1])
+    return None
+
+
+def adapt_history_len(fp8_state, history_len: int):
+    """Resize every `Fp8Meta.amax_history` window (last dim) to
+    ``history_len``: truncation keeps the NEWEST entries (index 0 is the
+    most recent — `update_meta` rolls right), padding appends zeros (a zero
+    amax is "no observation" and never wins the max). Scales pass through
+    untouched, so the restored schedule continues exactly where it left off.
+
+    Accepts abstract leaves (`jax.ShapeDtypeStruct`) too, so checkpoint
+    restore can build a like-tree matching an on-disk window that differs
+    from the live config — e.g. old checkpoints written under TE's 1024
+    default restoring into today's 16-step window.
+    """
+
+    def _adapt(meta):
+        if not isinstance(meta, Fp8Meta):
+            return meta
+        hist = meta.amax_history
+        h = int(hist.shape[-1])
+        if h == history_len:
+            return meta
+        if isinstance(hist, jax.ShapeDtypeStruct):
+            shape = tuple(hist.shape[:-1]) + (history_len,)
+            return Fp8Meta(
+                scale=meta.scale,
+                amax_history=jax.ShapeDtypeStruct(shape, hist.dtype),
+            )
+        if h > history_len:
+            new = hist[..., :history_len]
+        else:
+            pad = [(0, 0)] * (hist.ndim - 1) + [(0, history_len - h)]
+            new = jnp.pad(hist, pad)
+        return Fp8Meta(scale=meta.scale, amax_history=new)
+
+    return jax.tree_util.tree_map(
+        _adapt, fp8_state, is_leaf=lambda x: isinstance(x, Fp8Meta)
+    )
+
+
 def resolve_history_len(explicit: int | None = None) -> int:
     """amax-history window: explicit arg > the live Accelerator's
     `FP8RecipeKwargs` kwargs-handler > the dataclass default (16 here — TE's

@@ -33,12 +33,15 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import AXIS_SEQ
+from ..utils.imports import resolve_shard_map
 from ..models.common import repeat_kv as _repeat_heads
 from ..ops.flash_attention import (
     _flash_backward,
     _flash_forward,
     _pow2_floor,
 )
+
+_shard_map = resolve_shard_map()
 
 NEG_INF = -1e30
 
@@ -473,7 +476,7 @@ def ring_attention(
                 axis_size=axis_size, causal=causal, n_rep=n_rep,
                 interpret=interpret,
             )
-            return jax.shard_map(
+            return _shard_map(
                 fn, mesh=mesh,
                 in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
                 out_specs=seq_spec,
@@ -489,13 +492,13 @@ def ring_attention(
             axis_size=axis_size, causal=causal, n_rep=n_rep, window=window,
         )
         if mask is not None:
-            return jax.shard_map(
+            return _shard_map(
                 fn, mesh=mesh,
                 in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
                 out_specs=seq_spec,
                 check_vma=False,
             )(q, k, v, mask)
-    return jax.shard_map(
+    return _shard_map(
         fn, mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
         out_specs=seq_spec,

@@ -33,9 +33,14 @@ from .utils.constants import TPU_PEAK_FLOPS
 def profile(logdir: str = "/tmp/accelerate_tpu_trace",
             host_tracer_level: int = 2) -> Iterator[None]:
     """Capture an XLA execution trace viewable in TensorBoard/Perfetto."""
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(logdir, profiler_options=options)
+    # ProfileOptions only exists in newer jax; older runtimes take no options
+    options_cls = getattr(jax.profiler, "ProfileOptions", None)
+    if options_cls is not None:
+        options = options_cls()
+        options.host_tracer_level = host_tracer_level
+        jax.profiler.start_trace(logdir, profiler_options=options)
+    else:
+        jax.profiler.start_trace(logdir)
     try:
         yield
     finally:
@@ -91,15 +96,33 @@ def causal_lm_train_flops(n_params: int, tokens: int,
 
 @dataclass
 class StepTimer:
-    """Per-step timing + throughput/MFU meter.
+    """Per-step timing + throughput/MFU meter, with host-overhead breakdown.
 
     Usage::
 
         timer = StepTimer(flops_per_step=..., tokens_per_step=...)
-        for batch in loader:
-            state, metrics = step(state, batch)
+        it = iter(loader)
+        while True:
+            with timer.input_stall():      # time blocked on the pipeline
+                batch = next(it, None)
+            if batch is None:
+                break
+            with timer.dispatch():         # host-side cost of the step call
+                state, metrics = step(state, batch)
             timer.tick(state)          # blocks on `state` to time honestly
         print(timer.summary())
+
+    The two context managers isolate the overheads the device never sees:
+    `dispatch()` wraps the python `step(...)` call — on an async backend
+    (TPU) the call returns as soon as XLA execution is enqueued, so its
+    wall time IS the per-step host dispatch cost (pytree flatten, sharding
+    checks, argument processing), and a cached dispatch path shows up as
+    microsecond readings. On the CPU backend execution is largely
+    synchronous inside the call, so the reading absorbs device compute and
+    only upper-bounds the host share. `input_stall()` wraps the
+    `next(loader)` call — nonzero readings mean the device finished before
+    its next batch was ready (input-bound step). Both respect
+    `warmup_steps`.
     """
 
     flops_per_step: float = 0.0
@@ -108,8 +131,12 @@ class StepTimer:
     peak_flops: float | None = None
     num_chips: int | None = None
     _times: list[float] = field(default_factory=list)
+    _dispatch_times: list[float] = field(default_factory=list)
+    _stall_times: list[float] = field(default_factory=list)
     _last: float | None = None
     _seen: int = 0
+    _dispatch_seen: int = 0
+    _stall_seen: int = 0
 
     def tick(self, block_on: Any = None) -> float | None:
         """Record one step boundary; returns this step's seconds (or None
@@ -126,6 +153,41 @@ class StepTimer:
                 self._times.append(elapsed)
         self._last = now
         return elapsed
+
+    @contextlib.contextmanager
+    def dispatch(self) -> Iterator[None]:
+        """Time the host-side dispatch of one step (wrap the `step(...)`
+        call). The first `warmup_steps` readings are excluded (compile +
+        first dispatch), mirroring `tick`."""
+        t0 = time.perf_counter()
+        yield
+        self._dispatch_seen += 1
+        if self._dispatch_seen > self.warmup_steps:
+            self._dispatch_times.append(time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def input_stall(self) -> Iterator[None]:
+        """Time spent blocked waiting on the input pipeline (wrap the
+        `next(loader)` call)."""
+        t0 = time.perf_counter()
+        yield
+        self._stall_seen += 1
+        if self._stall_seen > self.warmup_steps:
+            self._stall_times.append(time.perf_counter() - t0)
+
+    @property
+    def host_dispatch_us(self) -> float:
+        """Mean host-dispatch microseconds per (post-warmup) step."""
+        if not self._dispatch_times:
+            return float("nan")
+        return 1e6 * sum(self._dispatch_times) / len(self._dispatch_times)
+
+    @property
+    def input_stall_us(self) -> float:
+        """Mean microseconds per (post-warmup) step spent waiting on input."""
+        if not self._stall_times:
+            return float("nan")
+        return 1e6 * sum(self._stall_times) / len(self._stall_times)
 
     @property
     def steps_recorded(self) -> int:
@@ -167,4 +229,8 @@ class StepTimer:
             out["tokens_per_sec_per_chip"] = self.tokens_per_sec / max(1, chips)
         if self.flops_per_step:
             out["mfu"] = self.mfu()
+        if self._dispatch_times:
+            out["host_dispatch_us_mean"] = self.host_dispatch_us
+        if self._stall_times:
+            out["input_stall_us_mean"] = self.input_stall_us
         return out

@@ -161,6 +161,22 @@ def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Me
         is_quant(leaf)
         for leaf in jax.tree_util.tree_leaves(opt_state, is_leaf=is_quant)
     )
+
+    # Quantized moments are handled as an OVERLAY on the tree_map_params
+    # result, not an early return: a composed optimizer (e.g.
+    # optax.chain(adamw_8bit, <transform with param-shaped state like
+    # ema/trace>)) must keep ZeRO sharding for its non-quantized param-shaped
+    # moments. Each _Quantized subtree is first masked to a single marker
+    # leaf so the state zips structurally against the param plan, then the
+    # markers are resolved to blocks-dim specs.
+    class _QuantMarker:
+        __slots__ = ("blocks",)
+
+        def __init__(self, blocks: int):
+            self.blocks = blocks
+
+    quant_plan = None
+    state_for_map = opt_state
     if has_quant:
         fsdp_size = _axis_sizes(mesh).get(AXIS_FSDP, 1)
         plan_wants_sharding = any(
@@ -175,10 +191,7 @@ def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Me
             else replicated
         )
 
-        def quant_or_replicate(node):
-            if not is_quant(node):
-                return replicated  # counts/scalars of the surrounding state
-            blocks = node.q.shape[0]
+        def quant_plan(blocks: int):
             if (
                 plan_wants_sharding
                 and fsdp_size > 1
@@ -192,22 +205,41 @@ def plan_optimizer_sharding(optimizer, opt_state: Any, param_plan: Any, mesh: Me
                 )
             return _Quantized(q=replicated, scale=replicated)
 
-        return jax.tree_util.tree_map(
-            quant_or_replicate, opt_state, is_leaf=is_quant
-        )
-    try:
-        mapped = optax.tree_map_params(
-            optimizer,
-            lambda _, sharding: sharding,
+        state_for_map = jax.tree_util.tree_map(
+            lambda n: _QuantMarker(int(n.q.shape[0])) if is_quant(n) else n,
             opt_state,
-            param_plan,
-            transform_non_params=lambda _: replicated,
+            is_leaf=is_quant,
         )
-        return mapped
+
+    def _map_param(leaf, sharding):
+        if isinstance(leaf, _QuantMarker):
+            return quant_plan(leaf.blocks)
+        return sharding
+
+    def _map_non_param(leaf):
+        if isinstance(leaf, _QuantMarker):
+            return quant_plan(leaf.blocks)
+        return replicated
+
+    try:
+        return optax.tree_map_params(
+            optimizer,
+            _map_param,
+            state_for_map,
+            param_plan,
+            transform_non_params=_map_non_param,
+        )
     except Exception:
-        # fallback: shape-match each leaf against nothing -> replicate
+        # fallback: replicate non-quantized leaves; quantized moments keep
+        # their blocks-dim specs (the 8-bit-Adam x ZeRO composition must not
+        # silently degrade just because the surrounding transform's state
+        # confused tree_map_params)
         logger.warning("optax.tree_map_params failed; replicating optimizer state")
-        return jax.tree_util.tree_map(lambda _: replicated, opt_state)
+        return jax.tree_util.tree_map(
+            lambda n: quant_plan(int(n.q.shape[0])) if is_quant(n) else replicated,
+            opt_state,
+            is_leaf=is_quant,
+        )
 
 
 def count_replicated_quantized(opt_plan: Any) -> tuple[int, int]:

@@ -114,6 +114,13 @@ class PartialState:
                     "CPU backend requested but a JAX backend is already "
                     "initialized; keeping the existing platform."
                 )
+        # persistent XLA compilation cache: configured here (the one choke
+        # point every entry path crosses before compiling) so a relaunch
+        # deserializes yesterday's executables instead of recompiling.
+        # ACCELERATE_TPU_COMPILATION_CACHE overrides the dir or disables.
+        from .utils.environment import configure_compilation_cache
+
+        self.compilation_cache_dir = configure_compilation_cache()
         self.multi_host = _maybe_init_jax_distributed(timeout_s)
         self.debug = parse_flag_from_env(ENV_DEBUG_MODE)
         self._devices = list(jax.devices())

@@ -32,6 +32,16 @@ ENV_CPU = ENV_PREFIX + "USE_CPU"
 ENV_FORCE_HOST_DEVICES = ENV_PREFIX + "HOST_DEVICE_COUNT"  # virtual CPU devices
 # engine/plugin selection (serialized by `accelerate-tpu config`/`launch`,
 # resolved to plugins in Accelerator.__init__ — a saved yaml is launch-ready)
+# persistent XLA compilation cache (utils/environment.py
+# configure_compilation_cache, wired at PartialState init): dir override, or
+# 0/off/false to disable; threshold overrides forward to the jax knobs
+ENV_COMPILATION_CACHE = ENV_PREFIX + "COMPILATION_CACHE"
+ENV_COMPILATION_CACHE_MIN_COMPILE_SECS = (
+    ENV_PREFIX + "COMPILATION_CACHE_MIN_COMPILE_SECS"
+)
+ENV_COMPILATION_CACHE_MIN_ENTRY_BYTES = (
+    ENV_PREFIX + "COMPILATION_CACHE_MIN_ENTRY_BYTES"
+)
 ENV_ZERO_STAGE = ENV_PREFIX + "ZERO_STAGE"            # 0-3 -> DeepSpeedPlugin
 ENV_FSDP_STRATEGY = ENV_PREFIX + "FSDP_SHARDING_STRATEGY"  # FULL_SHARD|...
 ENV_CP_MODE = ENV_PREFIX + "CONTEXT_PARALLEL_MODE"    # none|ring|ulysses

@@ -407,7 +407,13 @@ class DataLoaderConfiguration(KwargsHandler):
     even_batches: bool = True
     use_seedable_sampler: bool = True
     non_blocking: bool = True  # async host->device transfer
-    prefetch_size: int = 2
+    prefetch_size: int = 2  # host-prep batches buffered on the worker thread
+    # device-side double-buffer depth: how many batches' async host->device
+    # transfers stay in flight ahead of the step (data.DevicePrefetchIterator);
+    # 0 disables the device buffer (transfers issue at hand-out time).
+    # Both prefetch knobs apply to the sharded loader path only; the
+    # dispatcher (dispatch_batches=True) is broadcast-driven and ignores them
+    device_prefetch_depth: int = 2
 
 
 @dataclass

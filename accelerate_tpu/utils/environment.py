@@ -87,6 +87,93 @@ def force_cpu_platform() -> bool:
         return False
 
 
+_compilation_cache_dir_applied: str | None = None
+
+
+def default_compilation_cache_dir() -> str:
+    """~/.cache/accelerate_tpu/compilation (XDG_CACHE_HOME honoured)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "accelerate_tpu", "compilation")
+
+
+def configure_compilation_cache(
+    cache_dir: str | None = None, force: bool = False
+) -> str | None:
+    """Wire jax's persistent compilation cache so relaunches deserialize
+    executables instead of recompiling (minutes of XLA work at real model
+    sizes; the dominant cost of a restart on TPU pods).
+
+    Resolution: explicit ``cache_dir`` arg > ``ACCELERATE_TPU_COMPILATION_CACHE``
+    env > a ``jax_compilation_cache_dir`` the user already configured (left
+    untouched) > the default user cache dir. A value of ``0``/``off``/
+    ``false``/``none`` (env or arg) disables. Threshold overrides
+    ``ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS`` / ``_MIN_ENTRY_BYTES``
+    forward to the matching jax knobs (jax's defaults otherwise: entries
+    cheaper than ~1 s of compile are not persisted).
+
+    Safe to call any time — including after compiles have already happened:
+    jax memoizes "is the cache in use" at first compile, so when the dir
+    changes the cache state is reset to re-evaluate. Returns the active dir,
+    or None when disabled. Idempotent per resolved dir unless ``force``.
+    """
+    global _compilation_cache_dir_applied
+    from .constants import (
+        ENV_COMPILATION_CACHE,
+        ENV_COMPILATION_CACHE_MIN_COMPILE_SECS,
+        ENV_COMPILATION_CACHE_MIN_ENTRY_BYTES,
+    )
+
+    _OFF = {"0", "off", "false", "no", "none", "disabled"}
+    if cache_dir is None:
+        cache_dir = os.environ.get(ENV_COMPILATION_CACHE)
+    if cache_dir is not None and cache_dir.strip().lower() in _OFF:
+        return None
+    import jax
+
+    def _apply_thresholds() -> None:
+        min_secs = os.environ.get(ENV_COMPILATION_CACHE_MIN_COMPILE_SECS)
+        if min_secs is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", float(min_secs)
+            )
+        min_bytes = os.environ.get(ENV_COMPILATION_CACHE_MIN_ENTRY_BYTES)
+        if min_bytes is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", int(min_bytes)
+            )
+
+    if cache_dir is None:
+        existing = jax.config.jax_compilation_cache_dir
+        if existing:
+            # user already configured jax directly: keep their dir, but the
+            # threshold env overrides still apply
+            _apply_thresholds()
+            return existing
+        cache_dir = default_compilation_cache_dir()
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    if cache_dir == _compilation_cache_dir_applied and not force:
+        _apply_thresholds()
+        return cache_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None  # unwritable cache location (read-only HOME): skip
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    _apply_thresholds()
+    # jax checks cache usability once, at the first compile, and memoizes the
+    # answer — a process that already compiled something (test suites, REPL
+    # exploration before Accelerator()) would otherwise silently keep "no
+    # cache" forever. reset_cache() drops that memo; the next compile
+    # re-initializes against the dir configured above.
+    from jax.experimental.compilation_cache import compilation_cache
+
+    compilation_cache.reset_cache()
+    _compilation_cache_dir_applied = cache_dir
+    return cache_dir
+
+
 @contextlib.contextmanager
 def patch_environment(**kwargs: Any) -> Iterator[None]:
     """Temporarily set env vars; restores previous values on exit

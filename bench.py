@@ -93,6 +93,18 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         best = min(best, time.perf_counter() - t0)
     dt = best
 
+    # per-step HOST dispatch cost (the python step() call returns once XLA
+    # execution is enqueued): isolates the framework's steady-state overhead
+    # from the compiled program's runtime. Cached dispatch should keep this
+    # in single-digit microseconds per state leaf.
+    dispatch = []
+    for _ in range(steps):
+        d0 = time.perf_counter()
+        ts, m = step(ts, batch_arrays)
+        dispatch.append(time.perf_counter() - d0)
+    float(m["loss"])
+    host_dispatch_us = 1e6 * sum(dispatch) / len(dispatch)
+
     n_chips = jax.device_count()
     tokens_per_step = batch * seq
     tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
@@ -115,6 +127,7 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         "wall_s": round(dt, 2),
         "device": device_kind,
         "n_chips": n_chips,
+        "host_dispatch_us": round(host_dispatch_us, 1),
     }
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",

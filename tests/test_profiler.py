@@ -45,6 +45,31 @@ def test_step_timer_warmup_excluded():
     assert math.isnan(timer.mean_step_time)
 
 
+def test_step_timer_host_overhead_metrics():
+    timer = StepTimer(warmup_steps=1)
+    for _ in range(4):
+        with timer.input_stall():
+            pass
+        with timer.dispatch():
+            pass
+        timer.tick()
+    # warmup excluded: first iteration's readings (seen < warmup) dropped
+    assert len(timer._dispatch_times) == 3
+    assert len(timer._stall_times) == 3
+    assert timer.host_dispatch_us >= 0
+    assert timer.input_stall_us >= 0
+    summary = timer.summary()
+    assert "host_dispatch_us_mean" in summary
+    assert "input_stall_us_mean" in summary
+
+
+def test_step_timer_host_overhead_empty_is_nan():
+    timer = StepTimer()
+    assert math.isnan(timer.host_dispatch_us)
+    assert math.isnan(timer.input_stall_us)
+    assert "host_dispatch_us_mean" not in timer.summary()
+
+
 def test_mfu_math():
     timer = StepTimer(flops_per_step=1e12, peak_flops=1e13, num_chips=1,
                       warmup_steps=0)

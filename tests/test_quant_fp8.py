@@ -706,3 +706,64 @@ def test_mixtral_fp8_with_remat_trains():
     # the guarded regression: remat must not drop the fp8 meta updates
     scale = ts.fp8_state["layers"]["attn"]["q_proj"]["x"].scale
     assert not np.allclose(np.asarray(scale), 1.0)
+
+
+# -- fp8 checkpoint window migration ------------------------------------------
+
+
+def test_adapt_history_len_truncates_newest_first_and_pads():
+    from accelerate_tpu.ops.fp8 import adapt_history_len, fp8_state_history_len
+
+    meta = Fp8Meta(scale=jnp.float32(3.0),
+                   amax_history=jnp.arange(8, dtype=jnp.float32))
+    tree = {"w": {"x": meta}}
+    small = adapt_history_len(tree, 4)
+    assert fp8_state_history_len(small) == 4
+    # index 0 is the newest entry; truncation keeps the newest window
+    np.testing.assert_array_equal(
+        np.asarray(small["w"]["x"].amax_history), [0.0, 1.0, 2.0, 3.0]
+    )
+    assert float(small["w"]["x"].scale) == 3.0
+    grown = adapt_history_len(small, 6)
+    np.testing.assert_array_equal(
+        np.asarray(grown["w"]["x"].amax_history), [0.0, 1.0, 2.0, 3.0, 0.0, 0.0]
+    )
+    # abstract leaves resize too (checkpoint like-trees)
+    abstract = jax.tree_util.tree_map(
+        lambda m: Fp8Meta(scale=jax.ShapeDtypeStruct((), jnp.float32),
+                          amax_history=jax.ShapeDtypeStruct((2, 8), jnp.float32)),
+        tree, is_leaf=lambda x: isinstance(x, Fp8Meta))
+    res = adapt_history_len(abstract, 16)
+    assert res["w"]["x"].amax_history.shape == (2, 16)
+
+
+def test_fp8_checkpoint_restores_across_history_len_change(tmp_path):
+    """A checkpoint written under a long amax window (the old TE-style 1024
+    default) restores into today's short window by keeping the newest
+    entries, instead of failing orbax's shape check."""
+    import optax
+
+    from accelerate_tpu.checkpointing import (
+        load_accelerator_state,
+        save_accelerator_state,
+    )
+    from accelerate_tpu.ops.fp8 import adapt_history_len, fp8_state_history_len
+    from accelerate_tpu.training import TrainState
+
+    params = {"w": jnp.ones((8, 8))}
+    old = adapt_history_len(init_fp8_state(params), 1024)
+    old = jax.tree_util.tree_map(
+        lambda m: Fp8Meta(scale=m.scale * 2,
+                          amax_history=m.amax_history.at[..., 0].set(7.0)),
+        old, is_leaf=lambda x: isinstance(x, Fp8Meta))
+    ts = TrainState.create(apply_fn=None, params=params, tx=optax.sgd(1e-3),
+                           fp8_state=old)
+    save_accelerator_state(str(tmp_path), train_states=[ts])
+
+    ts2 = TrainState.create(apply_fn=None, params=params, tx=optax.sgd(1e-3),
+                            fp8_state=init_fp8_state(params))
+    load_accelerator_state(str(tmp_path), train_states=[ts2])
+    assert fp8_state_history_len(ts2.fp8_state) == 16
+    meta = ts2.fp8_state["w"]["x"]
+    assert float(np.asarray(meta.amax_history)[0]) == 7.0
+    assert float(np.asarray(meta.scale)) == 2.0

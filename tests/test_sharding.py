@@ -113,6 +113,36 @@ def test_optimizer_state_sharding_adam():
     assert len(sharded[0].mu["layers"]["attn"]["q_proj"]["kernel"].sharding.device_set) == 8
 
 
+def test_composed_quantized_optimizer_keeps_zero_sharding():
+    """Regression (advisor r5): a composed optimizer mixing quantized moments
+    with plain param-shaped state (optax.chain(adamw_8bit, trace)) must keep
+    ZeRO sharding for the NON-quantized moments — the old early-return
+    replicated them silently — while quantized moments still shard on their
+    blocks dim."""
+    import optax
+
+    from accelerate_tpu.optimizers import _Quantized, adamw_8bit
+
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    params = make_params()
+    plan = plan_sharding(params, mesh)
+    opt = optax.chain(adamw_8bit(1e-3), optax.trace(decay=0.9))
+    opt_state = opt.init(params)
+    opt_plan = plan_optimizer_sharding(opt, opt_state, plan, mesh)
+    # the trace's param-shaped moment adopts the param plan (ZeRO)
+    trace_q = opt_plan[1].trace["layers"]["attn"]["q_proj"]["kernel"]
+    assert trace_q.spec == P("fsdp", None)
+    # quantized moments shard along the blocks dim
+    mu_q = opt_plan[0].mu["layers"]["attn"]["q_proj"]["kernel"]
+    assert isinstance(mu_q, _Quantized)
+    assert mu_q.q.spec == P("fsdp", None)
+    # scalars replicate; the full plan is device_put-able
+    assert opt_plan[0].count.spec == P()
+    sharded = shard_pytree(opt_state, opt_plan)
+    placed = sharded[1].trace["layers"]["attn"]["q_proj"]["kernel"]
+    assert len(placed.sharding.device_set) == 8
+
+
 def test_batch_spec():
     mesh = MeshConfig(axes={"data": 2, "fsdp": 4}).build()
     assert batch_spec(mesh) == P(("data", "fsdp"))
