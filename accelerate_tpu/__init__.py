@@ -55,6 +55,9 @@ _LAZY = {
     "annotate": ".profiler",
     "StepTimer": ".profiler",
     "device_memory_stats": ".profiler",
+    "ServingEngine": ".serving",
+    "EngineConfig": ".serving",
+    "SlotKVCache": ".serving",
 }
 
 

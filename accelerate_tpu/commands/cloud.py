@@ -117,6 +117,26 @@ def register_subcommand(subparsers) -> None:
                    help="args for the training script; separate with `--`")
     p.set_defaults(func=cloud_command)
 
+    # `launch train.py --name pod -- --lr 1e-3`: older argparse (< 3.12.5
+    # double-dash fixes) has already exhausted the `script_args` positional
+    # by the time it reaches `--`, and errors with "unrecognized arguments".
+    # Split at the first `--` ourselves and hand the tail to script_args —
+    # same semantics on every Python line.
+    orig_parse_known_args = p.parse_known_args
+
+    def parse_known_args(args=None, namespace=None):
+        args = list(args) if args is not None else None
+        tail: list[str] = []
+        if args and "--" in args:
+            cut = args.index("--")
+            args, tail = args[:cut], args[cut + 1:]
+        ns, extras = orig_parse_known_args(args, namespace)
+        if tail:
+            ns.script_args = list(getattr(ns, "script_args", []) or []) + tail
+        return ns, extras
+
+    p.parse_known_args = parse_known_args
+
 
 def cloud_command(args: argparse.Namespace) -> int:
     # CLI > saved `accelerate-tpu config` yaml > hard defaults, so the

@@ -90,13 +90,32 @@ def windowed_cached_attention_mask(k_len: int, positions, mask=None,
     return kv_mask & in_band
 
 
+def _is_batched_keys(key) -> bool:
+    """A batch of PRNG keys (one per row) vs a single key: typed key arrays
+    batch when they carry any leading dims; raw uint32 keys are [2] single,
+    [B, 2] batched."""
+    if key is None or not hasattr(key, "dtype"):
+        return False
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        return key.ndim >= 1
+    return key.ndim >= 2
+
+
 def sample_token(logits, key, temperature: float):
     """Next token from the last position's logits: argmax at temperature 0,
     else temperature-scaled categorical. The ONE sampling rule shared by the
-    on-device, streamed, and T5 decode paths."""
+    on-device, streamed, T5, and serving decode paths.
+
+    `key` may be a single key (one stream for the whole batch — fine when
+    the batch is one request's beams) or a batch of per-row keys ([B] typed
+    or [B, 2] raw): the serving engine samples each slot with its own
+    request's key so concurrent requests never share a stream."""
     if temperature == 0.0:
         return jnp.argmax(logits[:, -1], axis=-1)
-    return jax.random.categorical(key, logits[:, -1] / temperature)
+    last = logits[:, -1] / temperature
+    if _is_batched_keys(key):
+        return jax.vmap(jax.random.categorical)(key, last)
+    return jax.random.categorical(key, last)
 
 
 def build_generate(forward, init_caches):

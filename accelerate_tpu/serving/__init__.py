@@ -1,0 +1,31 @@
+"""Continuous-batching serving: request-level scheduling over ONE compiled
+decode program.
+
+The training half of the repo compiles one program and feeds it batches;
+this package does the same for inference traffic: `Engine` multiplexes many
+concurrent generation requests through a fixed set of cache slots
+(`SlotKVCache`), a `Scheduler` that admits/sheds/retires requests and
+interleaves chunked prefill with batched decode, and per-request streaming
+with TTFT/per-token metrics. See docs/serving.md.
+"""
+
+from .cache import SlotKVCache
+from .engine import Engine, EngineConfig
+from .metrics import ServingMetrics
+from .scheduler import Request, RequestStatus, Scheduler, Slot, SlotState
+
+# unambiguous name for the top-level package namespace
+ServingEngine = Engine
+
+__all__ = [
+    "Engine",
+    "ServingEngine",
+    "EngineConfig",
+    "SlotKVCache",
+    "ServingMetrics",
+    "Scheduler",
+    "Request",
+    "RequestStatus",
+    "Slot",
+    "SlotState",
+]

@@ -1,0 +1,132 @@
+"""Slot-indexed KV cache for continuous batching.
+
+`models/decode.py`'s caches carry ONE `cache_len` scalar for the whole
+batch — every sequence must sit at the same depth, which is exactly what a
+serving mix is not. `SlotKVCache` keeps the same layer-stacked buffer
+layout ([L, S, M, H, D], S = slots) but gives every slot its own length,
+so requests at different decode depths share one fixed-shape batch and one
+compiled program (the pjit/TPUv4 static-shapes rule: the program is
+compiled once, the *data* changes).
+
+Correctness invariant (why retired slots never need zeroing): a write
+always lands at the slot's current `length`, and the position mask
+(`cached_attention_mask`) only lets queries attend cache rows `<= position
+< length`. Rows at or beyond `length` — stale K/V from a retired request,
+or padding from a chunked prefill — are never attended, and are overwritten
+as the slot's length advances. Admission therefore just resets `length` to
+zero; the O(L*M*H*D) cache wipe a naive design would pay per request is a
+single scalar store.
+
+Prefill chunks are padded to a fixed size so every chunk hits the same
+compiled program; the padded tail can spill up to `chunk - 1` rows past the
+slot's logical `max_len`, so the physical buffer allocates `max_len +
+pad_slack` rows (`pad_slack` = the chunk size). `lengths` only ever
+advances by *real* token counts, keeping the invariant above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SlotKVCache:
+    """Fixed-shape slot-indexed decode cache.
+
+    k/v: [num_layers, num_slots, max_len + pad_slack, num_kv_heads,
+    head_dim]; lengths: [num_slots] int32 — per-slot decode depth. The
+    arrays are pytree children, so the whole cache threads through jit (and
+    donates) like any other state; `max_len`/`pad_slack` are static.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+    max_len: int
+    pad_slack: int
+
+    @classmethod
+    def create(
+        cls,
+        num_layers: int,
+        num_slots: int,
+        max_len: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+        pad_slack: int = 0,
+    ) -> "SlotKVCache":
+        shape = (num_layers, num_slots, max_len + pad_slack, num_kv_heads,
+                 head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((num_slots,), jnp.int32),
+            max_len=max_len,
+            pad_slack=pad_slack,
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def rows(self) -> int:
+        """Physical rows per slot (max_len + pad_slack)."""
+        return self.k.shape[2]
+
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+def slot_caches(cache: SlotKVCache, slot: jax.Array):
+    """One slot's caches in `models/decode.py` layout: (k [L, 1, M, H, D],
+    v [L, 1, M, H, D], cache_len scalar) — exactly what a family `forward`
+    expects for a batch-of-one decode. `slot` may be traced (one compiled
+    program covers every slot)."""
+    ks = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+    vs = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+    return ks, vs, cache.lengths[slot]
+
+
+def write_slot(cache: SlotKVCache, slot: jax.Array, new_k: jax.Array,
+               new_v: jax.Array, advance: jax.Array) -> SlotKVCache:
+    """Write one slot's updated [L, 1, M, H, D] buffers back and advance its
+    length by `advance` REAL tokens (chunk padding is excluded by the
+    caller, per the module invariant)."""
+    return dataclasses.replace(
+        cache,
+        k=jax.lax.dynamic_update_slice_in_dim(cache.k, new_k, slot, axis=1),
+        v=jax.lax.dynamic_update_slice_in_dim(cache.v, new_v, slot, axis=1),
+        lengths=cache.lengths.at[slot].set(cache.lengths[slot] + advance),
+    )
+
+
+def reset_slot(cache: SlotKVCache, slot: jax.Array) -> SlotKVCache:
+    """Admit a new request into `slot`: length back to zero. The stale K/V
+    rows stay in place — the position mask hides them (see module
+    docstring)."""
+    return dataclasses.replace(cache,
+                               lengths=cache.lengths.at[slot].set(0))
+
+
+def _flatten(cache: SlotKVCache):
+    return (cache.k, cache.v, cache.lengths), (cache.max_len, cache.pad_slack)
+
+
+def _unflatten(aux, children):
+    k, v, lengths = children
+    max_len, pad_slack = aux
+    return SlotKVCache(k=k, v=v, lengths=lengths, max_len=max_len,
+                       pad_slack=pad_slack)
+
+
+jax.tree_util.register_pytree_node(SlotKVCache, _flatten, _unflatten)

@@ -1,0 +1,383 @@
+"""Continuous-batching serving engine: many requests, ONE compiled decode.
+
+The inference surface this replaces is one blocking `generate()` per
+request (`models/decode.py`): batch fixed at call time, every sequence at
+the same depth, no cross-request multiplexing. The engine instead drives
+exactly three compiled programs for its whole lifetime, whatever the
+request mix:
+
+- `admit`:   reset a slot's length, install the request's PRNG key and
+             temperature (slot index is traced — one program for any slot);
+- `prefill`: one fixed-size prompt chunk into one slot (prompts pad to the
+             chunk, lengths advance by real tokens only — serving/cache.py);
+- `decode`:  one token for EVERY slot, the family `forward` vmapped over
+             slots with per-slot lengths/positions. Retired or prefilling
+             slots ride along as masked lanes — fixed shapes are the price
+             of never recompiling, and their lanes are reused the moment a
+             queued request lands.
+
+Sampling is per-slot: each request's PRNG key is installed at admit and
+the step key derives as `fold_in(request_key, position)`, so streams never
+correlate across slots and a request's sample sequence is independent of
+how prefills/decodes interleave. Temperature is a traced per-slot scalar
+(greedy and sampled requests share the same program).
+
+Token delivery reuses the streamed-generate host plumbing: every decode
+step ends in one small device->host read of the [S] token vector (the same
+role the per-layer device->host probe plays in
+`big_modeling.stream_layers`), which is what `stream()`/`astream()` yield
+from.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from functools import partial
+from typing import Any, AsyncIterator, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import sample_token
+from ..profiler import StepTimer
+from .cache import SlotKVCache, reset_slot, slot_caches, write_slot
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler, Slot, SlotState
+
+__all__ = ["Engine", "EngineConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving knobs. `max_len` bounds prompt+generated per slot (admission
+    rejects longer requests); `prefill_chunk` trades prefill efficiency
+    against how long a long prompt may stall decode (one chunk)."""
+
+    num_slots: int = 4
+    max_len: int = 512
+    prefill_chunk: int = 32
+    max_queue: int = 64
+    cache_dtype: Any = jnp.bfloat16
+    seed: int = 0
+    donate: bool = True
+
+
+def _cache_spec(config) -> tuple[int, int, int]:
+    """(num_layers, num_kv_heads, head_dim) from any family config: GQA
+    families carry num_key_value_heads, MHA families fall back to
+    num_attention_heads."""
+    kv = getattr(config, "num_key_value_heads", None)
+    if kv is None:
+        kv = config.num_attention_heads
+    return config.num_hidden_layers, kv, config.head_dim
+
+
+def _as_raw_key(key) -> jax.Array:
+    """uint32[2] key data from a typed key, raw key, or None."""
+    if key is None:
+        return None
+    if (hasattr(key, "dtype")
+            and jnp.issubdtype(key.dtype, jax.dtypes.prng_key)):
+        return jax.random.key_data(key)
+    return jnp.asarray(key, jnp.uint32)
+
+
+class Engine:
+    """Front-end: `submit()` -> request handle, `stream()`/`astream()` for
+    tokens as they land, `cancel()`, `step()`/`run_until_idle()` to drive.
+
+    `family` is any model-zoo module following the uniform decode contract
+    (`forward(config, params, ids, positions=..., kv_caches=...) ->
+    (logits, new_caches)` — see models/decode.py), or that forward callable
+    directly.
+    """
+
+    def __init__(
+        self,
+        family,
+        config,
+        params,
+        engine_config: EngineConfig | None = None,
+        tracker=None,
+        log_every: int = 0,
+        clock=time.monotonic,
+    ):
+        self.config = config
+        self.params = params
+        self.engine_config = ec = engine_config or EngineConfig()
+        self._forward = family if callable(family) else family.forward
+        self._tracker = tracker
+        self._log_every = log_every
+        self._clock = clock
+
+        num_layers, num_kv, head_dim = _cache_spec(config)
+        self.cache = SlotKVCache.create(
+            num_layers, ec.num_slots, ec.max_len, num_kv, head_dim,
+            dtype=ec.cache_dtype, pad_slack=ec.prefill_chunk,
+        )
+        self.scheduler = Scheduler(ec.num_slots, ec.max_len,
+                                   max_queue=ec.max_queue, clock=clock)
+        self.metrics = ServingMetrics()
+        self.timer = StepTimer(warmup_steps=1)
+
+        self._tokens = jnp.zeros((ec.num_slots,), jnp.int32)
+        self._slot_keys = jax.random.key_data(
+            jax.random.split(jax.random.key(ec.seed), ec.num_slots))
+        self._temps = jnp.zeros((ec.num_slots,), jnp.float32)
+        self._base_key = jax.random.key(ec.seed)
+        self._build_programs()
+
+    # -- compiled programs ---------------------------------------------------
+
+    def _build_programs(self) -> None:
+        forward, config = self._forward, self.config
+        chunk = self.engine_config.prefill_chunk
+        # donation keeps the (large) cache update in place instead of
+        # copying it every step; (1, 2) = cache, tokens in both programs
+        don = (1, 2) if self.engine_config.donate else ()
+        don_admit = (0, 1, 2) if self.engine_config.donate else ()
+
+        def sample_slot(logits, key_raw, position, temp):
+            """One slot's next token from [V] logits: traced temperature
+            selects greedy vs sampled, the step key derives from the
+            request key and the token's position (deterministic under any
+            prefill/decode interleave)."""
+            key = jax.random.fold_in(jax.random.wrap_key_data(key_raw),
+                                     position)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temp, 1e-6)
+            sampled = sample_token(scaled[None, None, :], key, 1.0)[0]
+            return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
+
+        @partial(jax.jit, donate_argnums=don_admit)
+        def admit(cache, slot_keys, temps, slot, key_raw, temp):
+            cache = reset_slot(cache, slot)
+            slot_keys = slot_keys.at[slot].set(key_raw)
+            temps = temps.at[slot].set(temp)
+            return cache, slot_keys, temps
+
+        @partial(jax.jit, donate_argnums=don)
+        def prefill(params, cache, tokens, slot_keys, temps, slot, ids,
+                    real_len):
+            ks, vs, length = slot_caches(cache, slot)
+            positions = (length + jnp.arange(chunk, dtype=jnp.int32))[None, :]
+            logits, (nk, nv, _) = forward(
+                config, params, ids[None, :], positions=positions,
+                kv_caches=(ks, vs, length),
+            )
+            cache = write_slot(cache, slot, nk, nv, real_len)
+            new_len = length + real_len
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), real_len - 1, keepdims=False)
+            tok = sample_slot(last, slot_keys[slot], new_len, temps[slot])
+            tokens = tokens.at[slot].set(tok)
+            return cache, tokens
+
+        @partial(jax.jit, donate_argnums=don)
+        def decode(params, cache, tokens, slot_keys, temps, live):
+            def single(tok, length, k_slot, v_slot):
+                logits, (nk, nv, _) = forward(
+                    config, params, tok[None, None],
+                    positions=length[None, None],
+                    kv_caches=(k_slot[:, None], v_slot[:, None], length),
+                )
+                return logits[0, 0].astype(jnp.float32), nk[:, 0], nv[:, 0]
+
+            last, nk, nv = jax.vmap(
+                single, in_axes=(0, 0, 1, 1), out_axes=(0, 1, 1)
+            )(tokens, cache.lengths, cache.k, cache.v)
+            next_tok = jax.vmap(sample_slot)(
+                last, slot_keys, cache.lengths + 1, temps)
+            tokens = jnp.where(live, next_tok, tokens)
+            cache = dataclasses.replace(
+                cache, k=nk, v=nv,
+                lengths=cache.lengths + live.astype(jnp.int32))
+            return cache, tokens
+
+        self._admit_p, self._prefill_p, self._decode_p = admit, prefill, decode
+
+    def compile_stats(self) -> dict[str, int]:
+        """Compiled-program counts per engine program — the recompile
+        guard: these must stay flat however the request mix changes."""
+        return {
+            "admit": self._admit_p._cache_size(),
+            "prefill": self._prefill_p._cache_size(),
+            "decode": self._decode_p._cache_size(),
+        }
+
+    # -- request API ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        key=None,
+        eos_token_id: int | None = None,
+        deadline_s: float | None = None,
+    ) -> Request:
+        """Queue one generation request; returns its handle immediately.
+        Overload is reported on the handle (`status` REJECTED with
+        `reject_reason`), never deferred to an OOM."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), key=key,
+            eos_token_id=eos_token_id, deadline_s=deadline_s,
+        )
+        self.scheduler.submit(req)
+        if req.done:
+            self.metrics.observe_request(req)
+        else:
+            # eager admission: a free slot absorbs the request now, so
+            # max_queue only ever bounds genuinely *waiting* requests and
+            # TTFT doesn't wait for the next step() call
+            self._admit_pending()
+        return req
+
+    def cancel(self, request: Request) -> bool:
+        if self.scheduler.cancel(request):
+            self.metrics.observe_request(request)
+            return True
+        return False
+
+    def stream(self, request: Request) -> Iterator[int]:
+        """Yield the request's tokens as the engine produces them, driving
+        `step()` while the request is live."""
+        sent = 0
+        while True:
+            while sent < len(request.tokens):
+                yield request.tokens[sent]
+                sent += 1
+            if request.done or not self.step():
+                break
+        yield from request.tokens[sent:]
+
+    async def astream(self, request: Request) -> AsyncIterator[int]:
+        """`stream()` for asyncio callers: yields control to the loop
+        between engine steps so concurrent coroutines interleave."""
+        sent = 0
+        while True:
+            while sent < len(request.tokens):
+                yield request.tokens[sent]
+                sent += 1
+            if request.done or not self.step():
+                break
+            await asyncio.sleep(0)
+        for tok in request.tokens[sent:]:
+            yield tok
+
+    # -- the drive loop ------------------------------------------------------
+
+    def step(self) -> bool:
+        """Run one scheduler action (admissions + one prefill chunk OR one
+        batched decode step). Returns False when the engine is idle."""
+        if self.metrics.started_at is None:
+            self.metrics.started_at = self._clock()
+        self._admit_pending()
+        action = self.scheduler.next_action()
+        if action is None:
+            self.metrics.stopped_at = self._clock()
+            return False
+        if action[0] == "prefill":
+            self._run_prefill_chunk(action[1])
+        else:
+            self._run_decode(action[1])
+        self.metrics.observe_step(self.scheduler.live_slots,
+                                  self.engine_config.num_slots,
+                                  self.scheduler.queue_depth)
+        self.metrics.stopped_at = self._clock()
+        self._maybe_log()
+        return True
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def _admit_pending(self) -> None:
+        """Shed expired queued requests, then admit from the queue into
+        free slots."""
+        now = self._clock()
+        for req in self.scheduler.shed_expired(now):
+            self.metrics.observe_request(req)
+        for slot, req in self.scheduler.admissions(now):
+            self._run_admit(slot, req)
+
+    def _run_admit(self, slot: Slot, req: Request) -> None:
+        key_raw = _as_raw_key(req.key)
+        if key_raw is None:
+            key_raw = jax.random.key_data(
+                jax.random.fold_in(self._base_key, req.request_id))
+        self.cache, self._slot_keys, self._temps = self._admit_p(
+            self.cache, self._slot_keys, self._temps,
+            jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
+        )
+
+    def _run_prefill_chunk(self, slot: Slot) -> None:
+        chunk = self.engine_config.prefill_chunk
+        req = slot.request
+        start = slot.prompt_done
+        real = min(chunk, req.prompt_len - start)
+        ids = np.zeros((chunk,), np.int32)
+        ids[:real] = req.prompt[start:start + real]
+        with self.timer.dispatch():
+            self.cache, self._tokens = self._prefill_p(
+                self.params, self.cache, self._tokens, self._slot_keys,
+                self._temps, jnp.int32(slot.index), ids, jnp.int32(real),
+            )
+        self.metrics.prefill_chunks += 1
+        if self.scheduler.note_prefill_chunk(slot, real):
+            # the chunk that completed the prompt also produced the
+            # request's first token — fetch it (TTFT is measured here)
+            tok = int(np.asarray(self._tokens)[slot.index])
+            if self.scheduler.note_token(slot, tok):
+                self.metrics.observe_request(req)
+
+    def _run_decode(self, slots: list[Slot]) -> None:
+        live = np.zeros((self.engine_config.num_slots,), bool)
+        for s in slots:
+            live[s.index] = True
+        with self.timer.dispatch():
+            self.cache, self._tokens = self._decode_p(
+                self.params, self.cache, self._tokens, self._slot_keys,
+                self._temps, live,
+            )
+        toks = np.asarray(self._tokens)  # the per-step host read
+        self.timer.tick(block_on=None)
+        self.metrics.decode_steps += 1
+        for s in slots:
+            req = s.request
+            if self.scheduler.note_token(s, int(toks[s.index])):
+                self.metrics.observe_request(req)
+
+    # -- metrics -------------------------------------------------------------
+
+    def reset_metrics(self) -> None:
+        """Drop accumulated samples (e.g. after a warmup pass). Compiled
+        programs, slot state, and in-flight requests are untouched."""
+        self.metrics = ServingMetrics()
+        self.timer = StepTimer(warmup_steps=0)
+
+    def metrics_summary(self) -> dict[str, float]:
+        """Flat serving metrics (TTFT/per-token percentiles, occupancy,
+        queue depth, tokens/sec) + the StepTimer's host-overhead meters."""
+        out = self.metrics.summary()
+        if self.timer._dispatch_times:
+            out["host_dispatch_us_mean"] = self.timer.host_dispatch_us
+        out.update({f"compiles_{k}": float(v)
+                    for k, v in self.compile_stats().items()})
+        return out
+
+    def _maybe_log(self) -> None:
+        if not self._tracker or not self._log_every:
+            return
+        steps = self.metrics.decode_steps
+        if steps and steps % self._log_every == 0:
+            self._tracker.log(self.metrics_summary(), step=steps)

@@ -1,0 +1,97 @@
+"""Serving metrics: per-request latency distributions + engine gauges.
+
+The serving numbers that matter are distributional (a mean TTFT hides the
+p99 a shed request would have seen), so the aggregator keeps raw samples
+and summarizes to percentiles. Engine-level gauges (slot occupancy, queue
+depth) are sampled once per engine step. The summary is a flat
+str -> float dict, so it drops straight into the existing tracking layer
+(`GeneralTracker.log`) and into `bench.py`'s one-line JSON.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .scheduler import Request
+
+
+def _percentiles(samples: list[float], name: str) -> dict[str, float]:
+    if not samples:
+        return {}
+    arr = np.asarray(samples, dtype=np.float64)
+    return {
+        f"{name}_p50_ms": float(np.percentile(arr, 50) * 1e3),
+        f"{name}_p99_ms": float(np.percentile(arr, 99) * 1e3),
+        f"{name}_mean_ms": float(arr.mean() * 1e3),
+    }
+
+
+@dataclass
+class ServingMetrics:
+    """Aggregates finished requests + per-step engine gauges."""
+
+    ttft_s: list[float] = field(default_factory=list)
+    tpot_s: list[float] = field(default_factory=list)   # time per output token
+    queue_wait_s: list[float] = field(default_factory=list)
+    occupancy: list[float] = field(default_factory=list)
+    queue_depth: list[int] = field(default_factory=list)
+    finished: int = 0
+    cancelled: int = 0
+    rejected: int = 0
+    expired: int = 0
+    tokens_out: int = 0
+    decode_steps: int = 0
+    prefill_chunks: int = 0
+    started_at: float | None = None
+    stopped_at: float | None = None
+
+    def observe_step(self, live_slots: int, num_slots: int,
+                     queue_depth: int) -> None:
+        self.occupancy.append(live_slots / max(1, num_slots))
+        self.queue_depth.append(queue_depth)
+
+    def observe_request(self, req: Request) -> None:
+        """Fold one terminal request into the aggregates."""
+        if req.status.value == "finished":
+            self.finished += 1
+            self.tokens_out += len(req.tokens)
+            if req.ttft_s is not None:
+                self.ttft_s.append(req.ttft_s)
+            if req.admitted_at is not None:
+                self.queue_wait_s.append(req.admitted_at - req.submitted_at)
+            # per-token latency: gaps between consecutive decode tokens
+            # (TTFT is its own metric; the first gap is excluded)
+            gaps = np.diff(req.token_times)
+            self.tpot_s.extend(float(g) for g in gaps)
+        elif req.status.value == "cancelled":
+            self.cancelled += 1
+        elif req.status.value == "rejected":
+            self.rejected += 1
+        elif req.status.value == "expired":
+            self.expired += 1
+
+    def summary(self) -> dict[str, float]:
+        out: dict[str, float] = {
+            "requests_finished": float(self.finished),
+            "requests_rejected": float(self.rejected),
+            "requests_expired": float(self.expired),
+            "requests_cancelled": float(self.cancelled),
+            "tokens_out": float(self.tokens_out),
+            "decode_steps": float(self.decode_steps),
+            "prefill_chunks": float(self.prefill_chunks),
+        }
+        out.update(_percentiles(self.ttft_s, "ttft"))
+        out.update(_percentiles(self.tpot_s, "per_token"))
+        out.update(_percentiles(self.queue_wait_s, "queue_wait"))
+        if self.occupancy:
+            out["slot_occupancy_mean"] = float(np.mean(self.occupancy))
+        if self.queue_depth:
+            out["queue_depth_mean"] = float(np.mean(self.queue_depth))
+            out["queue_depth_max"] = float(np.max(self.queue_depth))
+        if (self.started_at is not None and self.stopped_at is not None
+                and self.stopped_at > self.started_at):
+            out["tokens_per_sec"] = self.tokens_out / (
+                self.stopped_at - self.started_at)
+        return out

@@ -1,0 +1,267 @@
+"""Request lifecycle and slot scheduling for the serving engine.
+
+Pure host-side logic — no jax in the hot methods — so policy is testable
+without a model and the engine's device programs stay fixed-shape. The
+scheduler owns:
+
+- the FIFO admission queue with load shedding: a full queue or an
+  over-long request REJECTS at submit (a reported status, not an OOM three
+  layers deeper), and a queued request whose deadline lapses before a slot
+  frees is shed with status EXPIRED;
+- the slot table: admit into free slots, chunked-prefill progress,
+  retirement on finish/cancel (slot reuse is a length reset — see
+  serving/cache.py);
+- the prefill/decode interleave policy: when both kinds of work exist the
+  engine alternates one prefill chunk with one batched decode step, so a
+  long prompt arriving mid-flight delays running streams by at most one
+  chunk's latency instead of its whole prefill.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+
+class RequestStatus(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    REJECTED = "rejected"   # refused at submit (queue full / too long)
+    EXPIRED = "expired"     # shed from the queue past its deadline
+    CANCELLED = "cancelled"
+
+
+class SlotState(enum.Enum):
+    IDLE = "idle"
+    PREFILL = "prefill"
+    DECODE = "decode"
+
+
+@dataclass
+class Request:
+    """One generation request and its observable state. The object returned
+    by `Engine.submit` IS the handle: `tokens` fills as decode steps land,
+    `status`/`done` report lifecycle, `metrics` carries per-request timing
+    (TTFT, per-token latencies) once finished."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    key: Any = None                      # per-request PRNG key (optional)
+    eos_token_id: int | None = None
+    deadline_s: float | None = None      # max queue wait before shedding
+    request_id: int = -1
+
+    status: RequestStatus = RequestStatus.QUEUED
+    reject_reason: str | None = None
+    tokens: list[int] = field(default_factory=list)
+    submitted_at: float = 0.0
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+    token_times: list[float] = field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def done(self) -> bool:
+        return self.status in (RequestStatus.FINISHED, RequestStatus.REJECTED,
+                               RequestStatus.EXPIRED, RequestStatus.CANCELLED)
+
+    @property
+    def ttft_s(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+@dataclass
+class Slot:
+    index: int
+    state: SlotState = SlotState.IDLE
+    request: Request | None = None
+    prompt_done: int = 0   # prompt tokens prefilled so far
+
+    def free(self) -> None:
+        self.state = SlotState.IDLE
+        self.request = None
+        self.prompt_done = 0
+
+
+class Scheduler:
+    """Admission control + slot assignment + prefill/decode interleave."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        max_len: int,
+        max_queue: int = 128,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.slots = [Slot(i) for i in range(num_slots)]
+        self.max_len = max_len
+        self.max_queue = max_queue
+        self.queue: deque[Request] = deque()
+        self.clock = clock
+        self._ids = itertools.count()
+        self._last_was_prefill = False
+        self.rejected_full = 0
+        self.rejected_too_long = 0
+        self.expired = 0
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, request: Request) -> Request:
+        """Queue a request, or mark it REJECTED immediately: the contract is
+        that overload is *reported* here, never discovered as an OOM or an
+        unbounded queue later."""
+        request.request_id = next(self._ids)
+        request.submitted_at = self.clock()
+        if request.prompt_len + request.max_new_tokens > self.max_len:
+            request.status = RequestStatus.REJECTED
+            request.reject_reason = (
+                f"prompt_len({request.prompt_len}) + max_new_tokens"
+                f"({request.max_new_tokens}) exceeds slot max_len"
+                f"({self.max_len})"
+            )
+            self.rejected_too_long += 1
+            return request
+        if len(self.queue) >= self.max_queue:
+            request.status = RequestStatus.REJECTED
+            request.reject_reason = f"queue full (max_queue={self.max_queue})"
+            self.rejected_full += 1
+            return request
+        self.queue.append(request)
+        return request
+
+    def shed_expired(self, now: float | None = None) -> list[Request]:
+        """Drop queued requests whose deadline lapsed before admission."""
+        now = self.clock() if now is None else now
+        shed = [
+            r for r in self.queue
+            if r.deadline_s is not None and now - r.submitted_at > r.deadline_s
+        ]
+        for r in shed:
+            self.queue.remove(r)
+            r.status = RequestStatus.EXPIRED
+            r.reject_reason = f"deadline_s={r.deadline_s} lapsed in queue"
+            r.finished_at = now
+            self.expired += 1
+        return shed
+
+    def admissions(self, now: float | None = None) -> list[tuple[Slot, Request]]:
+        """Pop queued requests into free slots (FIFO)."""
+        now = self.clock() if now is None else now
+        admitted = []
+        for slot in self.slots:
+            if slot.state is not SlotState.IDLE or not self.queue:
+                continue
+            req = self.queue.popleft()
+            req.status = RequestStatus.RUNNING
+            req.admitted_at = now
+            slot.request = req
+            slot.state = SlotState.PREFILL
+            slot.prompt_done = 0
+            admitted.append((slot, req))
+        return admitted
+
+    # -- the interleave policy ----------------------------------------------
+
+    def next_action(self) -> tuple[str, Any] | None:
+        """('prefill', slot) | ('decode', [slots]) | None.
+
+        Strict alternation when both kinds of work exist: a decode step
+        always runs between two prefill chunks, so running streams see at
+        most one chunk of extra latency however long the arriving prompt.
+        """
+        prefilling = [s for s in self.slots if s.state is SlotState.PREFILL]
+        decoding = [s for s in self.slots if s.state is SlotState.DECODE]
+        if prefilling:
+            # FIFO by admission, NOT by slot index: under sustained load a
+            # freed low-index slot re-fills every step, and picking by
+            # index would starve a long prompt mid-prefill in a higher
+            # slot forever (accepted request, unbounded TTFT)
+            oldest = min(prefilling, key=lambda s: s.request.admitted_at)
+        if prefilling and (not decoding or not self._last_was_prefill):
+            self._last_was_prefill = True
+            return ("prefill", oldest)
+        if decoding:
+            self._last_was_prefill = False
+            return ("decode", decoding)
+        if prefilling:
+            self._last_was_prefill = True
+            return ("prefill", oldest)
+        return None
+
+    # -- progress notes from the engine --------------------------------------
+
+    def note_prefill_chunk(self, slot: Slot, n_tokens: int) -> bool:
+        """Advance a slot's prefill by `n_tokens` real prompt tokens;
+        returns True when the prompt is fully prefilled (the chunk that
+        also produced the request's first token)."""
+        slot.prompt_done += n_tokens
+        if slot.prompt_done >= slot.request.prompt_len:
+            slot.state = SlotState.DECODE
+            return True
+        return False
+
+    def note_token(self, slot: Slot, token: int,
+                   now: float | None = None) -> bool:
+        """Record one generated token; retire the slot when the request
+        hits max_new_tokens or its EOS. Returns True on retirement."""
+        now = self.clock() if now is None else now
+        req = slot.request
+        req.tokens.append(int(token))
+        req.token_times.append(now)
+        if req.first_token_at is None:
+            req.first_token_at = now
+        eos = (req.eos_token_id is not None
+               and int(token) == req.eos_token_id)
+        if eos or len(req.tokens) >= req.max_new_tokens:
+            req.status = RequestStatus.FINISHED
+            req.finished_at = now
+            slot.free()
+            return True
+        return False
+
+    def cancel(self, request: Request) -> bool:
+        """Cancel a queued or running request; no-op on finished ones."""
+        if request.done:
+            return False
+        if request in self.queue:
+            self.queue.remove(request)
+            request.status = RequestStatus.CANCELLED
+            request.finished_at = self.clock()
+            return True
+        for slot in self.slots:
+            if slot.request is request:
+                slot.free()
+                request.status = RequestStatus.CANCELLED
+                request.finished_at = self.clock()
+                return True
+        return False
+
+    # -- introspection --------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def live_slots(self) -> int:
+        return sum(1 for s in self.slots if s.state is not SlotState.IDLE)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or self.live_slots > 0
+
+    def running(self) -> Iterable[Request]:
+        return [s.request for s in self.slots if s.request is not None]

@@ -48,6 +48,56 @@ def require_multi_process(test_case):
     )(test_case)
 
 
+@functools.lru_cache()
+def multiprocess_backend_supported() -> bool:
+    """Whether this jaxlib can run MULTI-PROCESS computations on the CPU
+    backend: some builds raise INVALID_ARGUMENT ("Multiprocess computations
+    aren't implemented on the CPU backend") the moment a 2-process world
+    compiles anything global, which no launched-script test can survive.
+    Probed once per session with a minimal 2-rank world (rendezvous + one
+    process_allgather) so the whole launch matrix can skip with a reason
+    instead of burning its timeout per parametrization."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = (
+        "import sys, jax, numpy as np\n"
+        f"jax.distributed.initialize(coordinator_address='127.0.0.1:{port}',"
+        " num_processes=2, process_id=int(sys.argv[1]))\n"
+        "from jax.experimental import multihost_utils\n"
+        "multihost_utils.process_allgather(np.zeros(1))\n"
+        "print('MP_OK')\n"
+    )
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    procs = [
+        subprocess.Popen([sys.executable, "-c", code, str(rank)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, env=env, start_new_session=True)
+        for rank in (0, 1)
+    ]
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+            ok = ok and p.returncode == 0 and "MP_OK" in out
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            p.communicate()
+            ok = False
+    return ok
+
+
 def slow(test_case):
     """Gate by RUN_SLOW=1 (ref testing.py slow decorator)."""
     from ..utils.environment import parse_flag_from_env

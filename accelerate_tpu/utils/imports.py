@@ -95,6 +95,21 @@ def package_version(name: str) -> str | None:
         return None
 
 
+def has_native_shard_map() -> bool:
+    """True when `jax.shard_map` exists at the top level — the same probe
+    `resolve_shard_map` gates on. Beyond the API location, the two lines
+    lower shard_map bodies differently: the modern lowering CSEs the
+    rotation collectives so a ring/pipeline body carries exactly one
+    collective-permute per rotated buffer, while the 0.4.x experimental
+    lowering duplicates them across the unrolled/transposed bodies. The
+    compiled-program contract tests pin exact collective counts per
+    lowering via this predicate (the structure — no gathers — is asserted
+    unconditionally)."""
+    import jax
+
+    return getattr(jax, "shard_map", None) is not None
+
+
 def resolve_shard_map():
     """`jax.shard_map` moved to the top level only in newer jax; older
     runtimes ship it under jax.experimental with the replication-check kwarg

@@ -129,6 +129,16 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         "n_chips": n_chips,
         "host_dispatch_us": round(host_dispatch_us, 1),
     }
+    # serving row: the continuous-batching engine's offered-load numbers
+    # next to the training row (tiny-config smoke on either backend — it
+    # reports the serving subsystem's steady state, not a model headline;
+    # benchmarks/serve_bench.py is the full harness). Never allowed to
+    # kill the bench line: failures fold into extra.serving.error.
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            extra["serving"] = _serving_row()
+        except Exception as e:  # the one-line contract outranks the row
+            extra["serving"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "unit": "tokens/s/chip",
@@ -153,6 +163,26 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         else:
             result["error"] = error
     return result
+
+
+def _serving_row() -> dict:
+    """Offered-load smoke through the continuous-batching engine
+    (benchmarks/serve_bench.py): tokens/sec + TTFT/per-token percentiles."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "serve_bench.py")
+    spec = importlib.util.spec_from_file_location("serve_bench", path)
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    engine, cfg = sb.build_tiny_engine("llama", num_slots=4, max_len=128,
+                                       prefill_chunk=16)
+    s = sb.run_offered_load(engine, cfg.vocab_size, num_requests=12,
+                            rate_hz=200.0)
+    keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+            "per_token_p50_ms", "per_token_p99_ms", "slot_occupancy_mean",
+            "requests_finished", "requests_rejected")
+    return {k: round(float(s[k]), 2) for k in keep if k in s}
 
 
 def _child_main() -> None:

@@ -1,0 +1,153 @@
+"""Offered-load serving benchmark for the continuous-batching engine.
+
+Open-loop harness: request arrivals are a seeded Poisson process (the
+offered load), prompts/token budgets draw from seeded ranges, and the
+engine is stepped continuously — arrivals land whenever the wall clock
+passes their timestamp, exactly like traffic hitting a server that is
+already busy. Closed-loop driving (submit, drain, repeat) would hide
+queueing: TTFT under load IS the queue, so the clock must keep running
+while the engine works.
+
+Emits ONE JSON line:
+
+  {"metric": "serving_tokens_per_sec", "value": ..., "unit": "tokens/s",
+   "extra": {"ttft_p50_ms": ..., "ttft_p99_ms": ...,
+             "per_token_p50_ms": ..., "per_token_p99_ms": ...,
+             "requests_finished": ..., "requests_rejected": ...,
+             "requests_expired": ..., "slot_occupancy_mean": ...,
+             "compiles_decode": 1, ...}}
+
+`python benchmarks/serve_bench.py --help` for knobs; the defaults are a
+CPU-safe tiny-llama smoke. `run_offered_load` is importable — the tier-1
+bench-contract test drives a miniature load through it in-process, and
+bench.py's serving row reuses it for the one-line JSON contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
+                      max_len: int = 128, prefill_chunk: int = 16,
+                      max_queue: int = 64, seed: int = 0):
+    """A small engine on the named family (tiny config, fresh params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.serving import Engine, EngineConfig
+
+    if family_name == "llama":
+        from accelerate_tpu.models import llama as family
+
+        cfg = family.LlamaConfig.tiny()
+    elif family_name == "gpt2":
+        from accelerate_tpu.models import gpt2 as family
+
+        cfg = family.GPT2Config.tiny()
+    else:
+        raise ValueError(f"unknown family {family_name!r}")
+    params = family.init_params(cfg, jax.random.key(seed))
+    ec = EngineConfig(num_slots=num_slots, max_len=max_len,
+                      prefill_chunk=prefill_chunk, max_queue=max_queue,
+                      cache_dtype=jnp.bfloat16, seed=seed)
+    return Engine(family, cfg, params, ec), cfg
+
+
+def run_offered_load(
+    engine,
+    vocab_size: int,
+    num_requests: int = 16,
+    rate_hz: float = 50.0,
+    prompt_len: tuple[int, int] = (4, 24),
+    max_new_tokens: tuple[int, int] = (4, 16),
+    temperature: float = 0.0,
+    deadline_s: float | None = None,
+    seed: int = 0,
+    warmup_requests: int = 1,
+) -> dict:
+    """Drive `num_requests` Poisson arrivals at `rate_hz` through the
+    engine; returns the flat metrics summary plus load parameters.
+
+    `warmup_requests` run to completion first (compile + first dispatch)
+    and are excluded from the reported distributions.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+
+    def make_prompt():
+        n = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        return rng.integers(0, vocab_size, (n,)).astype(np.int32)
+
+    def budget():
+        return int(rng.integers(max_new_tokens[0], max_new_tokens[1] + 1))
+
+    for _ in range(warmup_requests):
+        engine.submit(make_prompt(), max_new_tokens=budget(),
+                      temperature=temperature)
+    engine.run_until_idle()
+    engine.reset_metrics()  # drop warmup samples; programs stay compiled
+
+    gaps = rng.exponential(1.0 / rate_hz, size=num_requests)
+    start = time.perf_counter()
+    arrivals = start + np.cumsum(gaps)
+    submitted = 0
+    requests = []
+    while submitted < num_requests or engine.scheduler.has_work():
+        now = time.perf_counter()
+        while submitted < num_requests and arrivals[submitted] <= now:
+            requests.append(engine.submit(
+                make_prompt(), max_new_tokens=budget(),
+                temperature=temperature, deadline_s=deadline_s))
+            submitted += 1
+        if not engine.step() and submitted < num_requests:
+            # idle before the next arrival: sleep to it (open loop)
+            time.sleep(max(0.0, arrivals[submitted] - time.perf_counter()))
+
+    out = engine.metrics_summary()
+    out.update({
+        "offered_rate_hz": rate_hz,
+        "num_requests": float(num_requests),
+        "wall_s": round(time.perf_counter() - start, 3),
+    })
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--family", default="llama", choices=("llama", "gpt2"))
+    p.add_argument("--num-requests", type=int, default=16)
+    p.add_argument("--rate-hz", type=float, default=50.0)
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=128)
+    p.add_argument("--prefill-chunk", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, nargs=2, default=(4, 24))
+    p.add_argument("--max-new-tokens", type=int, nargs=2, default=(4, 16))
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--deadline-s", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    engine, cfg = build_tiny_engine(
+        args.family, num_slots=args.slots, max_len=args.max_len,
+        prefill_chunk=args.prefill_chunk, seed=args.seed)
+    summary = run_offered_load(
+        engine, cfg.vocab_size, num_requests=args.num_requests,
+        rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),
+        max_new_tokens=tuple(args.max_new_tokens),
+        temperature=args.temperature, deadline_s=args.deadline_s,
+        seed=args.seed)
+    print(json.dumps({
+        "metric": "serving_tokens_per_sec",
+        "value": round(summary.get("tokens_per_sec", 0.0), 2),
+        "unit": "tokens/s",
+        "extra": {k: (round(v, 3) if isinstance(v, float) else v)
+                  for k, v in summary.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,39 @@ def test_bench_emits_one_json_line_when_tpu_hangs():
         assert payload["extra"]["cpu_smoke_tokens_per_sec"] > 0
 
 
+def test_serve_bench_smoke_emits_serving_metrics():
+    """Tier-1-safe invocation of the offered-load serving harness: a
+    miniature load in-process (no fresh-interpreter compile) must produce
+    the serving JSON contract fields with a flat compile count."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(ROOT, "benchmarks", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    engine, cfg = sb.build_tiny_engine(
+        "gpt2", num_slots=2, max_len=32, prefill_chunk=8)
+    summary = sb.run_offered_load(
+        engine, cfg.vocab_size, num_requests=4, rate_hz=500.0,
+        prompt_len=(2, 6), max_new_tokens=(2, 4))
+    assert summary["requests_finished"] == 4
+    assert summary["tokens_per_sec"] > 0
+    assert summary["ttft_p50_ms"] > 0
+    assert summary["per_token_p50_ms"] > 0
+    assert summary["compiles_decode"] == 1
+
+
+def test_bench_serving_row_shape():
+    """bench.py's serving row reports the offered-load fields and can
+    never poison the one-line contract (errors fold into the row)."""
+    bench = _load_bench()
+    row = bench._serving_row()
+    assert row["requests_finished"] == 12
+    for field in ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+                  "per_token_p50_ms", "per_token_p99_ms"):
+        assert row[field] > 0, row
+
+
 def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
     """ADVICE r4: an operator who exported JAX_PLATFORMS=cpu must not pay
     the TPU hang budget. Behavioral: run main() with subprocess stubbed —

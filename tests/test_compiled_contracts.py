@@ -28,6 +28,13 @@ to program text instead of accuracy):
 
 Note: XLA's CPU backend lowers reduce-scatter to all-to-all(+local reduce)
 in optimized HLO, so the reduce-scatter assertions accept either spelling.
+
+The exact collective-permute pins are per shard_map lowering
+(`has_native_shard_map`): the modern top-level `jax.shard_map` CSEs the
+rotation permutes (2/8/2 for ring fwd / ring bwd / pipeline), the 0.4.x
+experimental lowering duplicates them across unrolled+transposed bodies
+(8/28/6, measured on jax 0.4.37). Both pins guard against silent
+rewrites on their line; the no-gather structure is asserted on both.
 """
 
 import re
@@ -45,6 +52,9 @@ from accelerate_tpu.accelerator import Accelerator
 from accelerate_tpu.models import llama
 from accelerate_tpu.utils import MeshConfig
 from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
+from accelerate_tpu.utils.imports import has_native_shard_map
+
+_NATIVE_SM = has_native_shard_map()
 
 _COLLECTIVE = re.compile(
     r"(all-gather|reduce-scatter|all-reduce|collective-permute|all-to-all)\b"
@@ -168,8 +178,11 @@ class TestRingCollectiveStructure:
         )
         counts = collective_counts(fwd.lower(q, k, v).compile().as_text())
         # one rotation = one permute each for the K and V buffers, inside
-        # the scan body (so the program text carries them exactly once)
-        assert counts["collective-permute"] == 2, dict(counts)
+        # the scan body (so the program text carries them exactly once on
+        # the native lowering; the experimental one duplicates the pair
+        # fourfold across its unrolled bodies)
+        assert counts["collective-permute"] == (2 if _NATIVE_SM else 8), (
+            dict(counts))
         # the ring must never fall back to gathering the full sequence
         assert counts["all-gather"] == 0, dict(counts)
         assert counts["all-to-all"] == 0, dict(counts)
@@ -191,7 +204,8 @@ class TestRingCollectiveStructure:
         # fwd K/V + bwd recompute K/V/mask-free + dK/dV return rings: the
         # exact figure is pinned so a rewrite that silently gathers or
         # doubles rotations fails here
-        assert counts["collective-permute"] == 8, dict(counts)
+        assert counts["collective-permute"] == (8 if _NATIVE_SM else 28), (
+            dict(counts))
         assert counts["all-gather"] == 0, dict(counts)
 
 
@@ -409,7 +423,8 @@ class TestPipelineCollectiveStructure:
             counts = collective_counts(
                 fn.lower(staged, x, t).compile().as_text()
             )
-            assert counts["collective-permute"] == 2, (sched, dict(counts))
+            assert counts["collective-permute"] == (2 if _NATIVE_SM else 6), (
+                sched, dict(counts))
             assert counts["all-gather"] == 0, (sched, dict(counts))
             assert counts["all-to-all"] == 0, (sched, dict(counts))
             assert counts["all-reduce"] > 0, (sched, dict(counts))
