@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import contextlib
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Iterator
+from typing import Any, Iterator, MutableSequence
 
 import jax
 
@@ -130,13 +131,23 @@ class StepTimer:
     warmup_steps: int = 2          # compile + first dispatch excluded
     peak_flops: float | None = None
     num_chips: int | None = None
-    _times: list[float] = field(default_factory=list)
-    _dispatch_times: list[float] = field(default_factory=list)
-    _stall_times: list[float] = field(default_factory=list)
+    max_samples: int | None = None  # cap raw samples (long-lived meters);
+    #                                 None keeps exact whole-run means
+    _times: MutableSequence[float] = field(default_factory=list)
+    _dispatch_times: MutableSequence[float] = field(default_factory=list)
+    _stall_times: MutableSequence[float] = field(default_factory=list)
     _last: float | None = None
     _seen: int = 0
     _dispatch_seen: int = 0
     _stall_seen: int = 0
+
+    def __post_init__(self) -> None:
+        if self.max_samples is not None:
+            self._times = deque(self._times, maxlen=self.max_samples)
+            self._dispatch_times = deque(self._dispatch_times,
+                                         maxlen=self.max_samples)
+            self._stall_times = deque(self._stall_times,
+                                      maxlen=self.max_samples)
 
     def tick(self, block_on: Any = None) -> float | None:
         """Record one step boundary; returns this step's seconds (or None

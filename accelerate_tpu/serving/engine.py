@@ -44,7 +44,7 @@ import numpy as np
 from ..models.decode import sample_token
 from ..profiler import StepTimer
 from .cache import SlotKVCache, reset_slot, slot_caches, write_slot
-from .metrics import ServingMetrics
+from .metrics import MAX_SAMPLES, ServingMetrics
 from .scheduler import Request, Scheduler, Slot, SlotState
 
 __all__ = ["Engine", "EngineConfig"]
@@ -111,6 +111,7 @@ class Engine:
         self._forward = family if callable(family) else family.forward
         self._tracker = tracker
         self._log_every = log_every
+        self._last_logged = 0
         self._clock = clock
 
         num_layers, num_kv, head_dim = _cache_spec(config)
@@ -121,7 +122,9 @@ class Engine:
         self.scheduler = Scheduler(ec.num_slots, ec.max_len,
                                    max_queue=ec.max_queue, clock=clock)
         self.metrics = ServingMetrics()
-        self.timer = StepTimer(warmup_steps=1)
+        # bounded like the ServingMetrics windows: the engine steps for the
+        # server's lifetime, so raw dispatch samples must not grow O(steps)
+        self.timer = StepTimer(warmup_steps=1, max_samples=MAX_SAMPLES)
 
         self._tokens = jnp.zeros((ec.num_slots,), jnp.int32)
         self._slot_keys = jax.random.key_data(
@@ -232,12 +235,16 @@ class Engine:
             temperature=float(temperature), key=key,
             eos_token_id=eos_token_id, deadline_s=deadline_s,
         )
+        # drain first, THEN capacity-check: a slot freed since the last
+        # step (or an expired entry still holding a queue position) must
+        # make room before this request is judged against max_queue — the
+        # queue bound covers genuinely *waiting* requests only
+        self._admit_pending()
         self.scheduler.submit(req)
         if req.done:
             self.metrics.observe_request(req)
         else:
             # eager admission: a free slot absorbs the request now, so
-            # max_queue only ever bounds genuinely *waiting* requests and
             # TTFT doesn't wait for the next step() call
             self._admit_pending()
         return req
@@ -363,7 +370,10 @@ class Engine:
         """Drop accumulated samples (e.g. after a warmup pass). Compiled
         programs, slot state, and in-flight requests are untouched."""
         self.metrics = ServingMetrics()
-        self.timer = StepTimer(warmup_steps=0)
+        self.timer = StepTimer(warmup_steps=0, max_samples=MAX_SAMPLES)
+        # decode_steps restarts from 0, so the log guard must too — a stale
+        # value would swallow the first post-reset log point
+        self._last_logged = 0
 
     def metrics_summary(self) -> dict[str, float]:
         """Flat serving metrics (TTFT/per-token percentiles, occupancy,
@@ -379,5 +389,10 @@ class Engine:
         if not self._tracker or not self._log_every:
             return
         steps = self.metrics.decode_steps
-        if steps and steps % self._log_every == 0:
+        # decode_steps only advances on decode, but step() also fires for
+        # prefill/admission — without the last-logged guard every such step
+        # re-logs the same decode step (duplicate rows; strictly-increasing
+        # trackers drop them)
+        if steps and steps % self._log_every == 0 and steps != self._last_logged:
+            self._last_logged = steps
             self._tracker.log(self.metrics_summary(), step=steps)

@@ -10,14 +10,24 @@ str -> float dict, so it drops straight into the existing tracking layer
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from .scheduler import Request
 
+# Raw-sample cap: a long-lived server steps forever, and unbounded sample
+# lists grow by O(steps + tokens) — percentiles are computed over the most
+# recent window instead (counters stay exact and lifetime-cumulative).
+MAX_SAMPLES = 100_000
 
-def _percentiles(samples: list[float], name: str) -> dict[str, float]:
+
+def _window() -> deque[float]:
+    return deque(maxlen=MAX_SAMPLES)
+
+
+def _percentiles(samples: "deque[float]", name: str) -> dict[str, float]:
     if not samples:
         return {}
     arr = np.asarray(samples, dtype=np.float64)
@@ -32,11 +42,11 @@ def _percentiles(samples: list[float], name: str) -> dict[str, float]:
 class ServingMetrics:
     """Aggregates finished requests + per-step engine gauges."""
 
-    ttft_s: list[float] = field(default_factory=list)
-    tpot_s: list[float] = field(default_factory=list)   # time per output token
-    queue_wait_s: list[float] = field(default_factory=list)
-    occupancy: list[float] = field(default_factory=list)
-    queue_depth: list[int] = field(default_factory=list)
+    ttft_s: deque[float] = field(default_factory=_window)
+    tpot_s: deque[float] = field(default_factory=_window)  # time per output token
+    queue_wait_s: deque[float] = field(default_factory=_window)
+    occupancy: deque[float] = field(default_factory=_window)
+    queue_depth: deque[int] = field(default_factory=_window)
     finished: int = 0
     cancelled: int = 0
     rejected: int = 0

@@ -44,12 +44,18 @@ class SlotState(enum.Enum):
     DECODE = "decode"
 
 
-@dataclass
+@dataclass(eq=False)
 class Request:
     """One generation request and its observable state. The object returned
     by `Engine.submit` IS the handle: `tokens` fills as decode steps land,
     `status`/`done` report lifecycle, `metrics` carries per-request timing
-    (TTFT, per-token latencies) once finished."""
+    (TTFT, per-token latencies) once finished.
+
+    eq=False: requests compare by identity. The generated __eq__ would
+    compare the numpy `prompt` field element-wise, which makes
+    `queue.remove(request)` / `request in queue` raise on any queue with
+    depth > 1 — and two distinct requests with equal fields must never
+    alias in the scheduler anyway."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -197,9 +203,6 @@ class Scheduler:
         if decoding:
             self._last_was_prefill = False
             return ("decode", decoding)
-        if prefilling:
-            self._last_was_prefill = True
-            return ("prefill", oldest)
         return None
 
     # -- progress notes from the engine --------------------------------------

@@ -128,8 +128,14 @@ def configure_compilation_cache(
     _OFF = {"0", "off", "false", "no", "none", "disabled"}
     if cache_dir is None:
         cache_dir = os.environ.get(ENV_COMPILATION_CACHE)
-    if cache_dir is not None and cache_dir.strip().lower() in _OFF:
-        return None
+    if cache_dir is not None:
+        cache_dir = cache_dir.strip()
+        if cache_dir.lower() in _OFF:
+            return None
+        if not cache_dir:
+            # `ACCELERATE_TPU_COMPILATION_CACHE= python ...` means "unset",
+            # not "use the cwd" (abspath("") is the launch directory)
+            cache_dir = None
     import jax
 
     def _apply_thresholds() -> None:

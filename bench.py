@@ -48,6 +48,7 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
     from accelerate_tpu.accelerator import Accelerator
     from accelerate_tpu.models import llama
     from accelerate_tpu.models.common import count_params
+    from accelerate_tpu.profiler import StepTimer
     from accelerate_tpu.utils.constants import TPU_PEAK_FLOPS
 
     dev0 = jax.devices()[0]
@@ -96,14 +97,16 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
     # per-step HOST dispatch cost (the python step() call returns once XLA
     # execution is enqueued): isolates the framework's steady-state overhead
     # from the compiled program's runtime. Cached dispatch should keep this
-    # in single-digit microseconds per state leaf.
-    dispatch = []
+    # in single-digit microseconds per state leaf. Same meter as
+    # profile_step.py and the serving engine (StepTimer), so the numbers
+    # stay comparable across tools; warmup_steps=0 because the program is
+    # already compiled and dispatch-cached by the timed windows above.
+    timer = StepTimer(warmup_steps=0)
     for _ in range(steps):
-        d0 = time.perf_counter()
-        ts, m = step(ts, batch_arrays)
-        dispatch.append(time.perf_counter() - d0)
+        with timer.dispatch():
+            ts, m = step(ts, batch_arrays)
     float(m["loss"])
-    host_dispatch_us = 1e6 * sum(dispatch) / len(dispatch)
+    host_dispatch_us = timer.host_dispatch_us
 
     n_chips = jax.device_count()
     tokens_per_step = batch * seq

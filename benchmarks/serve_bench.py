@@ -95,13 +95,11 @@ def run_offered_load(
     start = time.perf_counter()
     arrivals = start + np.cumsum(gaps)
     submitted = 0
-    requests = []
     while submitted < num_requests or engine.scheduler.has_work():
         now = time.perf_counter()
         while submitted < num_requests and arrivals[submitted] <= now:
-            requests.append(engine.submit(
-                make_prompt(), max_new_tokens=budget(),
-                temperature=temperature, deadline_s=deadline_s))
+            engine.submit(make_prompt(), max_new_tokens=budget(),
+                          temperature=temperature, deadline_s=deadline_s)
             submitted += 1
         if not engine.step() and submitted < num_requests:
             # idle before the next arrival: sleep to it (open loop)

@@ -198,14 +198,19 @@ def test_cancel_queued_and_running(gpt2_setup):
     eng = _engine(cfg, params, num_slots=1)
     rng = np.random.default_rng(6)
     running = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=32)
+    head = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=4)
     queued = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=32)
     for _ in range(3):
         eng.step()
     assert running.status is RequestStatus.RUNNING
+    # `queued` sits BEHIND `head`: removal must not compare numpy prompts
+    # against other queued requests (Request compares by identity)
     assert eng.cancel(queued) and queued.status is RequestStatus.CANCELLED
+    assert head.status is RequestStatus.QUEUED  # untouched by the removal
     assert eng.cancel(running) and running.status is RequestStatus.CANCELLED
     assert not eng.cancel(running)  # idempotent on terminal requests
     eng.run_until_idle()
+    assert head.status is RequestStatus.FINISHED
     assert eng.scheduler.live_slots == 0
 
 
@@ -230,6 +235,26 @@ def test_admission_rejects_when_queue_full(gpt2_setup):
     assert eng.metrics.rejected == 1
 
 
+def test_submit_drains_freed_slot_before_queue_full_check(gpt2_setup):
+    """A slot freed since the last step must make room BEFORE a new submit
+    is judged against max_queue — the bound covers genuinely *waiting*
+    requests only. Regression: submit used to capacity-check first, so a
+    full queue plus a just-freed slot spuriously REJECTED."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_slots=1, max_queue=1)
+    rng = np.random.default_rng(16)
+    a = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=1)
+    b = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=1)
+    eng.step()  # a's prefill chunk yields its only token -> slot freed
+    assert a.status is RequestStatus.FINISHED
+    assert eng.scheduler.queue_depth == 1  # b still holds the queue position
+    c = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=1)
+    assert c.status is not RequestStatus.REJECTED
+    eng.run_until_idle()
+    assert b.status is RequestStatus.FINISHED
+    assert c.status is RequestStatus.FINISHED
+
+
 def test_admission_rejects_overlong_request(gpt2_setup):
     cfg, params = gpt2_setup
     eng = _engine(cfg, params, max_len=16)
@@ -249,18 +274,21 @@ def test_deadline_shedding_reports_expired(gpt2_setup):
                  clock=lambda: now[0])
     rng = np.random.default_rng(8)
     hog = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=32)
+    patient = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=4)
     hurried = eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=4,
                          deadline_s=5.0)
     for _ in range(3):
         eng.step()
         now[0] += 1.0
     assert hurried.status is RequestStatus.QUEUED
-    now[0] += 10.0  # deadline lapses while still queued
-    eng.step()
+    now[0] += 10.0  # deadline lapses while still queued, behind `patient`
+    eng.step()  # shedding a non-head request must not crash on numpy __eq__
     assert hurried.status is RequestStatus.EXPIRED
     assert "deadline" in hurried.reject_reason
+    assert patient.status is not RequestStatus.EXPIRED
     eng.run_until_idle()
     assert hog.status is RequestStatus.FINISHED
+    assert patient.status is RequestStatus.FINISHED
     assert eng.metrics.expired == 1
 
 
@@ -486,6 +514,27 @@ def test_prefill_is_fifo_not_slot_indexed():
     sched2.admissions()          # c -> slot 0, admitted later than b
     kind, slot = sched2.next_action()
     assert kind == "prefill" and slot.request is b
+
+
+def test_scheduler_cancel_and_shed_non_head_queued():
+    """Removing a request from BEHIND other queued requests must not
+    element-compare numpy prompts (Request is eq=False: identity only).
+    Regression — the generated dataclass __eq__ raised 'truth value of an
+    array is ambiguous' at any queue depth > 1."""
+    now = [0.0]
+    sched = Scheduler(num_slots=0, max_len=32, max_queue=8,
+                      clock=lambda: now[0])
+    head, mid, tail = _req(), _req(deadline_s=1.0), _req()
+    for r in (head, mid, tail):
+        sched.submit(r)
+    assert sched.cancel(tail) and tail.status is RequestStatus.CANCELLED
+    now[0] = 5.0
+    shed = sched.shed_expired()
+    assert shed == [mid] and mid.status is RequestStatus.EXPIRED
+    assert head.status is RequestStatus.QUEUED
+    assert sched.queue_depth == 1
+    # equal-field requests are still distinct handles
+    assert _req() != _req()
 
 
 def test_scheduler_retire_frees_slot_for_queue():
