@@ -58,6 +58,16 @@ _LAZY = {
     "ServingEngine": ".serving",
     "EngineConfig": ".serving",
     "SlotKVCache": ".serving",
+    "MetricsRegistry": ".telemetry",
+    "StreamingHistogram": ".telemetry",
+    "get_registry": ".telemetry",
+    "span": ".telemetry",
+    "configure_tracing": ".telemetry",
+    "export_chrome_trace": ".telemetry",
+    "start_metrics_server": ".telemetry",
+    "render_prometheus": ".telemetry",
+    "aggregate_snapshot": ".telemetry",
+    "StallWatchdog": ".telemetry",
 }
 
 

@@ -45,6 +45,10 @@ from .sharding import (
     transformer_rules,
 )
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry.export import start_metrics_server
+from .telemetry.registry import get_registry
+from .telemetry.trace import span
+from .telemetry.watchdog import StallWatchdog, resolve_stall_timeout
 from .training import (
     DynamicLossScale,
     TrainState,
@@ -131,9 +135,11 @@ class _CompiledTrainStep:
         self._step_fn = step_fn
         self._donate = donate
         self._by_layout: dict = {}   # (treedef, leaf shardings) -> jitted
-        self._aot: dict = {}         # same key -> (batch signature, compiled)
+        self._aot: dict = {}         # (layout key, batch signature) -> compiled
         self._last: tuple | None = None  # (weakref(last out state), fn, jitted)
         self._pin_computations = 0   # pin-tree builds (cache misses)
+        self._aot_compiles = 0       # AOT lower+compile runs (cache misses)
+        self._on_dispatch: Callable | None = None  # telemetry hook
 
     def _layout_key(self, state):
         leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -186,45 +192,65 @@ class _CompiledTrainStep:
         cache enabled (utils.environment.configure_compilation_cache), a
         relaunch's warmup deserializes instead of recompiling."""
         jitted, key = self._ensure(state)
-        sig = self._batch_sig(batch)
-        entry = self._aot.get(key)
-        if entry is None or entry[0] != sig:
-            self._aot[key] = (sig, jitted.lower(state, *batch).compile())
+        # keyed by (layout, batch signature) — NOT one slot per layout:
+        # alternating warmups across two batch shapes must each stay
+        # cached instead of evicting one another and recompiling every
+        # time (tests/test_prefetch.py::TestWarmup)
+        akey = (key, self._batch_sig(batch))
+        compiled = self._aot.get(akey)
+        if compiled is None:
+            self._aot_compiles += 1
+            compiled = self._aot[akey] = jitted.lower(state, *batch).compile()
             # drop the identity fast path: it would keep dispatching to the
             # callable captured before this warmup and never consult the
             # fresh executable (e.g. warming up for an upcoming batch-shape
             # change mid-loop)
             self._last = None
-        return self._aot[key][1]
+        return compiled
 
     def __call__(self, state, *batch):
-        last = self._last
-        if last is not None and last[0]() is state:
-            # steady state: this state object IS our previous output, whose
-            # layout the out_shardings pin fixed — no tree walk needed
-            fn, jitted = last[1], last[2]
-        else:
-            jitted, key = self._ensure(state)
-            fn = jitted
-            aot = self._aot.get(key)
-            if aot is not None and aot[0] == self._batch_sig(batch):
-                fn = aot[1]
-        try:
-            out = fn(state, *batch)
-        except (TypeError, ValueError):
-            if fn is jitted:
-                raise
-            # batch shape/dtype drifted from the warmed-up signature (the
-            # identity fast path skips the signature check); the AOT
-            # executable rejects the args before any donation, so falling
-            # back to the jit path is safe
-            fn = jitted
-            out = jitted(state, *batch)
-        try:
-            ref = weakref.ref(out[0])
-        except TypeError:  # plain-container states (dicts) aren't weakref-able
-            ref = None
-        self._last = None if ref is None else (ref, fn, jitted)
+        with span("accelerate_tpu.train_step.dispatch"):
+            last = self._last
+            if last is not None and last[0]() is state:
+                # steady state: this state object IS our previous output,
+                # whose layout the out_shardings pin fixed — no tree walk
+                # needed
+                fn, jitted = last[1], last[2]
+            else:
+                jitted, key = self._ensure(state)
+                fn = self._aot.get((key, self._batch_sig(batch)), jitted)
+            try:
+                out = fn(state, *batch)
+            except (TypeError, ValueError):
+                if fn is jitted:
+                    raise
+                # batch shape/dtype drifted from the signature this
+                # executable was warmed for (the identity fast path skips
+                # the signature check); the AOT executable rejects the
+                # args before any donation, so retrying is safe — first
+                # against another warmed executable for this
+                # (layout, signature), else the jit path. The executable
+                # that just failed must never be retried (its rejection
+                # may not be signature-visible, e.g. device drift).
+                failed = fn
+                jitted, key = self._ensure(state)
+                fn = self._aot.get((key, self._batch_sig(batch)))
+                if fn is None or fn is failed:
+                    fn = jitted
+                try:
+                    out = fn(state, *batch)
+                except (TypeError, ValueError):
+                    if fn is jitted:
+                        raise
+                    fn = jitted
+                    out = jitted(state, *batch)
+            try:
+                ref = weakref.ref(out[0])
+            except TypeError:  # plain-container states (dicts) aren't weakref-able
+                ref = None
+            self._last = None if ref is None else (ref, fn, jitted)
+        if self._on_dispatch is not None:
+            self._on_dispatch()
         return out
 
     def lower(self, state, *batch):
@@ -261,6 +287,8 @@ class Accelerator:
         jit_config: JitConfig | None = None,
         gradient_clipping: float | None = None,
         kwargs_handlers: list | None = None,
+        metrics_port: int | None = None,
+        stall_timeout_s: float | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
             project_dir=project_dir
@@ -414,6 +442,26 @@ class Accelerator:
             [log_with] if log_with is not None else []
         )
         self.trackers = []
+
+        # --- telemetry (ISSUE 3): shared registry + opt-in exporter/watchdog
+        # The registry is the process-wide default: StepTimer/checkpointing
+        # instrumentation lands in the same series the exporter serves.
+        # Both background threads are OFF unless asked for (kwarg or env),
+        # so plain scripts/tests never grow threads.
+        self.telemetry = get_registry()
+        self.metrics_server = None
+        self.stall_watchdog: StallWatchdog | None = None
+        if self.is_main_process:
+            self.metrics_server = start_metrics_server(
+                metrics_port, registry=self.telemetry)
+        wd_timeout = resolve_stall_timeout(stall_timeout_s)
+        if wd_timeout is not None:
+            self.stall_watchdog = StallWatchdog(
+                wd_timeout, name=f"accelerator-rank{self.process_index}"
+            ).start()
+        self._c_train_steps = self.telemetry.counter(
+            "accelerator_train_steps_total")
+        self._c_logs = self.telemetry.counter("accelerator_log_calls_total")
 
         # checkpoint hooks (ref :2798,:2964)
         self._save_model_state_pre_hook = {}
@@ -1011,7 +1059,17 @@ class Accelerator:
                 metrics["aux"] = aux
             return new_state, metrics
 
-        return _CompiledTrainStep(step_fn, donate=donate)
+        step = _CompiledTrainStep(step_fn, donate=donate)
+        step._on_dispatch = self._note_train_dispatch
+        return step
+
+    def _note_train_dispatch(self) -> None:
+        """Per-dispatch telemetry heartbeat: counts the step and feeds the
+        stall watchdog (a silent multi-host hang then dumps stacks instead
+        of burning TPU hours)."""
+        self._c_train_steps.inc()
+        if self.stall_watchdog is not None:
+            self.stall_watchdog.tick()
 
     def eval_step(self, eval_fn: Callable) -> Callable:
         """Compile an inference/eval function with the precision policy."""
@@ -1118,11 +1176,21 @@ class Accelerator:
         return _profile(logdir, **kwargs)
 
     def step_timer(self, flops_per_step: float = 0.0, tokens_per_step: int = 0,
-                   **kwargs):
+                   fresh: bool = True, **kwargs):
         from .profiler import StepTimer
 
-        return StepTimer(flops_per_step=flops_per_step,
-                         tokens_per_step=tokens_per_step, **kwargs)
+        # registry-backed by default: the timer's step/dispatch/stall
+        # histograms surface on the Prometheus endpoint and in
+        # log_telemetry()'s multi-host aggregate
+        kwargs.setdefault("registry", self.telemetry)
+        timer = StepTimer(flops_per_step=flops_per_step,
+                          tokens_per_step=tokens_per_step, **kwargs)
+        if fresh:
+            # registry series are shared by name: a NEW timer must not
+            # inherit a discarded one's samples (warmup-window pattern).
+            # Pass fresh=False to deliberately continue the series.
+            timer.reset()
+        return timer
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: bool | None = None):
@@ -1207,9 +1275,53 @@ class Accelerator:
 
     def log(self, values: dict, step: int | None = None, log_kwargs: dict | None = None) -> None:
         """ref :2609."""
+        self._c_logs.inc()
+        if self.stall_watchdog is not None:
+            # log boundaries are heartbeats too: eager-path loops that
+            # never call the fused step still feed the watchdog
+            self.stall_watchdog.tick()
+        self._record_hbm_high_water()
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def _record_hbm_high_water(self) -> None:
+        """Sample HBM-in-use into a high-water gauge (log boundaries only —
+        not per step). Backends without memory stats (CPU) record 0."""
+        try:
+            from .profiler import device_memory_stats
+
+            stats = device_memory_stats()
+        except Exception:
+            return
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            self.telemetry.gauge("device_hbm_bytes_in_use_peak").set_max(
+                float(in_use))
+
+    def log_telemetry(self, step: int | None = None,
+                      aggregate: bool = True) -> dict[str, float]:
+        """Snapshot the telemetry registry and fan it out through the
+        prepared trackers (the JSONLTracker backend writes one JSONL
+        line). With `aggregate=True` on a multi-host world this is a
+        COLLECTIVE (call on every process): counters sum globally, gauges
+        reduce min/mean/max (per-host HBM high-water -> `__max`),
+        histogram sketches merge for true global p50/p99, and each
+        histogram carries `__slowest_host_mean` — the straggler view.
+        Returns the flat dict that was logged."""
+        self._record_hbm_high_water()
+        if aggregate and self.num_processes > 1:
+            from .telemetry.aggregate import aggregate_flat
+
+            flat = aggregate_flat(self.telemetry)
+        else:
+            from .telemetry.export import snapshot_for_tracking
+
+            flat = snapshot_for_tracking(self.telemetry)
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(flat, step=step)
+        return flat
 
     def get_tracker(self, name: str, unwrap: bool = False):
         """ref :2582."""
@@ -1229,6 +1341,12 @@ class Accelerator:
             # peers hanging at the barrier
             for tracker in self.trackers:
                 tracker.finish()
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
+            if self.stall_watchdog is not None:
+                self.stall_watchdog.stop()
+                self.stall_watchdog = None
             self.wait_for_everyone()
 
     # --------------------------------------------------------- checkpoints

@@ -22,6 +22,7 @@ import json
 import os
 import pickle
 import random as _py_random
+import time
 from typing import Any
 
 import jax
@@ -29,6 +30,8 @@ import numpy as np
 
 from .logging import get_logger
 from .state import PartialState
+from .telemetry.registry import get_registry
+from .telemetry.trace import span
 from .utils.constants import (
     MODEL_NAME,
     OPTIMIZER_NAME,
@@ -106,11 +109,17 @@ def wait_for_checkpoints() -> int:
     if ckptr is None or drained == 0:
         _async_state["inflight"] = 0
         return 0
-    try:
-        ckptr.wait_until_finished()
-    except Exception:
-        _close_async_checkpointer()
-        raise
+    t0 = time.perf_counter()
+    with span("checkpoint.drain"):
+        try:
+            ckptr.wait_until_finished()
+        except Exception:
+            _close_async_checkpointer()
+            raise
+    # how long training actually BLOCKED on the async writer — the number
+    # that says whether async checkpointing is hiding its cost
+    get_registry().histogram("checkpoint_drain_seconds").record(
+        time.perf_counter() - t0)
     _async_state["inflight"] = 0
     return drained
 
@@ -198,6 +207,24 @@ def save_accelerator_state(
     """ref checkpointing.py:51 `save_accelerator_state`. With
     `async_save=True` array writes overlap subsequent training steps; call
     `wait_for_checkpoints()` (or `load`) before relying on the files."""
+    t0 = time.perf_counter()
+    with span("checkpoint.save"):
+        out = _save_accelerator_state(
+            output_dir, train_states, optimizers, schedulers, dataloaders,
+            custom_objects, step, async_save,
+        )
+    reg = get_registry()
+    reg.counter("checkpoint_saves_total").inc()
+    # async saves time the *enqueue* here; the commit drains in
+    # wait_for_checkpoints (its own series below)
+    reg.histogram("checkpoint_save_seconds").record(time.perf_counter() - t0)
+    return out
+
+
+def _save_accelerator_state(
+    output_dir, train_states, optimizers, schedulers, dataloaders,
+    custom_objects, step, async_save,
+) -> str:
     state = PartialState()
     output_dir = _abspath(output_dir)
     os.makedirs(output_dir, exist_ok=True)
@@ -283,6 +310,23 @@ def load_accelerator_state(
     """ref checkpointing.py:152 `load_accelerator_state`. Arrays restore onto
     their current shardings (resharding to a different mesh works: orbax
     reads only the shards each host needs)."""
+    t0 = time.perf_counter()
+    with span("checkpoint.restore"):
+        out = _load_accelerator_state(
+            input_dir, train_states, optimizers, schedulers, dataloaders,
+            custom_objects, load_rng,
+        )
+    reg = get_registry()
+    reg.counter("checkpoint_restores_total").inc()
+    reg.histogram("checkpoint_restore_seconds").record(
+        time.perf_counter() - t0)
+    return out
+
+
+def _load_accelerator_state(
+    input_dir, train_states, optimizers, schedulers, dataloaders,
+    custom_objects, load_rng,
+) -> dict:
     state = PartialState()
     # a load must see fully committed async saves from EVERY host: drain the
     # local writes, then barrier so no host reads before the slowest commit

@@ -44,6 +44,7 @@ import jax
 import numpy as np
 
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry.trace import span
 from .utils.constants import BATCH_AXES
 from .utils.dataclasses import DataLoaderConfiguration, RNGType
 from .utils.operations import (
@@ -749,7 +750,11 @@ class DevicePrefetchIterator:
             except StopIteration:
                 self._exhausted = True
                 return
-            self._buffer.append(self._place(item))
+            # span: the async transfer enqueue — on the trace timeline this
+            # should be microseconds; a long slice here means the transfer
+            # went synchronous (no-op when tracing is disabled)
+            with span("data.prefetch_place"):
+                self._buffer.append(self._place(item))
 
     def __next__(self):
         self._fill()
@@ -844,31 +849,35 @@ class DataLoaderShard(DataLoaderStateMixin):
         work happens here — the transfer is issued by the consumer-side
         `DevicePrefetchIterator` so its depth (not the host queue's) bounds
         in-flight HBM."""
-        batch = batch_to_numpy(batch)
-        n = _batch_size(batch)
-        per_host = self.dp_size // jax.process_count()
-        remainder = -1
-        tail_layout = None
-        if (
-            self.even_batches
-            and self.put_on_device
-            and n is not None
-            and n % per_host != 0
-        ):
-            target = math.ceil(n / per_host) * per_host
-            # SPMD keeps per-host shapes identical, so every host sees the
-            # same (n, target): global real count is n * num_hosts, and after
-            # gathering, rows lay out as [host0: n real + pad, host1: ...] —
-            # recorded so gather_for_metrics can drop pads per host block.
-            remainder = n * jax.process_count()
-            tail_layout = (jax.process_count(), target, n)
-            batch = pad_batch_to(batch, target, rows=n)
-        return batch, remainder, tail_layout
+        with span("data.host_prep"):
+            batch = batch_to_numpy(batch)
+            n = _batch_size(batch)
+            per_host = self.dp_size // jax.process_count()
+            remainder = -1
+            tail_layout = None
+            if (
+                self.even_batches
+                and self.put_on_device
+                and n is not None
+                and n % per_host != 0
+            ):
+                target = math.ceil(n / per_host) * per_host
+                # SPMD keeps per-host shapes identical, so every host sees the
+                # same (n, target): global real count is n * num_hosts, and
+                # after gathering, rows lay out as [host0: n real + pad,
+                # host1: ...] — recorded so gather_for_metrics can drop pads
+                # per host block.
+                remainder = n * jax.process_count()
+                tail_layout = (jax.process_count(), target, n)
+                batch = pad_batch_to(batch, target, rows=n)
+            return batch, remainder, tail_layout
 
     def _place(self, item):
         """Device half: issue the async transfer onto the mesh sharding."""
         batch, remainder, tail_layout = item
-        return make_global_batch(batch, self.mesh, self.batch_axes), remainder, tail_layout
+        with span("data.device_put"):
+            placed = make_global_batch(batch, self.mesh, self.batch_axes)
+        return placed, remainder, tail_layout
 
     def _prepare(self, batch):
         """Full prep for one batch (host + device) — kept as the single-shot

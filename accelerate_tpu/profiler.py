@@ -21,12 +21,12 @@ from __future__ import annotations
 
 import contextlib
 import time
-from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Iterator, MutableSequence
+from typing import Any, Iterator
 
 import jax
 
+from .telemetry.registry import StreamingHistogram
 from .utils.constants import TPU_PEAK_FLOPS
 
 
@@ -124,6 +124,15 @@ class StepTimer:
     `next(loader)` call — nonzero readings mean the device finished before
     its next batch was ready (input-bound step). Both respect
     `warmup_steps`.
+
+    Samples land in bounded-memory streaming histograms
+    (`telemetry.StreamingHistogram`) rather than raw lists: means stay
+    exact (tracked sum/count) for a run of ANY length, and `summary()`
+    reports tail latency (`step_time_p50_s`/`step_time_p99_s`) from the
+    sketch. Pass a `telemetry.MetricsRegistry` as `registry` to publish
+    the series (`<name>_time_seconds`, `<name>_dispatch_seconds`,
+    `<name>_input_stall_seconds`) through the shared export surface
+    (Prometheus endpoint, JSONL snapshots, multi-host aggregation).
     """
 
     flops_per_step: float = 0.0
@@ -131,23 +140,35 @@ class StepTimer:
     warmup_steps: int = 2          # compile + first dispatch excluded
     peak_flops: float | None = None
     num_chips: int | None = None
-    max_samples: int | None = None  # cap raw samples (long-lived meters);
-    #                                 None keeps exact whole-run means
-    _times: MutableSequence[float] = field(default_factory=list)
-    _dispatch_times: MutableSequence[float] = field(default_factory=list)
-    _stall_times: MutableSequence[float] = field(default_factory=list)
+    registry: Any = None           # telemetry.MetricsRegistry | None
+    name: str = "step"             # series prefix when registry-backed
     _last: float | None = None
     _seen: int = 0
     _dispatch_seen: int = 0
     _stall_seen: int = 0
+    _step_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
+    _dispatch_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
+    _stall_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
 
     def __post_init__(self) -> None:
-        if self.max_samples is not None:
-            self._times = deque(self._times, maxlen=self.max_samples)
-            self._dispatch_times = deque(self._dispatch_times,
-                                         maxlen=self.max_samples)
-            self._stall_times = deque(self._stall_times,
-                                      maxlen=self.max_samples)
+        make = (self.registry.histogram if self.registry is not None
+                else StreamingHistogram)
+        if self._step_hist is None:
+            self._step_hist = make(f"{self.name}_time_seconds")
+        if self._dispatch_hist is None:
+            self._dispatch_hist = make(f"{self.name}_dispatch_seconds")
+        if self._stall_hist is None:
+            self._stall_hist = make(f"{self.name}_input_stall_seconds")
+
+    def reset(self) -> None:
+        """Zero the recorded samples (and warmup progress) in place. With
+        a registry, the series OBJECTS are shared by name — a second timer
+        with the same (registry, name) continues the same series unless
+        reset; the exporter keeps serving the zeroed series either way."""
+        for hist in (self._step_hist, self._dispatch_hist, self._stall_hist):
+            hist.reset()
+        self._last = None
+        self._seen = self._dispatch_seen = self._stall_seen = 0
 
     def tick(self, block_on: Any = None) -> float | None:
         """Record one step boundary; returns this step's seconds (or None
@@ -161,7 +182,7 @@ class StepTimer:
             self._seen += 1
             if self._seen > self.warmup_steps:
                 elapsed = now - self._last
-                self._times.append(elapsed)
+                self._step_hist.record(elapsed)
         self._last = now
         return elapsed
 
@@ -174,7 +195,7 @@ class StepTimer:
         yield
         self._dispatch_seen += 1
         if self._dispatch_seen > self.warmup_steps:
-            self._dispatch_times.append(time.perf_counter() - t0)
+            self._dispatch_hist.record(time.perf_counter() - t0)
 
     @contextlib.contextmanager
     def input_stall(self) -> Iterator[None]:
@@ -184,31 +205,31 @@ class StepTimer:
         yield
         self._stall_seen += 1
         if self._stall_seen > self.warmup_steps:
-            self._stall_times.append(time.perf_counter() - t0)
+            self._stall_hist.record(time.perf_counter() - t0)
 
     @property
     def host_dispatch_us(self) -> float:
         """Mean host-dispatch microseconds per (post-warmup) step."""
-        if not self._dispatch_times:
+        if not self._dispatch_hist.count:
             return float("nan")
-        return 1e6 * sum(self._dispatch_times) / len(self._dispatch_times)
+        return 1e6 * self._dispatch_hist.mean
 
     @property
     def input_stall_us(self) -> float:
         """Mean microseconds per (post-warmup) step spent waiting on input."""
-        if not self._stall_times:
+        if not self._stall_hist.count:
             return float("nan")
-        return 1e6 * sum(self._stall_times) / len(self._stall_times)
+        return 1e6 * self._stall_hist.mean
 
     @property
     def steps_recorded(self) -> int:
-        return len(self._times)
+        return self._step_hist.count
 
     @property
     def mean_step_time(self) -> float:
-        if not self._times:
+        if not self._step_hist.count:
             return float("nan")
-        return sum(self._times) / len(self._times)
+        return self._step_hist.mean
 
     @property
     def steps_per_sec(self) -> float:
@@ -223,7 +244,7 @@ class StepTimer:
         """Model FLOPs utilization in [0,1] against chip peak * num_chips."""
         peak = self.peak_flops if self.peak_flops is not None else peak_flops_per_chip()
         chips = self.num_chips if self.num_chips is not None else jax.device_count()
-        if not peak or not self.flops_per_step or not self._times:
+        if not peak or not self.flops_per_step or not self._step_hist.count:
             return 0.0
         achieved = self.flops_per_step / self.mean_step_time
         return achieved / (peak * chips)
@@ -234,14 +255,19 @@ class StepTimer:
             "mean_step_time_s": self.mean_step_time,
             "steps_per_sec": self.steps_per_sec,
         }
+        if self._step_hist.count:
+            # tail latency, not just means: the sketch keeps p50/p99 at
+            # bounded memory for a run of any length
+            out["step_time_p50_s"] = self._step_hist.quantile(0.5)
+            out["step_time_p99_s"] = self._step_hist.quantile(0.99)
         if self.tokens_per_step:
             out["tokens_per_sec"] = self.tokens_per_sec
             chips = self.num_chips if self.num_chips is not None else jax.device_count()
             out["tokens_per_sec_per_chip"] = self.tokens_per_sec / max(1, chips)
         if self.flops_per_step:
             out["mfu"] = self.mfu()
-        if self._dispatch_times:
+        if self._dispatch_hist.count:
             out["host_dispatch_us_mean"] = self.host_dispatch_us
-        if self._stall_times:
+        if self._stall_hist.count:
             out["input_stall_us_mean"] = self.input_stall_us
         return out

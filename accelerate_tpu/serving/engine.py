@@ -43,8 +43,12 @@ import numpy as np
 
 from ..models.decode import sample_token
 from ..profiler import StepTimer
+from ..telemetry.export import start_metrics_server
+from ..telemetry.registry import MetricsRegistry
+from ..telemetry.trace import span
+from ..telemetry.watchdog import StallWatchdog, resolve_stall_timeout
 from .cache import SlotKVCache, reset_slot, slot_caches, write_slot
-from .metrics import MAX_SAMPLES, ServingMetrics
+from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler, Slot, SlotState
 
 __all__ = ["Engine", "EngineConfig"]
@@ -54,7 +58,15 @@ __all__ = ["Engine", "EngineConfig"]
 class EngineConfig:
     """Serving knobs. `max_len` bounds prompt+generated per slot (admission
     rejects longer requests); `prefill_chunk` trades prefill efficiency
-    against how long a long prompt may stall decode (one chunk)."""
+    against how long a long prompt may stall decode (one chunk).
+
+    Observability: `metrics_port` serves the engine's telemetry registry
+    as a Prometheus endpoint from a background thread (0 = ephemeral
+    port, read it from `engine.metrics_server.port`; None defers to
+    `ACCELERATE_TPU_METRICS_PORT`, unset = off). `watchdog_timeout_s`
+    arms a stall watchdog ticked by `step()` — after that much silence it
+    dumps all-thread stacks / HBM stats / the span flight recorder to the
+    log (None defers to `ACCELERATE_TPU_STALL_TIMEOUT_S`, unset = off)."""
 
     num_slots: int = 4
     max_len: int = 512
@@ -63,6 +75,8 @@ class EngineConfig:
     cache_dtype: Any = jnp.bfloat16
     seed: int = 0
     donate: bool = True
+    metrics_port: int | None = None
+    watchdog_timeout_s: float | None = None
 
 
 def _cache_spec(config) -> tuple[int, int, int]:
@@ -121,10 +135,22 @@ class Engine:
         )
         self.scheduler = Scheduler(ec.num_slots, ec.max_len,
                                    max_queue=ec.max_queue, clock=clock)
-        self.metrics = ServingMetrics()
-        # bounded like the ServingMetrics windows: the engine steps for the
-        # server's lifetime, so raw dispatch samples must not grow O(steps)
-        self.timer = StepTimer(warmup_steps=1, max_samples=MAX_SAMPLES)
+        # per-engine registry (not the process default) so concurrent
+        # engines in one process never collide on series; the histograms
+        # are streaming sketches, so a server that steps forever still
+        # holds O(1) metric memory
+        self.registry = MetricsRegistry()
+        self.metrics = ServingMetrics(registry=self.registry)
+        self.timer = StepTimer(warmup_steps=1, registry=self.registry,
+                               name="serving_step")
+        # opt-in observability: Prometheus endpoint + stall watchdog
+        self.metrics_server = start_metrics_server(
+            ec.metrics_port, registry=self.registry)
+        self.watchdog: StallWatchdog | None = None
+        wd_timeout = resolve_stall_timeout(ec.watchdog_timeout_s)
+        if wd_timeout is not None:
+            self.watchdog = StallWatchdog(
+                wd_timeout, name="serving-engine").start()
 
         self._tokens = jnp.zeros((ec.num_slots,), jnp.int32)
         self._slot_keys = jax.random.key_data(
@@ -288,6 +314,8 @@ class Engine:
         batched decode step). Returns False when the engine is idle."""
         if self.metrics.started_at is None:
             self.metrics.started_at = self._clock()
+        if self.watchdog is not None:
+            self.watchdog.tick()
         self._admit_pending()
         action = self.scheduler.next_action()
         if action is None:
@@ -297,10 +325,10 @@ class Engine:
             self._run_prefill_chunk(action[1])
         else:
             self._run_decode(action[1])
+        self.metrics.stopped_at = self._clock()
         self.metrics.observe_step(self.scheduler.live_slots,
                                   self.engine_config.num_slots,
                                   self.scheduler.queue_depth)
-        self.metrics.stopped_at = self._clock()
         self._maybe_log()
         return True
 
@@ -322,10 +350,11 @@ class Engine:
         if key_raw is None:
             key_raw = jax.random.key_data(
                 jax.random.fold_in(self._base_key, req.request_id))
-        self.cache, self._slot_keys, self._temps = self._admit_p(
-            self.cache, self._slot_keys, self._temps,
-            jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
-        )
+        with span("serving.admit"):
+            self.cache, self._slot_keys, self._temps = self._admit_p(
+                self.cache, self._slot_keys, self._temps,
+                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
+            )
 
     def _run_prefill_chunk(self, slot: Slot) -> None:
         chunk = self.engine_config.prefill_chunk
@@ -334,12 +363,12 @@ class Engine:
         real = min(chunk, req.prompt_len - start)
         ids = np.zeros((chunk,), np.int32)
         ids[:real] = req.prompt[start:start + real]
-        with self.timer.dispatch():
+        with span("serving.prefill"), self.timer.dispatch():
             self.cache, self._tokens = self._prefill_p(
                 self.params, self.cache, self._tokens, self._slot_keys,
                 self._temps, jnp.int32(slot.index), ids, jnp.int32(real),
             )
-        self.metrics.prefill_chunks += 1
+        self.metrics.note_prefill_chunk()
         if self.scheduler.note_prefill_chunk(slot, real):
             # the chunk that completed the prompt also produced the
             # request's first token — fetch it (TTFT is measured here)
@@ -351,14 +380,14 @@ class Engine:
         live = np.zeros((self.engine_config.num_slots,), bool)
         for s in slots:
             live[s.index] = True
-        with self.timer.dispatch():
+        with span("serving.decode"), self.timer.dispatch():
             self.cache, self._tokens = self._decode_p(
                 self.params, self.cache, self._tokens, self._slot_keys,
                 self._temps, live,
             )
         toks = np.asarray(self._tokens)  # the per-step host read
         self.timer.tick(block_on=None)
-        self.metrics.decode_steps += 1
+        self.metrics.note_decode_step()
         for s in slots:
             req = s.request
             if self.scheduler.note_token(s, int(toks[s.index])):
@@ -368,9 +397,13 @@ class Engine:
 
     def reset_metrics(self) -> None:
         """Drop accumulated samples (e.g. after a warmup pass). Compiled
-        programs, slot state, and in-flight requests are untouched."""
-        self.metrics = ServingMetrics()
-        self.timer = StepTimer(warmup_steps=0, max_samples=MAX_SAMPLES)
+        programs, slot state, and in-flight requests are untouched. The
+        registry's series objects survive (zeroed in place), so the
+        Prometheus endpoint and any cached metric handles stay live."""
+        self.registry.reset()
+        self.metrics = ServingMetrics(registry=self.registry)
+        self.timer = StepTimer(warmup_steps=0, registry=self.registry,
+                               name="serving_step")
         # decode_steps restarts from 0, so the log guard must too — a stale
         # value would swallow the first post-reset log point
         self._last_logged = 0
@@ -379,11 +412,21 @@ class Engine:
         """Flat serving metrics (TTFT/per-token percentiles, occupancy,
         queue depth, tokens/sec) + the StepTimer's host-overhead meters."""
         out = self.metrics.summary()
-        if self.timer._dispatch_times:
+        if self.timer._dispatch_hist.count:
             out["host_dispatch_us_mean"] = self.timer.host_dispatch_us
         out.update({f"compiles_{k}": float(v)
                     for k, v in self.compile_stats().items()})
         return out
+
+    def close(self) -> None:
+        """Stop the background observability threads (exporter, watchdog).
+        Idempotent; the engine itself stays usable."""
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
 
     def _maybe_log(self) -> None:
         if not self._tracker or not self._log_every:

@@ -1,86 +1,127 @@
 """Serving metrics: per-request latency distributions + engine gauges.
 
 The serving numbers that matter are distributional (a mean TTFT hides the
-p99 a shed request would have seen), so the aggregator keeps raw samples
-and summarizes to percentiles. Engine-level gauges (slot occupancy, queue
-depth) are sampled once per engine step. The summary is a flat
+p99 a shed request would have seen). Distributions live in the shared
+`telemetry.StreamingHistogram` sketches — bounded memory however long the
+server runs, exact counts/sums, mergeable across hosts — registered on a
+`telemetry.MetricsRegistry` so the same series the `summary()` dict
+reports are also served by the Prometheus endpoint and the JSONL
+snapshot writer. Engine-level gauges (slot occupancy, queue depth,
+tokens/sec) are sampled once per engine step. The summary is a flat
 str -> float dict, so it drops straight into the existing tracking layer
 (`GeneralTracker.log`) and into `bench.py`'s one-line JSON.
 """
 
 from __future__ import annotations
 
-from collections import deque
-from dataclasses import dataclass, field
-
 import numpy as np
 
+from ..telemetry.registry import MetricsRegistry, StreamingHistogram
 from .scheduler import Request
 
-# Raw-sample cap: a long-lived server steps forever, and unbounded sample
-# lists grow by O(steps + tokens) — percentiles are computed over the most
-# recent window instead (counters stay exact and lifetime-cumulative).
-MAX_SAMPLES = 100_000
 
-
-def _window() -> deque[float]:
-    return deque(maxlen=MAX_SAMPLES)
-
-
-def _percentiles(samples: "deque[float]", name: str) -> dict[str, float]:
-    if not samples:
+def _percentiles(hist: StreamingHistogram, name: str) -> dict[str, float]:
+    if not hist.count:
         return {}
-    arr = np.asarray(samples, dtype=np.float64)
     return {
-        f"{name}_p50_ms": float(np.percentile(arr, 50) * 1e3),
-        f"{name}_p99_ms": float(np.percentile(arr, 99) * 1e3),
-        f"{name}_mean_ms": float(arr.mean() * 1e3),
+        f"{name}_p50_ms": hist.quantile(0.5) * 1e3,
+        f"{name}_p99_ms": hist.quantile(0.99) * 1e3,
+        f"{name}_mean_ms": hist.mean * 1e3,
     }
 
 
-@dataclass
 class ServingMetrics:
-    """Aggregates finished requests + per-step engine gauges."""
+    """Aggregates finished requests + per-step engine gauges.
 
-    ttft_s: deque[float] = field(default_factory=_window)
-    tpot_s: deque[float] = field(default_factory=_window)  # time per output token
-    queue_wait_s: deque[float] = field(default_factory=_window)
-    occupancy: deque[float] = field(default_factory=_window)
-    queue_depth: deque[int] = field(default_factory=_window)
-    finished: int = 0
-    cancelled: int = 0
-    rejected: int = 0
-    expired: int = 0
-    tokens_out: int = 0
-    decode_steps: int = 0
-    prefill_chunks: int = 0
-    started_at: float | None = None
-    stopped_at: float | None = None
+    All series are registry-backed; pass the engine's registry so the
+    exporters see them, or omit it for a self-contained instance."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = r = registry or MetricsRegistry()
+        self.ttft_s = r.histogram("serving_ttft_seconds")
+        self.tpot_s = r.histogram("serving_per_token_seconds")
+        self.queue_wait_s = r.histogram("serving_queue_wait_seconds")
+        self.occupancy = r.histogram("serving_slot_occupancy")
+        self.queue_depth = r.histogram("serving_queue_depth")
+        self._c_finished = r.counter("serving_requests_finished_total")
+        self._c_cancelled = r.counter("serving_requests_cancelled_total")
+        self._c_rejected = r.counter("serving_requests_rejected_total")
+        self._c_expired = r.counter("serving_requests_expired_total")
+        self._c_tokens = r.counter("serving_tokens_out_total")
+        self._c_decode = r.counter("serving_decode_steps_total")
+        self._c_prefill = r.counter("serving_prefill_chunks_total")
+        self._g_queue_depth = r.gauge("serving_queue_depth_current")
+        self._g_occupancy = r.gauge("serving_slot_occupancy_current")
+        self._g_tokens_per_sec = r.gauge("serving_tokens_per_sec")
+        self.started_at: float | None = None
+        self.stopped_at: float | None = None
+
+    # counters read back as ints for the summary / engine bookkeeping
+    @property
+    def finished(self) -> int:
+        return int(self._c_finished.value)
+
+    @property
+    def cancelled(self) -> int:
+        return int(self._c_cancelled.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._c_rejected.value)
+
+    @property
+    def expired(self) -> int:
+        return int(self._c_expired.value)
+
+    @property
+    def tokens_out(self) -> int:
+        return int(self._c_tokens.value)
+
+    @property
+    def decode_steps(self) -> int:
+        return int(self._c_decode.value)
+
+    @property
+    def prefill_chunks(self) -> int:
+        return int(self._c_prefill.value)
+
+    def note_decode_step(self) -> None:
+        self._c_decode.inc()
+
+    def note_prefill_chunk(self) -> None:
+        self._c_prefill.inc()
 
     def observe_step(self, live_slots: int, num_slots: int,
                      queue_depth: int) -> None:
-        self.occupancy.append(live_slots / max(1, num_slots))
-        self.queue_depth.append(queue_depth)
+        occ = live_slots / max(1, num_slots)
+        self.occupancy.record(occ)
+        self.queue_depth.record(queue_depth)
+        self._g_occupancy.set(occ)
+        self._g_queue_depth.set(queue_depth)
+        if (self.started_at is not None and self.stopped_at is not None
+                and self.stopped_at > self.started_at):
+            self._g_tokens_per_sec.set(
+                self.tokens_out / (self.stopped_at - self.started_at))
 
     def observe_request(self, req: Request) -> None:
         """Fold one terminal request into the aggregates."""
         if req.status.value == "finished":
-            self.finished += 1
-            self.tokens_out += len(req.tokens)
+            self._c_finished.inc()
+            self._c_tokens.inc(len(req.tokens))
             if req.ttft_s is not None:
-                self.ttft_s.append(req.ttft_s)
+                self.ttft_s.record(req.ttft_s)
             if req.admitted_at is not None:
-                self.queue_wait_s.append(req.admitted_at - req.submitted_at)
+                self.queue_wait_s.record(req.admitted_at - req.submitted_at)
             # per-token latency: gaps between consecutive decode tokens
             # (TTFT is its own metric; the first gap is excluded)
-            gaps = np.diff(req.token_times)
-            self.tpot_s.extend(float(g) for g in gaps)
+            for g in np.diff(req.token_times):
+                self.tpot_s.record(float(g))
         elif req.status.value == "cancelled":
-            self.cancelled += 1
+            self._c_cancelled.inc()
         elif req.status.value == "rejected":
-            self.rejected += 1
+            self._c_rejected.inc()
         elif req.status.value == "expired":
-            self.expired += 1
+            self._c_expired.inc()
 
     def summary(self) -> dict[str, float]:
         out: dict[str, float] = {
@@ -95,11 +136,11 @@ class ServingMetrics:
         out.update(_percentiles(self.ttft_s, "ttft"))
         out.update(_percentiles(self.tpot_s, "per_token"))
         out.update(_percentiles(self.queue_wait_s, "queue_wait"))
-        if self.occupancy:
-            out["slot_occupancy_mean"] = float(np.mean(self.occupancy))
-        if self.queue_depth:
-            out["queue_depth_mean"] = float(np.mean(self.queue_depth))
-            out["queue_depth_max"] = float(np.max(self.queue_depth))
+        if self.occupancy.count:
+            out["slot_occupancy_mean"] = self.occupancy.mean
+        if self.queue_depth.count:
+            out["queue_depth_mean"] = self.queue_depth.mean
+            out["queue_depth_max"] = self.queue_depth.max
         if (self.started_at is not None and self.stopped_at is not None
                 and self.stopped_at > self.started_at):
             out["tokens_per_sec"] = self.tokens_out / (

@@ -1,0 +1,92 @@
+"""Unified telemetry: metrics registry, span tracing, exporters,
+multi-host aggregation, and the stall watchdog.
+
+One observability layer the rest of the codebase plugs into (ISSUE 3):
+
+- `registry` — named counters/gauges/streaming-histograms with labeled
+  series and an atomic `snapshot()`; `get_registry()` is the
+  process-wide default.
+- `trace` — `span("name", **attrs)` host spans with trace/span IDs, a
+  ring-buffer flight recorder, Perfetto/`chrome://tracing` export, and
+  `jax.profiler.TraceAnnotation` forwarding; no-op when disabled.
+- `export` — Prometheus text endpoint on a background thread (opt-in via
+  flag or `ACCELERATE_TPU_METRICS_PORT`) + JSONL snapshot helpers for
+  the `GeneralTracker` fan-out.
+- `aggregate` — cross-host min/mean/max/sum + sketch-merge reduction of
+  snapshots (global tokens/sec, slowest-host step time, per-host HBM).
+- `watchdog` — heartbeat thread that dumps all-thread stacks, device
+  memory stats, and the flight-recorder tail when a job goes silent.
+
+Importing this package never initializes a jax backend (guarded by
+tests/test_telemetry.py), so it is safe in CLI tools and collectors.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .registry import (
+    Counter,
+    Gauge,
+    MetricsRegistry,
+    StreamingHistogram,
+    flatten_snapshot,
+    get_registry,
+)
+from .trace import (
+    clear_flight_recorder,
+    configure_tracing,
+    export_chrome_trace,
+    flight_recorder,
+    span,
+    tracing_enabled,
+)
+from .export import (
+    METRICS_HOST_ENV,
+    METRICS_PORT_ENV,
+    MetricsServer,
+    render_prometheus,
+    resolve_metrics_port,
+    snapshot_for_tracking,
+    start_metrics_server,
+    write_snapshot,
+)
+from .aggregate import aggregate_flat, aggregate_snapshot
+from .watchdog import (
+    STALL_TIMEOUT_ENV,
+    StallError,
+    StallWatchdog,
+    resolve_stall_timeout,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "MetricsRegistry",
+    "StreamingHistogram",
+    "flatten_snapshot",
+    "get_registry",
+    "span",
+    "configure_tracing",
+    "tracing_enabled",
+    "flight_recorder",
+    "clear_flight_recorder",
+    "export_chrome_trace",
+    "MetricsServer",
+    "render_prometheus",
+    "resolve_metrics_port",
+    "start_metrics_server",
+    "snapshot_for_tracking",
+    "write_snapshot",
+    "METRICS_PORT_ENV",
+    "METRICS_HOST_ENV",
+    "aggregate_snapshot",
+    "aggregate_flat",
+    "StallWatchdog",
+    "StallError",
+    "resolve_stall_timeout",
+    "STALL_TIMEOUT_ENV",
+]
+
+if os.environ.get("ACCELERATE_TPU_TRACE", "").strip() in ("1", "true", "on"):
+    configure_tracing(enabled=True)

@@ -1,0 +1,145 @@
+"""Cross-host aggregation of metrics snapshots — the straggler view.
+
+A per-host snapshot answers "how is THIS process doing"; a multi-host TPU
+job hangs or crawls because of its *slowest* host. `aggregate_snapshot`
+all-gathers every host's snapshot over the existing host-collective
+helpers (`utils.operations.gather_object`, the same fabric the eval loop
+uses) and reduces:
+
+- counters  -> global sum (global tokens/sec comes from summed token
+  counters over the window),
+- gauges    -> min / mean / max across hosts (per-host HBM high-water
+  marks surface as `name__max`),
+- histograms -> the serialized sketches MERGE, so rank 0 reports true
+  global p50/p99 — and `name__slowest_host_mean` exposes the worst
+  per-host mean (the straggler signal a merged distribution hides).
+
+Call it at log boundaries from EVERY process (it is a collective);
+every host gets the aggregate back, rank 0 typically logs it.
+
+jax-touching imports stay inside the function so
+`accelerate_tpu.telemetry` imports without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import math
+
+from .registry import MetricsRegistry, StreamingHistogram, get_registry
+
+__all__ = ["aggregate_snapshot", "aggregate_flat"]
+
+
+def _reduce_scalar(values: list[float]) -> dict[str, float]:
+    vals = [v for v in values if v == v]  # drop NaN
+    if not vals:
+        return {"min": math.nan, "mean": math.nan, "max": math.nan,
+                "sum": math.nan}
+    return {
+        "min": min(vals),
+        "mean": sum(vals) / len(vals),
+        "max": max(vals),
+        "sum": sum(vals),
+    }
+
+
+def aggregate_snapshot(registry: MetricsRegistry | None = None,
+                       snapshots: list[dict] | None = None) -> dict:
+    """All-gather per-host snapshots and reduce (collective — call on all
+    processes). `snapshots` overrides the gather for offline/test use.
+
+    Returns::
+
+        {"num_hosts": P,
+         "counters": {key: {"sum": ..., "min": ..., "max": ...}},
+         "gauges": {key: {"min": ..., "mean": ..., "max": ...}},
+         "histograms": {key: {count, sum, mean, p50, p90, p99,
+                              "slowest_host_mean": ...}}}
+    """
+    if snapshots is None:
+        local = (registry or get_registry()).snapshot(include_sketch=True)
+        from ..utils.operations import gather_object
+
+        snapshots = gather_object(local)
+    out: dict = {"num_hosts": len(snapshots), "counters": {}, "gauges": {},
+                 "histograms": {}}
+
+    keys = {k for s in snapshots for k in s.get("counters", {})}
+    for key in sorted(keys):
+        vals = [s["counters"][key] for s in snapshots
+                if key in s.get("counters", {})]
+        red = _reduce_scalar(vals)
+        out["counters"][key] = {"sum": red["sum"], "min": red["min"],
+                                "max": red["max"]}
+
+    keys = {k for s in snapshots for k in s.get("gauges", {})}
+    for key in sorted(keys):
+        vals = [s["gauges"][key] for s in snapshots
+                if key in s.get("gauges", {})]
+        red = _reduce_scalar(vals)
+        out["gauges"][key] = {"min": red["min"], "mean": red["mean"],
+                              "max": red["max"]}
+
+    keys = {k for s in snapshots for k in s.get("histograms", {})}
+    for key in sorted(keys):
+        entries = [s["histograms"][key] for s in snapshots
+                   if key in s.get("histograms", {})]
+        merged: StreamingHistogram | None = None
+        per_host_means = []
+        for e in entries:
+            if e.get("count"):
+                per_host_means.append(e["sum"] / e["count"])
+            sketch = e.get("sketch")
+            if sketch is not None:
+                h = StreamingHistogram.from_dict(sketch)
+                if merged is None:
+                    merged = h
+                else:
+                    merged.merge(h)
+        entry: dict = {}
+        if merged is not None and merged.count:
+            entry = {
+                "count": float(merged.count),
+                "sum": merged.sum,
+                "mean": merged.mean,
+                "min": merged.min,
+                "max": merged.max,
+                "p50": merged.quantile(0.5),
+                "p90": merged.quantile(0.9),
+                "p99": merged.quantile(0.99),
+            }
+        else:  # sketchless snapshots still reduce their scalar stats
+            entry = {
+                "count": sum(e.get("count", 0.0) for e in entries),
+                "sum": sum(e.get("sum", 0.0) for e in entries),
+            }
+            if entry["count"]:
+                entry["mean"] = entry["sum"] / entry["count"]
+        if per_host_means:
+            # the straggler signal: the worst single host's mean (a merged
+            # global distribution averages it away)
+            entry["slowest_host_mean"] = max(per_host_means)
+        out["histograms"][key] = entry
+    return out
+
+
+def aggregate_flat(registry: MetricsRegistry | None = None,
+                   snapshots: list[dict] | None = None,
+                   prefix: str = "telemetry/") -> dict[str, float]:
+    """`aggregate_snapshot` flattened for `GeneralTracker.log`: counters
+    as `<key>` (global sum), gauges as `<key>__min/__mean/__max`,
+    histograms as `<key>_p50/_p99/...` plus `<key>__slowest_host_mean`."""
+    agg = aggregate_snapshot(registry=registry, snapshots=snapshots)
+    flat: dict[str, float] = {prefix + "num_hosts": float(agg["num_hosts"])}
+    for key, red in agg["counters"].items():
+        flat[prefix + key] = red["sum"]
+    for key, red in agg["gauges"].items():
+        for stat in ("min", "mean", "max"):
+            flat[f"{prefix}{key}__{stat}"] = red[stat]
+    for key, entry in agg["histograms"].items():
+        for stat in ("count", "mean", "p50", "p90", "p99"):
+            if stat in entry:
+                flat[f"{prefix}{key}_{stat}"] = entry[stat]
+        if "slowest_host_mean" in entry:
+            flat[f"{prefix}{key}__slowest_host_mean"] = entry["slowest_host_mean"]
+    return flat

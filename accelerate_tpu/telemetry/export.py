@@ -1,0 +1,220 @@
+"""Exporters: Prometheus text exposition over HTTP, and JSONL snapshots.
+
+Two surfaces for the same registry snapshot:
+
+- `MetricsServer` / `start_metrics_server`: a background-thread stdlib
+  HTTP server (no new dependencies) exposing `GET /metrics` in the
+  Prometheus text format (0.0.4) — counters as `counter`, gauges as
+  `gauge`, streaming histograms as `summary` quantile series with
+  `_sum`/`_count`. Opt-in: nothing binds unless an `EngineConfig` /
+  `Accelerator` flag or `ACCELERATE_TPU_METRICS_PORT` asks for it; port 0
+  binds an ephemeral port (the resolved one is on `server.port`).
+- `write_snapshot` / `snapshot_for_tracking`: one flat JSON object per
+  call, shaped for the existing `GeneralTracker.log` fan-out (the
+  `JSONLTracker` backend turns it into one JSONL line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import MetricsRegistry, flatten_snapshot, get_registry
+
+__all__ = [
+    "render_prometheus",
+    "MetricsServer",
+    "start_metrics_server",
+    "resolve_metrics_port",
+    "snapshot_for_tracking",
+    "write_snapshot",
+]
+
+METRICS_PORT_ENV = "ACCELERATE_TPU_METRICS_PORT"
+METRICS_HOST_ENV = "ACCELERATE_TPU_METRICS_HOST"
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _sanitize(name: str) -> str:
+    """Prometheus metric names: [a-zA-Z_:][a-zA-Z0-9_:]*."""
+    out = "".join(c if c.isalnum() or c in "_:" else "_" for c in name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
+    parts = ['%s="%s"' % (_sanitize(k), _escape(str(v))) for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    return repr(float(v))
+
+
+def render_prometheus(registry: MetricsRegistry | None = None) -> str:
+    """Text exposition (version 0.0.4) of every series in the registry."""
+    registry = registry or get_registry()
+    lines: list[str] = []
+    seen_types: set[str] = set()
+
+    def type_line(name: str, kind: str) -> None:
+        if name not in seen_types:
+            seen_types.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for kind, name, labels, metric in registry.items():
+        pname = _sanitize(name)
+        if kind == "counter":
+            type_line(pname, "counter")
+            lines.append(f"{pname}{_fmt_labels(labels)} {_fmt_value(metric.value)}")
+        elif kind == "gauge":
+            type_line(pname, "gauge")
+            lines.append(f"{pname}{_fmt_labels(labels)} {_fmt_value(metric.value)}")
+        else:  # histogram -> summary (quantiles come from the sketch)
+            type_line(pname, "summary")
+            for q in _QUANTILES:
+                val = metric.quantile(q) if metric.count else float("nan")
+                qlabel = 'quantile="%s"' % q
+                lines.append(
+                    f"{pname}{_fmt_labels(labels, qlabel)} {_fmt_value(val)}"
+                )
+            lines.append(f"{pname}_sum{_fmt_labels(labels)} {_fmt_value(metric.sum)}")
+            lines.append(f"{pname}_count{_fmt_labels(labels)} {_fmt_value(metric.count)}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry | None = None  # set per server subclass
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        if self.path.split("?")[0] not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = render_prometheus(self.registry).encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes are not log lines
+        pass
+
+
+class MetricsServer:
+    """Prometheus endpoint on a background daemon thread.
+
+    `port=0` binds an ephemeral port — read the resolved one from
+    `.port` (this is what tier-1 tests use, so no fixed ports collide).
+    Binds loopback by default — telemetry carries workload details, so
+    exposing it beyond the host is an explicit choice (`host="0.0.0.0"`
+    or `ACCELERATE_TPU_METRICS_HOST` for a real scrape target).
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry or get_registry()
+        handler = type("_BoundHandler", (_Handler,),
+                       {"registry": self.registry})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name="accelerate-tpu-metrics", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._httpd.server_close()
+
+
+def resolve_metrics_port(explicit: int | None = None) -> int | None:
+    """The port to serve on: an explicit flag wins, else
+    `ACCELERATE_TPU_METRICS_PORT`; None/unset means the exporter stays
+    off. `0` (either source) binds an ephemeral port."""
+    if explicit is not None:
+        return int(explicit)
+    raw = os.environ.get(METRICS_PORT_ENV, "").strip()
+    if not raw:
+        return None
+    return int(raw)
+
+
+def start_metrics_server(port: int | None = None,
+                         registry: MetricsRegistry | None = None,
+                         host: str | None = None) -> MetricsServer | None:
+    """Start the exporter if a port is configured (flag or env); returns
+    the running server, or None when observability is not requested.
+
+    An EXPLICIT port that cannot bind raises (the caller asked for it);
+    an env-resolved port that is already taken — e.g. a second Engine in
+    a process where the Accelerator already bound
+    `ACCELERATE_TPU_METRICS_PORT` — logs a warning and returns None
+    instead of aborting construction."""
+    resolved = resolve_metrics_port(port)
+    if resolved is None:
+        return None
+    if host is None:
+        host = os.environ.get(METRICS_HOST_ENV, "").strip() or "127.0.0.1"
+    try:
+        return MetricsServer(registry=registry, port=resolved,
+                             host=host).start()
+    except OSError as e:
+        if port is not None:
+            raise
+        from ..logging import get_logger
+
+        get_logger(__name__).warning(
+            f"metrics exporter: could not bind {host}:{resolved} from "
+            f"{METRICS_PORT_ENV} ({e}); continuing without an endpoint. "
+            "Use port 0 (ephemeral) or per-component flags for multiple "
+            "binders in one process."
+        )
+        return None
+
+
+def snapshot_for_tracking(registry: MetricsRegistry | None = None,
+                          prefix: str = "telemetry/") -> dict[str, float]:
+    """Flat str -> float snapshot shaped for `GeneralTracker.log` (the
+    JSONLTracker in the fan-out turns it into one JSONL line)."""
+    registry = registry or get_registry()
+    return flatten_snapshot(registry.snapshot(), prefix=prefix)
+
+
+def write_snapshot(path: str,
+                   registry: MetricsRegistry | None = None) -> dict:
+    """Append one JSON line of the current snapshot to `path` (for
+    callers outside the tracker fan-out, e.g. a serving smoke run)."""
+    registry = registry or get_registry()
+    record = {"ts": time.time(), **flatten_snapshot(registry.snapshot())}
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    return record

@@ -1,0 +1,214 @@
+"""Host-side span tracing with a ring-buffer flight recorder.
+
+`span("name", **attrs)` wraps a region of host code; when tracing is
+enabled each span records (trace id, span id, parent id, thread, start,
+duration, attrs) into a bounded ring buffer — the *flight recorder* — and
+optionally enters `jax.profiler.TraceAnnotation` so the same names appear
+on XLA device traces captured by `profiler.profile()`. The recorder tail
+is what the stall watchdog dumps when a job goes silent, and
+`export_chrome_trace()` writes the whole ring as Perfetto-compatible
+`chrome://tracing` JSON.
+
+Disabled (the default) a span is a shared no-op context manager: one
+function call, one attribute load, no allocation — cheap enough to leave
+in dispatch-path code permanently (guarded by the overhead test in
+tests/test_telemetry.py). Enable with `configure_tracing(enabled=True)`
+or `ACCELERATE_TPU_TRACE=1`.
+
+jax is imported lazily and only while tracing is enabled, so this module
+never initializes an accelerator backend on import.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+__all__ = [
+    "span",
+    "configure_tracing",
+    "tracing_enabled",
+    "flight_recorder",
+    "clear_flight_recorder",
+    "export_chrome_trace",
+]
+
+
+class _NullSpan:
+    """Shared do-nothing span: the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _State:
+    __slots__ = ("enabled", "annotate", "ring", "lock", "span_ids",
+                 "trace_ids", "tls")
+
+    def __init__(self):
+        self.enabled = False
+        self.annotate = True
+        self.ring: deque = deque(maxlen=4096)
+        self.lock = threading.Lock()
+        self.span_ids = itertools.count(1)
+        self.trace_ids = itertools.count(1)
+        self.tls = threading.local()
+
+
+_STATE = _State()
+_annotation_cls: Any = None  # resolved lazily; False = unavailable
+
+
+def configure_tracing(enabled: bool = True, ring_size: int | None = None,
+                      annotate: bool | None = None) -> None:
+    """Turn host-span recording on/off. `ring_size` bounds the flight
+    recorder (events, not spans — one per completed span); `annotate`
+    controls forwarding span names to `jax.profiler.TraceAnnotation`."""
+    _STATE.enabled = bool(enabled)
+    if ring_size is not None:
+        with _STATE.lock:
+            _STATE.ring = deque(_STATE.ring, maxlen=int(ring_size))
+    if annotate is not None:
+        _STATE.annotate = bool(annotate)
+
+
+def tracing_enabled() -> bool:
+    return _STATE.enabled
+
+
+def _resolve_annotation_cls():
+    global _annotation_cls
+    if _annotation_cls is None:
+        try:
+            import jax
+
+            _annotation_cls = jax.profiler.TraceAnnotation
+        except Exception:
+            _annotation_cls = False
+    return _annotation_cls
+
+
+def _stack() -> list:
+    stack = getattr(_STATE.tls, "stack", None)
+    if stack is None:
+        stack = _STATE.tls.stack = []
+    return stack
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "trace_id", "span_id", "parent_id",
+                 "_start_ns", "_annotation")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = _stack()
+        if stack:
+            parent = stack[-1]
+            self.trace_id, self.parent_id = parent.trace_id, parent.span_id
+        else:
+            self.trace_id = next(_STATE.trace_ids)
+            self.parent_id = 0
+        self.span_id = next(_STATE.span_ids)
+        stack.append(self)
+        self._annotation = None
+        if _STATE.annotate:
+            cls = _resolve_annotation_cls()
+            if cls:
+                self._annotation = cls(self.name)
+                self._annotation.__enter__()
+        self._start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        end_ns = time.perf_counter_ns()
+        if self._annotation is not None:
+            self._annotation.__exit__(exc_type, exc, tb)
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        event = {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "thread": threading.get_ident(),
+            "start_ns": self._start_ns,
+            "dur_ns": end_ns - self._start_ns,
+        }
+        if self.attrs:
+            event["attrs"] = self.attrs
+        if exc_type is not None:
+            event["error"] = exc_type.__name__
+        _STATE.ring.append(event)  # deque.append is thread-safe
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager around a host-side region. No-op when tracing is
+    disabled; otherwise records to the flight recorder and mirrors the
+    name onto the XLA trace timeline."""
+    if not _STATE.enabled:
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def flight_recorder(last: int | None = None) -> list[dict]:
+    """Most recent completed spans, oldest first (the watchdog dumps the
+    tail of this on a stall)."""
+    with _STATE.lock:
+        events = list(_STATE.ring)
+    if last is not None:
+        events = events[-last:]
+    return events
+
+
+def clear_flight_recorder() -> None:
+    with _STATE.lock:
+        _STATE.ring.clear()
+
+
+def export_chrome_trace(path: str | None = None) -> dict:
+    """Render the flight recorder as `chrome://tracing` / Perfetto JSON
+    (complete 'X' events; microsecond timestamps). Returns the document;
+    writes it to `path` when given — load alongside a
+    `profiler.profile()` capture to line host spans up with XLA device
+    slices."""
+    events = []
+    for e in flight_recorder():
+        ev = {
+            "name": e["name"],
+            "cat": "host",
+            "ph": "X",
+            "ts": e["start_ns"] / 1e3,
+            "dur": e["dur_ns"] / 1e3,
+            "pid": os.getpid(),
+            "tid": e["thread"],
+            "args": {
+                "trace_id": e["trace_id"],
+                "span_id": e["span_id"],
+                "parent_id": e["parent_id"],
+                **e.get("attrs", {}),
+            },
+        }
+        events.append(ev)
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -1,0 +1,216 @@
+"""Stall watchdog: turn silent hangs into actionable reports.
+
+A multi-host TPU job that deadlocks in a collective (the exact failure
+mode PR 1's donation-alias bug produced) looks identical to a slow one
+from the outside: no exception, no progress, no logs. The watchdog is a
+heartbeat armed by step/serving-loop ticks; when the configured silence
+elapses it dumps, ONCE per stall:
+
+- every thread's Python stack (where the hang actually is),
+- `profiler.device_memory_stats()` (is HBM exhausted / still moving),
+- the tail of the span flight recorder (what the process last did),
+
+to the logger (and an optional callback), then optionally raises
+`StallError` so a supervisor can fail the job instead of burning TPU
+hours on a wedged collective. A subsequent tick re-arms it.
+
+Default OFF: nothing starts unless a timeout is configured (kwarg or
+`ACCELERATE_TPU_STALL_TIMEOUT_S`), so tests and short scripts never grow
+a background thread. The clock is injectable, which is how the tier-1
+tests drive it deterministically.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable
+
+from .trace import flight_recorder
+
+__all__ = ["StallWatchdog", "StallError", "resolve_stall_timeout",
+           "STALL_TIMEOUT_ENV"]
+
+STALL_TIMEOUT_ENV = "ACCELERATE_TPU_STALL_TIMEOUT_S"
+
+
+class StallError(RuntimeError):
+    """Raised (when `raise_on_stall=True`) after a stall report is dumped."""
+
+
+def resolve_stall_timeout(explicit: float | None = None) -> float | None:
+    """Explicit kwarg wins; else the env var; None means watchdog off."""
+    if explicit is not None:
+        return float(explicit)
+    raw = os.environ.get(STALL_TIMEOUT_ENV, "").strip()
+    if not raw:
+        return None
+    return float(raw)
+
+
+def _all_thread_stacks() -> dict[str, list[str]]:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks: dict[str, list[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, 'unknown')}-{ident}"
+        stacks[label] = traceback.format_stack(frame)
+    return stacks
+
+
+class StallWatchdog:
+    """Heartbeat monitor. `tick()` from the loop being watched; `start()`
+    spawns the background checker (or call `check()` yourself — that is
+    the deterministic path the tests use)."""
+
+    def __init__(
+        self,
+        timeout_s: float,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        on_stall: Callable[[dict], Any] | None = None,
+        raise_on_stall: bool = False,
+        poll_interval_s: float | None = None,
+        flight_recorder_tail: int = 64,
+        logger=None,
+        name: str = "accelerate-tpu",
+    ):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be positive")
+        self.timeout_s = float(timeout_s)
+        self.clock = clock
+        self.on_stall = on_stall
+        self.raise_on_stall = raise_on_stall
+        self.poll_interval_s = (
+            poll_interval_s if poll_interval_s is not None
+            else max(0.25, min(self.timeout_s / 4.0, 5.0))
+        )
+        self.flight_recorder_tail = flight_recorder_tail
+        self.name = name
+        if logger is None:
+            from ..logging import get_logger
+
+            logger = get_logger(__name__)
+        self.logger = logger
+        self.stall_count = 0
+        self._lock = threading.Lock()
+        self._last_tick = self.clock()
+        self._fired = False
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- heartbeat -----------------------------------------------------------
+
+    def tick(self) -> None:
+        """Progress happened: reset the silence window and re-arm."""
+        with self._lock:
+            self._last_tick = self.clock()
+            self._fired = False
+
+    def check(self, now: float | None = None) -> dict | None:
+        """Fire if the silence exceeded `timeout_s` and we haven't fired
+        for this silence yet. Returns the stall report when it fires,
+        else None. Exactly-once per stall: re-arms only on `tick()`."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            silence = now - self._last_tick
+            if self._fired or silence <= self.timeout_s:
+                return None
+            self._fired = True
+            self.stall_count += 1
+        report = self.build_report(silence)
+        self._emit(report)
+        if self.raise_on_stall:
+            raise StallError(
+                f"[{self.name}] no heartbeat for {silence:.1f}s "
+                f"(timeout {self.timeout_s}s); stall report dumped"
+            )
+        return report
+
+    # -- the report ----------------------------------------------------------
+
+    def build_report(self, silence_s: float) -> dict:
+        report: dict[str, Any] = {
+            "watchdog": self.name,
+            "silence_s": silence_s,
+            "timeout_s": self.timeout_s,
+            "stall_count": self.stall_count,
+            "stacks": _all_thread_stacks(),
+            "flight_recorder": flight_recorder(self.flight_recorder_tail),
+        }
+        try:
+            from ..profiler import device_memory_stats
+
+            report["device_memory_stats"] = device_memory_stats()
+        except Exception as e:
+            # a wedged backend must not keep the report from landing
+            report["device_memory_stats"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        return report
+
+    def _emit(self, report: dict) -> None:
+        lines = [
+            f"[{self.name}] STALL: no heartbeat for "
+            f"{report['silence_s']:.1f}s (timeout {self.timeout_s}s). "
+            f"Thread stacks follow.",
+        ]
+        for label, stack in report["stacks"].items():
+            lines.append(f"--- thread {label} ---")
+            lines.append("".join(stack).rstrip())
+        mem = report.get("device_memory_stats") or {}
+        if mem:
+            lines.append(f"device_memory_stats: {mem}")
+        tail = report.get("flight_recorder") or []
+        if tail:
+            lines.append(f"flight recorder (last {len(tail)} spans):")
+            for e in tail[-16:]:
+                lines.append(
+                    f"  {e['name']} dur={e['dur_ns'] / 1e6:.3f}ms "
+                    f"trace={e['trace_id']} span={e['span_id']}"
+                )
+        try:
+            self.logger.error("\n".join(lines))
+        except Exception:
+            pass
+        if self.on_stall is not None:
+            try:
+                self.on_stall(report)
+            except StallError:
+                raise
+            except Exception:
+                pass
+
+    # -- background thread ---------------------------------------------------
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"{self.name}-watchdog", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.check()
+            except StallError:
+                # raise_on_stall in thread mode: the report is already
+                # dumped; the raise ends the checker so a supervisor
+                # watching the log (or on_stall) takes over
+                raise
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -108,6 +108,16 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
     float(m["loss"])
     host_dispatch_us = timer.host_dispatch_us
 
+    # per-step-synchronized window for the tail-latency telemetry row:
+    # tick() blocks on each step's loss, so the histogram sees true
+    # step times (the throughput windows above stay free-running)
+    tail_timer = StepTimer(warmup_steps=0)
+    tail_timer.tick()
+    for _ in range(steps):
+        ts, m = step(ts, batch_arrays)
+        tail_timer.tick(m["loss"])
+    tail_summary = tail_timer.summary()
+
     n_chips = jax.device_count()
     tokens_per_step = batch * seq
     tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
@@ -131,6 +141,14 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         "device": device_kind,
         "n_chips": n_chips,
         "host_dispatch_us": round(host_dispatch_us, 1),
+        # telemetry row (ISSUE 3): step-time tail latency from the shared
+        # streaming-histogram meter, not just means
+        "telemetry": {
+            "step_time_p50_s": round(tail_summary["step_time_p50_s"], 6),
+            "step_time_p99_s": round(tail_summary["step_time_p99_s"], 6),
+            "step_time_mean_s": round(tail_summary["mean_step_time_s"], 6),
+            "host_dispatch_us_mean": round(host_dispatch_us, 1),
+        },
     }
     # serving row: the continuous-batching engine's offered-load numbers
     # next to the training row (tiny-config smoke on either backend — it

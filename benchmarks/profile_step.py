@@ -142,11 +142,17 @@ def host_overhead(steps: int = 30) -> dict:
         timer.tick(m["loss"])
         with timer.input_stall():
             current = next(it, None)
+    summary = timer.summary()
     out = {
         "metric": "train_step_host_overhead",
         "host_dispatch_us_mean": round(timer.host_dispatch_us, 1),
         "input_stall_us_mean": round(timer.input_stall_us, 1),
         "mean_step_time_s": round(timer.mean_step_time, 6),
+        # tail latency from the shared streaming histogram — a p99 far from
+        # the mean means jittery steps (input stalls, recompiles, noisy
+        # neighbors), which a mean-only meter hides
+        "step_time_p50_s": round(summary.get("step_time_p50_s", float("nan")), 6),
+        "step_time_p99_s": round(summary.get("step_time_p99_s", float("nan")), 6),
         "steps_recorded": timer.steps_recorded,
         "pin_tree_computations": step._pin_computations,
         "device": getattr(jax.devices()[0], "device_kind", "cpu").lower(),

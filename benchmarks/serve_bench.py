@@ -32,8 +32,11 @@ import time
 
 def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       max_len: int = 128, prefill_chunk: int = 16,
-                      max_queue: int = 64, seed: int = 0):
-    """A small engine on the named family (tiny config, fresh params)."""
+                      max_queue: int = 64, seed: int = 0,
+                      metrics_port: int | None = None):
+    """A small engine on the named family (tiny config, fresh params).
+    `metrics_port` turns on the engine's Prometheus endpoint (0 binds an
+    ephemeral port, reported on `engine.metrics_server.port`)."""
     import jax
     import jax.numpy as jnp
 
@@ -52,7 +55,8 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
     params = family.init_params(cfg, jax.random.key(seed))
     ec = EngineConfig(num_slots=num_slots, max_len=max_len,
                       prefill_chunk=prefill_chunk, max_queue=max_queue,
-                      cache_dtype=jnp.bfloat16, seed=seed)
+                      cache_dtype=jnp.bfloat16, seed=seed,
+                      metrics_port=metrics_port)
     return Engine(family, cfg, params, ec), cfg
 
 
@@ -127,11 +131,20 @@ def main() -> None:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--deadline-s", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics while the load runs "
+                        "(0 = ephemeral port, printed to stderr)")
     args = p.parse_args()
 
     engine, cfg = build_tiny_engine(
         args.family, num_slots=args.slots, max_len=args.max_len,
-        prefill_chunk=args.prefill_chunk, seed=args.seed)
+        prefill_chunk=args.prefill_chunk, seed=args.seed,
+        metrics_port=args.metrics_port)
+    if engine.metrics_server is not None:
+        import sys
+
+        print(f"serving Prometheus metrics on "
+              f":{engine.metrics_server.port}/metrics", file=sys.stderr)
     summary = run_offered_load(
         engine, cfg.vocab_size, num_requests=args.num_requests,
         rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),

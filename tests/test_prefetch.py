@@ -286,6 +286,28 @@ class TestWarmup:
         assert float(np.asarray(state["w"][0, 0])) == 2.0
         assert step._cache_size() == 1
 
+    def test_alternating_shape_warmups_compile_once_each(self):
+        """Regression (PR 1 review item): the AOT cache kept ONE executable
+        per layout key, so alternating warmups across two batch shapes
+        evicted each other and recompiled every time. Keyed by
+        (layout, batch signature) they must each compile exactly once."""
+        step = _CompiledTrainStep(_make_toy_step(), donate=False)
+        state = _placed_state({"w": jnp.zeros((8, 4))})
+        batch_a, batch_b = jnp.ones((8, 2)), jnp.ones((16, 2))
+        first_a = step.warmup(state, batch_a)
+        first_b = step.warmup(state, batch_b)
+        for _ in range(3):
+            assert step.warmup(state, batch_a) is first_a
+            assert step.warmup(state, batch_b) is first_b
+        assert step._aot_compiles == 2
+        assert len(step._aot) == 2
+        # both warmed shapes dispatch AOT — the jit cache stays cold
+        state, _ = step(state, batch_a)
+        state, _ = step(state, batch_b)
+        state, _ = step(state, batch_a)
+        assert step._cache_size() == 0
+        assert float(np.asarray(state["w"][0, 0])) == 3.0
+
 
 # ---------------------------------------------------------------------------
 # persistent compilation cache

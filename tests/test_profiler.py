@@ -54,8 +54,8 @@ def test_step_timer_host_overhead_metrics():
             pass
         timer.tick()
     # warmup excluded: first iteration's readings (seen < warmup) dropped
-    assert len(timer._dispatch_times) == 3
-    assert len(timer._stall_times) == 3
+    assert timer._dispatch_hist.count == 3
+    assert timer._stall_hist.count == 3
     assert timer.host_dispatch_us >= 0
     assert timer.input_stall_us >= 0
     summary = timer.summary()
@@ -73,7 +73,7 @@ def test_step_timer_host_overhead_empty_is_nan():
 def test_mfu_math():
     timer = StepTimer(flops_per_step=1e12, peak_flops=1e13, num_chips=1,
                       warmup_steps=0)
-    timer._times = [0.5]  # 2e12 FLOPs/s achieved vs 1e13 peak
+    timer._step_hist.record(0.5)  # 2e12 FLOPs/s achieved vs 1e13 peak
     assert timer.mfu() == pytest.approx(0.2)
 
 

@@ -444,6 +444,69 @@ def test_metrics_flow_into_tracker(gpt2_setup, tmp_path):
     assert logged and any("tokens_out" in ln for ln in logged)
 
 
+def test_engine_prometheus_endpoint_serves_serving_series(gpt2_setup):
+    """Acceptance (ISSUE 3): an engine with the exporter enabled serves a
+    Prometheus exposition containing TTFT / queue-depth / tokens-per-sec
+    series. Port 0 = ephemeral, so tier-1 never collides on ports."""
+    import urllib.request
+
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, metrics_port=0)
+    try:
+        assert eng.metrics_server is not None
+        rng = np.random.default_rng(21)
+        for _ in range(3):
+            eng.submit(_prompt(rng, 6, cfg.vocab_size), max_new_tokens=4)
+        eng.run_until_idle()
+        url = f"http://127.0.0.1:{eng.metrics_server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        for series in ("serving_ttft_seconds", "serving_queue_depth",
+                       "serving_tokens_per_sec",
+                       "serving_tokens_out_total",
+                       "serving_step_dispatch_seconds"):
+            assert series in body, f"{series} missing from exposition"
+        # counters carry the finished run's values, not just zeros
+        assert "serving_requests_finished_total 3.0" in body
+        assert "serving_tokens_out_total 12.0" in body
+    finally:
+        eng.close()
+
+
+def test_engine_step_ticks_watchdog(gpt2_setup):
+    """The serving loop arms the stall watchdog: every step() heartbeats,
+    so a live engine never fires; the report machinery is exercised by a
+    manual check after silence (fake silence via a huge negative tick)."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, watchdog_timeout_s=3600.0)
+    try:
+        assert eng.watchdog is not None
+        rng = np.random.default_rng(22)
+        eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=3)
+        eng.run_until_idle()
+        assert eng.watchdog.check() is None  # just ticked: silent
+        eng.watchdog._last_tick -= 7200.0    # simulate 2h of silence
+        report = eng.watchdog.check()
+        assert report is not None and report["stall_count"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_reset_metrics_keeps_registry_and_exporter_live(gpt2_setup):
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params)
+    rng = np.random.default_rng(23)
+    eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=3)
+    eng.run_until_idle()
+    registry = eng.registry
+    assert eng.metrics.tokens_out == 3
+    eng.reset_metrics()
+    assert eng.registry is registry          # same registry object
+    assert eng.metrics.tokens_out == 0       # zeroed in place
+    eng.submit(_prompt(rng, 4, cfg.vocab_size), max_new_tokens=2)
+    eng.run_until_idle()
+    assert eng.metrics.tokens_out == 2       # fresh window accumulates
+
+
 # ---------------------------------------------------------------------------
 # scheduler unit coverage (no model)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,670 @@
+"""Unified telemetry subsystem (ISSUE 3): registry/histograms, span
+tracing + flight recorder, Prometheus/JSONL export, multi-host
+aggregation, the stall watchdog — and the overhead + collection guards
+that keep instrumentation free when observability is off."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.telemetry import (
+    MetricsRegistry,
+    MetricsServer,
+    StallWatchdog,
+    StreamingHistogram,
+    aggregate_flat,
+    aggregate_snapshot,
+    clear_flight_recorder,
+    configure_tracing,
+    export_chrome_trace,
+    flatten_snapshot,
+    flight_recorder,
+    get_registry,
+    render_prometheus,
+    resolve_metrics_port,
+    span,
+    tracing_enabled,
+)
+from accelerate_tpu.telemetry.watchdog import StallError
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Every test starts and ends with tracing disabled and an empty
+    flight recorder (module-level state must not leak across tests)."""
+    configure_tracing(enabled=False)
+    clear_flight_recorder()
+    yield
+    configure_tracing(enabled=False)
+    clear_flight_recorder()
+
+
+# ---------------------------------------------------------------------------
+# streaming histogram (the shared quantile helper)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingHistogram:
+    def test_quantile_parity_with_numpy_percentile(self):
+        """Satellite: the shared histogram must agree with numpy.percentile
+        on known data within its declared relative accuracy."""
+        rng = np.random.default_rng(0)
+        for data in (
+            rng.lognormal(0.0, 1.5, 20_000),          # latency-shaped
+            rng.uniform(0.001, 10.0, 20_000),
+            np.arange(1, 5001).astype(float),
+        ):
+            h = StreamingHistogram(relative_accuracy=0.01)
+            for v in data:
+                h.record(v)
+            for q in (50, 90, 99):
+                exact = float(np.percentile(data, q))
+                approx = h.quantile(q / 100)
+                # nearest-rank + log buckets: 3x the sketch accuracy is a
+                # safe deterministic bound
+                assert abs(approx - exact) / exact < 0.03, (q, approx, exact)
+
+    def test_exact_count_sum_mean_min_max(self):
+        h = StreamingHistogram()
+        data = [0.1, 0.2, 0.4, 0.8]
+        for v in data:
+            h.record(v)
+        assert h.count == 4
+        assert h.sum == pytest.approx(sum(data))
+        assert h.mean == pytest.approx(sum(data) / 4)
+        assert h.min == pytest.approx(0.1)
+        assert h.max == pytest.approx(0.8)
+
+    def test_empty_and_zero_values(self):
+        h = StreamingHistogram()
+        assert math.isnan(h.quantile(0.5)) and math.isnan(h.mean)
+        h.record(0.0)
+        h.record(0.0)
+        h.record(1.0)
+        assert h.quantile(0.5) == 0.0
+        assert h.quantile(1.0) == pytest.approx(1.0, rel=0.02)
+
+    def test_bounded_memory_collapses_low_buckets(self):
+        h = StreamingHistogram(relative_accuracy=0.01, max_buckets=512)
+        rng = np.random.default_rng(1)
+        data = rng.lognormal(0.0, 1.5, 50_000)
+        for v in data:
+            h.record(v)
+        assert len(h._buckets) <= 512
+        # collapsing the LOWEST buckets keeps tail accuracy: p50/p99 sit
+        # far above the collapsed bottom of the range
+        for q in (50, 99):
+            exact = float(np.percentile(data, q))
+            assert abs(h.quantile(q / 100) - exact) / exact < 0.05
+
+    def test_merge_equals_combined_stream(self):
+        rng = np.random.default_rng(2)
+        a_data, b_data = rng.lognormal(0, 1, 5000), rng.lognormal(1, 1, 5000)
+        a, b, both = (StreamingHistogram() for _ in range(3))
+        for v in a_data:
+            a.record(v)
+            both.record(v)
+        for v in b_data:
+            b.record(v)
+            both.record(v)
+        a.merge(b)
+        assert a.count == both.count
+        assert a.sum == pytest.approx(both.sum)
+        for q in (0.5, 0.99):
+            assert a.quantile(q) == pytest.approx(both.quantile(q), rel=0.03)
+
+    def test_roundtrip_through_dict(self):
+        h = StreamingHistogram()
+        for v in (0.5, 1.5, 2.5):
+            h.record(v)
+        h2 = StreamingHistogram.from_dict(
+            json.loads(json.dumps(h.to_dict())))
+        assert h2.count == 3 and h2.sum == pytest.approx(4.5)
+        assert h2.quantile(0.5) == pytest.approx(h.quantile(0.5))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_get_or_create_and_labels(self):
+        r = MetricsRegistry()
+        assert r.counter("req_total", host="0") is r.counter("req_total", host="0")
+        assert r.counter("req_total", host="0") is not r.counter("req_total", host="1")
+        r.counter("req_total", host="0").inc(3)
+        r.gauge("depth").set(7)
+        r.histogram("lat_s").record(0.25)
+        snap = r.snapshot()
+        assert snap["counters"]['req_total{host="0"}'] == 3.0
+        assert snap["gauges"]["depth"] == 7.0
+        assert snap["histograms"]["lat_s"]["count"] == 1.0
+
+    def test_counter_rejects_negative(self):
+        with pytest.raises(ValueError):
+            MetricsRegistry().counter("c").inc(-1)
+
+    def test_gauge_set_max_is_high_water(self):
+        g = MetricsRegistry().gauge("hbm")
+        g.set_max(10)
+        g.set_max(5)
+        assert g.value == 10
+
+    def test_reset_zeroes_in_place(self):
+        r = MetricsRegistry()
+        c, h = r.counter("c"), r.histogram("h")
+        c.inc(5)
+        h.record(1.0)
+        r.reset()
+        # same objects (cached handles + exporter stay live), zeroed
+        assert r.counter("c") is c and c.value == 0
+        assert r.histogram("h") is h and h.count == 0
+
+    def test_flatten_snapshot(self):
+        r = MetricsRegistry()
+        r.counter("tok").inc(2)
+        r.histogram("lat").record(0.5)
+        flat = flatten_snapshot(r.snapshot(), prefix="t/")
+        assert flat["t/tok"] == 2.0
+        assert flat["t/lat_count"] == 1.0 and "t/lat_p99" in flat
+
+    def test_concurrent_increments_are_exact(self):
+        r = MetricsRegistry()
+        c = r.counter("n")
+
+        def work():
+            for _ in range(10_000):
+                c.inc()
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 40_000
+
+
+# ---------------------------------------------------------------------------
+# span tracing + flight recorder + chrome export
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_disabled_span_is_shared_noop(self):
+        assert not tracing_enabled()
+        s1, s2 = span("a"), span("b", big="attr")
+        assert s1 is s2  # the shared null span: no allocation per call
+        with s1:
+            pass
+        assert flight_recorder() == []
+
+    def test_nested_spans_record_ids_and_attrs(self):
+        configure_tracing(enabled=True, annotate=False)
+        with span("outer", phase="train"):
+            with span("inner"):
+                time.sleep(0.001)
+        events = flight_recorder()
+        assert [e["name"] for e in events] == ["inner", "outer"]
+        inner, outer = events
+        assert inner.get("parent_id") == outer["span_id"]
+        assert inner["trace_id"] == outer["trace_id"]
+        assert outer["attrs"] == {"phase": "train"}
+        assert inner["dur_ns"] >= 1_000_000  # the sleep is inside it
+        assert outer["dur_ns"] >= inner["dur_ns"]
+
+    def test_sibling_roots_get_distinct_traces(self):
+        configure_tracing(enabled=True, annotate=False)
+        with span("a"):
+            pass
+        with span("b"):
+            pass
+        a, b = flight_recorder()
+        assert a["trace_id"] != b["trace_id"]
+        assert a["parent_id"] == 0 and b["parent_id"] == 0
+
+    def test_ring_buffer_is_bounded(self):
+        configure_tracing(enabled=True, ring_size=8, annotate=False)
+        for i in range(50):
+            with span(f"s{i}"):
+                pass
+        events = flight_recorder()
+        assert len(events) == 8
+        assert events[-1]["name"] == "s49"
+        configure_tracing(enabled=False, ring_size=4096)
+
+    def test_span_records_on_exception(self):
+        configure_tracing(enabled=True, annotate=False)
+        with pytest.raises(RuntimeError):
+            with span("boom"):
+                raise RuntimeError("x")
+        (e,) = flight_recorder()
+        assert e["error"] == "RuntimeError"
+
+    def test_chrome_trace_export(self, tmp_path):
+        configure_tracing(enabled=True, annotate=False)
+        with span("region", k="v"):
+            pass
+        path = str(tmp_path / "trace.json")
+        doc = export_chrome_trace(path)
+        with open(path) as f:
+            loaded = json.load(f)
+        assert loaded == doc
+        (ev,) = loaded["traceEvents"]
+        assert ev["ph"] == "X" and ev["name"] == "region"
+        assert ev["dur"] >= 0 and ev["args"]["k"] == "v"
+
+    def test_annotation_forwarding_matches_jax_profiler(self):
+        """Enabled spans enter jax.profiler.TraceAnnotation so host spans
+        line up with XLA device traces (smoke: no device capture here)."""
+        configure_tracing(enabled=True, annotate=True)
+        with span("annotated-region"):
+            pass
+        (e,) = flight_recorder()
+        assert e["name"] == "annotated-region"
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = None  # compiled lazily
+
+
+def _parse_exposition(body: str) -> dict[str, float]:
+    """Minimal exposition parser: every non-comment line must be
+    `name[{labels}] value`."""
+    import re
+
+    pat = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(-?[0-9.eE+naNif]+)$')
+    out = {}
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        m = pat.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        out[m.group(1) + (m.group(2) or "")] = m.group(3)
+    return out
+
+
+class TestPrometheusExport:
+    def test_render_types_and_values(self):
+        r = MetricsRegistry()
+        r.counter("tokens_total").inc(12)
+        r.gauge("queue_depth").set(3)
+        h = r.histogram("ttft_seconds")
+        for v in (0.1, 0.2, 0.3):
+            h.record(v)
+        body = render_prometheus(r)
+        assert "# TYPE tokens_total counter" in body
+        assert "# TYPE queue_depth gauge" in body
+        assert "# TYPE ttft_seconds summary" in body
+        series = _parse_exposition(body)
+        assert float(series["tokens_total"]) == 12.0
+        assert float(series["ttft_seconds_count"]) == 3.0
+        assert float(series['ttft_seconds{quantile="0.99"}']) > 0
+
+    def test_label_escaping_and_name_sanitizing(self):
+        r = MetricsRegistry()
+        r.counter("weird-name.total", path='a"b\\c').inc()
+        body = render_prometheus(r)
+        assert "weird_name_total" in body
+        assert '\\"b' in body
+
+    def test_http_endpoint_serves_parseable_exposition(self):
+        """Satellite: bind port 0 (no fixed ports), GET /metrics, parse."""
+        r = MetricsRegistry()
+        r.counter("up_total").inc()
+        r.histogram("lat_seconds").record(0.05)
+        server = MetricsServer(registry=r, port=0, host="127.0.0.1").start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            resp = urllib.request.urlopen(url, timeout=5)
+            assert resp.status == 200
+            assert "text/plain" in resp.headers["Content-Type"]
+            series = _parse_exposition(resp.read().decode())
+            assert float(series["up_total"]) == 1.0
+            assert float(series["lat_seconds_count"]) == 1.0
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5)
+        finally:
+            server.stop()
+
+    def test_resolve_metrics_port(self, monkeypatch):
+        monkeypatch.delenv("ACCELERATE_TPU_METRICS_PORT", raising=False)
+        assert resolve_metrics_port(None) is None
+        assert resolve_metrics_port(9100) == 9100
+        monkeypatch.setenv("ACCELERATE_TPU_METRICS_PORT", "0")
+        assert resolve_metrics_port(None) == 0
+        assert resolve_metrics_port(9100) == 9100  # explicit wins
+
+    def test_server_binds_loopback_by_default(self):
+        server = MetricsServer(registry=MetricsRegistry(), port=0)
+        try:
+            assert server._httpd.server_address[0] == "127.0.0.1"
+        finally:
+            server.stop()
+
+    def test_env_port_conflict_degrades_instead_of_crashing(self, monkeypatch):
+        """Second binder of the env-configured port (e.g. an Engine next
+        to an Accelerator) must warn and run without an endpoint, not
+        abort construction; an explicit flag still raises."""
+        from accelerate_tpu.telemetry import start_metrics_server
+
+        first = start_metrics_server(0, registry=MetricsRegistry())
+        try:
+            monkeypatch.setenv("ACCELERATE_TPU_METRICS_PORT",
+                               str(first.port))
+            second = start_metrics_server(None, registry=MetricsRegistry())
+            assert second is None
+            with pytest.raises(OSError):
+                start_metrics_server(first.port, registry=MetricsRegistry())
+        finally:
+            first.stop()
+
+    def test_jsonl_snapshot_writer(self, tmp_path):
+        from accelerate_tpu.telemetry import write_snapshot
+
+        r = MetricsRegistry()
+        r.counter("n").inc(4)
+        path = str(tmp_path / "telemetry.jsonl")
+        write_snapshot(path, r)
+        r.counter("n").inc(1)
+        write_snapshot(path, r)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["n"] for ln in lines] == [4.0, 5.0]
+        assert all("ts" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# multi-host aggregation
+# ---------------------------------------------------------------------------
+
+
+def _host_snapshot(step_times: list[float], tokens: float, hbm: float) -> dict:
+    r = MetricsRegistry()
+    r.counter("tokens_total").inc(tokens)
+    r.gauge("hbm_peak").set(hbm)
+    h = r.histogram("step_time_s")
+    for v in step_times:
+        h.record(v)
+    return r.snapshot(include_sketch=True)
+
+
+class TestAggregation:
+    def test_counters_sum_gauges_reduce_hists_merge(self):
+        fast = _host_snapshot([0.10] * 100, tokens=1000, hbm=5.0)
+        slow = _host_snapshot([0.30] * 100, tokens=1000, hbm=9.0)
+        agg = aggregate_snapshot(snapshots=[fast, slow])
+        assert agg["num_hosts"] == 2
+        assert agg["counters"]["tokens_total"]["sum"] == 2000.0
+        g = agg["gauges"]["hbm_peak"]
+        assert (g["min"], g["max"]) == (5.0, 9.0)
+        assert g["mean"] == pytest.approx(7.0)
+        h = agg["histograms"]["step_time_s"]
+        assert h["count"] == 200.0
+        # the straggler view: the merged distribution spans both hosts,
+        # and slowest_host_mean pins the worst host
+        assert h["slowest_host_mean"] == pytest.approx(0.30, rel=0.02)
+        assert h["p99"] == pytest.approx(0.30, rel=0.03)
+        assert h["mean"] == pytest.approx(0.20, rel=0.02)
+
+    def test_single_host_passthrough_uses_gather(self):
+        r = MetricsRegistry()
+        r.counter("c").inc(2)
+        agg = aggregate_snapshot(registry=r)  # single process: gathers [self]
+        assert agg["num_hosts"] == 1
+        assert agg["counters"]["c"]["sum"] == 2.0
+
+    def test_aggregate_flat_shape(self):
+        snaps = [_host_snapshot([0.1], 10, 1.0),
+                 _host_snapshot([0.2], 20, 2.0)]
+        flat = aggregate_flat(snapshots=snaps, prefix="t/")
+        assert flat["t/num_hosts"] == 2.0
+        assert flat["t/tokens_total"] == 30.0
+        assert flat["t/hbm_peak__max"] == 2.0
+        assert flat["t/step_time_s__slowest_host_mean"] == pytest.approx(0.2, rel=0.02)
+        assert all(isinstance(v, float) for v in flat.values())
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestStallWatchdog:
+    def test_missed_heartbeat_fires_exactly_once_with_payload(self):
+        """Satellite: fake clock — a missed heartbeat fires once with the
+        stack/HBM/flight-recorder payload; ticking keeps it silent."""
+        configure_tracing(enabled=True, annotate=False)
+        with span("last-thing-before-hang"):
+            pass
+        now = [0.0]
+        reports = []
+        wd = StallWatchdog(10.0, clock=lambda: now[0],
+                           on_stall=reports.append)
+        wd.tick()
+        now[0] = 9.0
+        assert wd.check() is None          # within budget: silent
+        now[0] = 11.0
+        report = wd.check()                # fired
+        assert report is not None and len(reports) == 1
+        assert wd.check() is None          # exactly once per stall
+        now[0] = 500.0
+        assert wd.check() is None          # still the same stall
+        # payload: all-thread stacks, device memory stats, recorder tail
+        assert any("test_telemetry" in "".join(stack)
+                   for stack in report["stacks"].values())
+        assert isinstance(report["device_memory_stats"], dict)
+        assert [e["name"] for e in report["flight_recorder"]] == [
+            "last-thing-before-hang"]
+        assert report["silence_s"] == pytest.approx(11.0)
+
+    def test_tick_rearms_for_the_next_stall(self):
+        now = [0.0]
+        wd = StallWatchdog(5.0, clock=lambda: now[0], logger=_SilentLogger())
+        now[0] = 6.0
+        assert wd.check() is not None
+        wd.tick()                          # progress: re-armed
+        now[0] = 8.0
+        assert wd.check() is None
+        now[0] = 12.0
+        assert wd.check() is not None      # second stall fires again
+        assert wd.stall_count == 2
+
+    def test_raise_on_stall(self):
+        now = [0.0]
+        wd = StallWatchdog(1.0, clock=lambda: now[0], raise_on_stall=True,
+                           logger=_SilentLogger())
+        now[0] = 2.0
+        with pytest.raises(StallError):
+            wd.check()
+
+    def test_background_thread_fires_and_stays_silent_when_ticked(self):
+        fired = threading.Event()
+        wd = StallWatchdog(0.1, poll_interval_s=0.02,
+                           on_stall=lambda r: fired.set(),
+                           logger=_SilentLogger())
+        with wd:
+            for _ in range(5):
+                wd.tick()
+                time.sleep(0.02)
+            assert not fired.is_set()      # heartbeats kept it silent
+            assert fired.wait(timeout=5.0)  # then silence fires it
+
+    def test_rejects_nonpositive_timeout(self):
+        with pytest.raises(ValueError):
+            StallWatchdog(0.0)
+
+
+class _SilentLogger:
+    def error(self, *a, **k):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# overhead guards (CI satellite): observability off must stay ~free
+# ---------------------------------------------------------------------------
+
+
+class TestOverheadGuards:
+    N = 20_000
+
+    def _time(self, fn) -> float:
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    def test_disabled_span_cost_bounded(self):
+        """Disabled spans sit on dispatch-path code permanently; their cost
+        must stay within a generous multiple of a plain function call (and
+        an absolute per-iteration ceiling, so tier-1 stays deterministic
+        on slow shared runners)."""
+        assert not tracing_enabled()
+
+        def noop():
+            pass
+
+        def baseline():
+            for _ in range(self.N):
+                noop()
+
+        def with_span():
+            for _ in range(self.N):
+                with span("x"):
+                    pass
+
+        baseline()  # warm both paths
+        with_span()
+        base = min(self._time(baseline) for _ in range(3))
+        spanned = min(self._time(with_span) for _ in range(3))
+        per_iter_us = spanned / self.N * 1e6
+        assert per_iter_us < 50.0, f"disabled span {per_iter_us:.2f}us/iter"
+        assert spanned < max(base, 1e-9) * 100, (spanned, base)
+
+    def test_registry_increment_cost_bounded(self):
+        r = MetricsRegistry()
+        c = r.counter("n")
+        h = r.histogram("h")
+
+        def work():
+            for _ in range(self.N):
+                c.inc()
+                h.record(0.001)
+
+        work()
+        best = min(self._time(work) for _ in range(3))
+        per_iter_us = best / self.N * 1e6
+        assert per_iter_us < 100.0, f"inc+record {per_iter_us:.2f}us/iter"
+
+
+# ---------------------------------------------------------------------------
+# StepTimer on the shared histograms
+# ---------------------------------------------------------------------------
+
+
+class TestStepTimerTelemetry:
+    def test_summary_reports_tail_latency(self):
+        from accelerate_tpu.profiler import StepTimer
+
+        timer = StepTimer(warmup_steps=0)
+        for v in [0.1] * 90 + [1.0] * 10:
+            timer._step_hist.record(v)
+        s = timer.summary()
+        assert s["step_time_p50_s"] == pytest.approx(0.1, rel=0.03)
+        assert s["step_time_p99_s"] == pytest.approx(1.0, rel=0.03)
+        assert s["mean_step_time_s"] == pytest.approx(0.19, rel=0.01)
+
+    def test_registry_backed_timer_publishes_series(self):
+        from accelerate_tpu.profiler import StepTimer
+
+        r = MetricsRegistry()
+        timer = StepTimer(warmup_steps=0, registry=r, name="train")
+        with timer.dispatch():
+            pass
+        timer.tick()
+        timer.tick()
+        snap = r.snapshot()
+        assert snap["histograms"]["train_time_seconds"]["count"] == 1.0
+        assert snap["histograms"]["train_dispatch_seconds"]["count"] == 1.0
+        # the exporter sees the same series
+        assert "train_time_seconds" in render_prometheus(r)
+
+    def test_fresh_timer_does_not_inherit_shared_series(self):
+        """Registry series are shared by name: a NEW StepTimer must be
+        able to start clean (reset) without unregistering the series."""
+        from accelerate_tpu.profiler import StepTimer
+
+        r = MetricsRegistry()
+        warm = StepTimer(warmup_steps=0, registry=r, name="train")
+        warm.tick()
+        warm.tick()
+        assert warm.steps_recorded == 1
+        fresh = StepTimer(warmup_steps=0, registry=r, name="train")
+        fresh.reset()                       # the warmup-window pattern
+        assert fresh.steps_recorded == 0
+        fresh.tick()
+        fresh.tick()
+        assert fresh.steps_recorded == 1    # only its own samples
+        # still the same registered series object for the exporter
+        assert r.histogram("train_time_seconds") is fresh._step_hist
+
+    def test_serving_metrics_percentiles_use_shared_helper(self):
+        """Satellite (dedup): ServingMetrics percentiles come from the
+        shared StreamingHistogram and agree with numpy.percentile."""
+        from accelerate_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        rng = np.random.default_rng(0)
+        samples = rng.lognormal(-3, 0.5, 5000)
+        for v in samples:
+            m.ttft_s.record(float(v))
+        s = m.summary()
+        for q, key in ((50, "ttft_p50_ms"), (99, "ttft_p99_ms")):
+            exact = float(np.percentile(samples, q)) * 1e3
+            assert s[key] == pytest.approx(exact, rel=0.03)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 collection + import guards
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_tests_are_tier1_collected():
+    """The ROADMAP tier-1 command runs `pytest tests/ -m 'not slow'`; this
+    file must be collected by it (mirror of the guard in
+    tests/test_prefetch.py)."""
+    roadmap = os.path.join(os.path.dirname(__file__), os.pardir, "ROADMAP.md")
+    with open(roadmap) as f:
+        text = f.read()
+    assert "-m 'not slow'" in text and "pytest tests/" in text, (
+        "tier-1 command changed; update this guard"
+    )
+
+
+def test_telemetry_imports_without_jax_device_init():
+    """`accelerate_tpu.telemetry` must be importable in collectors/CLI
+    tools without initializing a jax backend (device init is expensive and
+    can hang on a dead TPU tunnel)."""
+    code = (
+        "import accelerate_tpu.telemetry as t\n"
+        "t.get_registry().counter('probe').inc()\n"
+        "assert t.render_prometheus(t.get_registry())\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'telemetry import initialized a jax backend'\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
