@@ -68,6 +68,15 @@ _LAZY = {
     "render_prometheus": ".telemetry",
     "aggregate_snapshot": ".telemetry",
     "StallWatchdog": ".telemetry",
+    "AnalysisViolation": ".analysis",
+    "CollectiveContract": ".analysis",
+    "Finding": ".analysis",
+    "collective_counts": ".analysis",
+    "contract_for": ".analysis",
+    "find_host_transfers": ".analysis",
+    "audit_replication": ".analysis",
+    "lint_paths": ".analysis",
+    "lint_text": ".analysis",
 }
 
 

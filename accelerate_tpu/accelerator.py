@@ -131,7 +131,10 @@ class _CompiledTrainStep:
     training loop pays dispatch only, not trace+compile.
     """
 
-    def __init__(self, step_fn: Callable, donate: bool):
+    def __init__(self, step_fn: Callable, donate: bool,
+                 strict: str | None = None, contract=None,
+                 replication_threshold: int = 1 << 26,
+                 on_finding: Callable | None = None):
         self._step_fn = step_fn
         self._donate = donate
         self._by_layout: dict = {}   # (treedef, leaf shardings) -> jitted
@@ -140,6 +143,18 @@ class _CompiledTrainStep:
         self._pin_computations = 0   # pin-tree builds (cache misses)
         self._aot_compiles = 0       # AOT lower+compile runs (cache misses)
         self._on_dispatch: Callable | None = None  # telemetry hook
+        # strict mode (ISSUE 4): program passes run ONCE per
+        # (layout, batch signature) at trace time — the audit rides the
+        # warmup/AOT path, so the compile it needs is the compile the
+        # dispatch cache keeps; steady-state calls never re-audit
+        self._strict = strict
+        self._contract = contract
+        self._replication_threshold = replication_threshold
+        self._on_finding = on_finding
+        # akey -> None (audited clean/warned) | AnalysisViolation (cached:
+        # re-raised on every later dispatch attempt WITHOUT re-running the
+        # audit, so telemetry counts each finding once)
+        self._audited: dict = {}
 
     def _layout_key(self, state):
         leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -206,6 +221,23 @@ class _CompiledTrainStep:
             # fresh executable (e.g. warming up for an upcoming batch-shape
             # change mid-loop)
             self._last = None
+        if self._strict is not None:
+            # strict-mode program passes over the freshly compiled step:
+            # declared CollectiveContract, host-transfer scan, replication
+            # audit. The once-per-key cache / count-once / warn-survives
+            # semantics live in run_cached_audit, shared with the serving
+            # engine's per-program audit.
+            from .analysis.findings import run_cached_audit
+            from .analysis.program import audit_compiled_step
+
+            run_cached_audit(
+                self._audited, akey, self._strict,
+                lambda: audit_compiled_step(
+                    compiled, state=state, contract=self._contract,
+                    replication_threshold=self._replication_threshold),
+                on_finding=self._on_finding,
+                label="the compiled train step",
+            )
         return compiled
 
     def __call__(self, state, *batch):
@@ -218,7 +250,15 @@ class _CompiledTrainStep:
                 fn, jitted = last[1], last[2]
             else:
                 jitted, key = self._ensure(state)
-                fn = self._aot.get((key, self._batch_sig(batch)), jitted)
+                akey = (key, self._batch_sig(batch))
+                if (self._strict is not None
+                        and self._audited.get(akey, False) is not None):
+                    # not recorded clean: unaudited (trace-time audit rides
+                    # the AOT compile — zero extra compiles) or a cached
+                    # violation warmup re-raises
+                    fn = self.warmup(state, *batch)
+                else:
+                    fn = self._aot.get(akey, jitted)
             try:
                 out = fn(state, *batch)
             except (TypeError, ValueError):
@@ -234,7 +274,15 @@ class _CompiledTrainStep:
                 # may not be signature-visible, e.g. device drift).
                 failed = fn
                 jitted, key = self._ensure(state)
-                fn = self._aot.get((key, self._batch_sig(batch)))
+                akey = (key, self._batch_sig(batch))
+                if (self._strict is not None
+                        and self._audited.get(akey, False) is not None):
+                    # the drifted signature was never audited (or carries a
+                    # cached violation) — the retry must NOT sidestep strict
+                    # mode via the bare jit path
+                    fn = self.warmup(state, *batch)
+                else:
+                    fn = self._aot.get(akey)
                 if fn is None or fn is failed:
                     fn = jitted
                 try:
@@ -289,6 +337,7 @@ class Accelerator:
         kwargs_handlers: list | None = None,
         metrics_port: int | None = None,
         stall_timeout_s: float | None = None,
+        strict: str | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
             project_dir=project_dir
@@ -443,6 +492,13 @@ class Accelerator:
         )
         self.trackers = []
 
+        # validated before the exporter/watchdog threads start: a bad value
+        # must not leak a bound port or a live thread (same ordering as
+        # EngineConfig.strict in serving/engine.py)
+        if strict is not None and strict not in ("warn", "error"):
+            raise ValueError(
+                f"strict must be None, 'warn', or 'error'; got {strict!r}")
+
         # --- telemetry (ISSUE 3): shared registry + opt-in exporter/watchdog
         # The registry is the process-wide default: StepTimer/checkpointing
         # instrumentation lands in the same series the exporter serves.
@@ -462,6 +518,27 @@ class Accelerator:
         self._c_train_steps = self.telemetry.counter(
             "accelerator_train_steps_total")
         self._c_logs = self.telemetry.counter("accelerator_log_calls_total")
+
+        # --- strict mode (ISSUE 4): transfer guard + trace-time program audit
+        # strict="warn" logs implicit device->host transfers and warns on
+        # program-pass findings; strict="error" disallows implicit
+        # device->host transfers (`float(loss)`, `np.asarray(arr)` — jax
+        # raises at the sync site; explicit jax.device_get stays legal) and
+        # raises AnalysisViolation at trace time when a train step's lowered
+        # program violates its declared CollectiveContract / carries host
+        # callbacks. Only the d2h direction is guarded: h2d transfers are
+        # how constants and batches are born. The guard is process-global
+        # jax config; end_training() restores the previous value.
+        self.strict = strict
+        self._prev_transfer_guard = None
+        if strict is not None:
+            self._prev_transfer_guard = getattr(
+                jax.config, "jax_transfer_guard_device_to_host", "allow"
+            ) or "allow"
+            jax.config.update(
+                "jax_transfer_guard_device_to_host",
+                "log" if strict == "warn" else "disallow",
+            )
 
         # checkpoint hooks (ref :2798,:2964)
         self._save_model_state_pre_hook = {}
@@ -917,6 +994,8 @@ class Accelerator:
         has_aux: bool = False,
         max_grad_norm: float | None = None,
         donate: bool = True,
+        contract=None,
+        replication_threshold: int = 1 << 26,
     ) -> Callable:
         """Compile (TrainState, batch) -> (TrainState, metrics): forward,
         backward, 1/k accumulation, clip, optimizer update, loss-scale — one
@@ -926,6 +1005,13 @@ class Accelerator:
         every `gradient_accumulation_steps` calls (micro-step counter lives in
         the state; XLA `cond` gates the apply), so the Python loop stays a
         flat `for batch: state, m = step(state, batch)`.
+
+        `contract` (an `analysis.CollectiveContract`) declares the step's
+        expected collective structure; with `Accelerator(strict=...)` the
+        lowered program is checked against it at trace time — plus a
+        host-transfer scan and a replication audit of state leaves above
+        `replication_threshold` bytes (default 64 MiB). Findings land in the
+        telemetry registry as `analysis_findings_total{rule=...}`.
         """
         k = self.gradient_accumulation_steps
         dtype = self.compute_dtype
@@ -1059,9 +1145,19 @@ class Accelerator:
                 metrics["aux"] = aux
             return new_state, metrics
 
-        step = _CompiledTrainStep(step_fn, donate=donate)
+        step = _CompiledTrainStep(
+            step_fn, donate=donate, strict=self.strict, contract=contract,
+            replication_threshold=replication_threshold,
+            on_finding=self._note_analysis_finding,
+        )
         step._on_dispatch = self._note_train_dispatch
         return step
+
+    def _note_analysis_finding(self, finding) -> None:
+        """Strict-mode findings surface as telemetry series (scrapeable and
+        part of log_telemetry()'s multi-host aggregate)."""
+        self.telemetry.counter(
+            "analysis_findings_total", rule=finding.rule).inc()
 
     def _note_train_dispatch(self) -> None:
         """Per-dispatch telemetry heartbeat: counts the step and feeds the
@@ -1347,6 +1443,13 @@ class Accelerator:
             if self.stall_watchdog is not None:
                 self.stall_watchdog.stop()
                 self.stall_watchdog = None
+            if self._prev_transfer_guard is not None:
+                # strict mode armed the process-global transfer guard;
+                # hand back the value we found
+                jax.config.update(
+                    "jax_transfer_guard_device_to_host",
+                    self._prev_transfer_guard)
+                self._prev_transfer_guard = None
             self.wait_for_everyone()
 
     # --------------------------------------------------------- checkpoints

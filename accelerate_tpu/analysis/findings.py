@@ -1,0 +1,291 @@
+"""Finding model, rule catalog, suppressions, and baselines.
+
+Everything here is dependency-free (no jax, no numpy): `accelerate-tpu
+lint` must run in an environment that has never initialized an accelerator
+backend, and the tier-1 self-lint gate must cost AST time only.
+
+Rule IDs are stable public API (``ATP0xx`` = source passes, ``ATP1xx`` =
+program passes). A rule is never renumbered; retired rules leave a tombstone
+in the catalog so old suppressions/baselines keep parsing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import re
+import warnings
+from typing import Iterable
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "RULES",
+    "AnalysisViolation",
+    "run_cached_audit",
+    "parse_suppressions",
+    "apply_suppressions",
+    "load_baseline",
+    "save_baseline",
+    "baseline_payload",
+    "new_findings",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str          # short kebab-case slug
+    kind: str          # "source" (AST) | "program" (jaxpr/HLO)
+    summary: str       # one line for the catalog / --help
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in [
+        Rule("ATP000", "parse-error", "source",
+             "file could not be parsed (reported as a finding, not a crash)"),
+        Rule("ATP001", "host-sync-item", "source",
+             ".item()/.tolist() inside traced code blocks on the device"),
+        Rule("ATP002", "host-sync-cast", "source",
+             "float()/int()/bool() of a traced value forces a device sync"),
+        Rule("ATP003", "host-transfer-numpy", "source",
+             "np.asarray/np.array of a traced value pulls it to the host"),
+        Rule("ATP004", "print-in-traced", "source",
+             "print() of a runtime value inside traced code (trace-time only "
+             "or a sync; use jax.debug.print)"),
+        Rule("ATP005", "untraced-randomness", "source",
+             "np.random/random inside traced code bakes ONE sample into the "
+             "compiled program"),
+        Rule("ATP006", "traced-control-flow", "source",
+             "Python if/while/for on a traced value (TracerBoolConversion "
+             "at best, silent trace-time constant at worst)"),
+        Rule("ATP007", "recompile-hazard", "source",
+             "jitted function uses an argument in a static position (shape/"
+             "range) without static_argnums/static_argnames"),
+        Rule("ATP008", "donation-aliasing", "source",
+             "pytree literal reaches the same object through multiple paths "
+             "in donation context ('donate the same buffer twice')"),
+        Rule("ATP101", "collective-contract", "program",
+             "lowered program's collective counts violate its declared "
+             "CollectiveContract"),
+        Rule("ATP102", "transfer-in-program", "program",
+             "device_put/host callback/infeed inside a traced program"),
+        Rule("ATP103", "replicated-blowup", "program",
+             "fully-replicated array above the size threshold on a "
+             "multi-device mesh"),
+    ]
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``path``/``line`` point at source for source passes;
+    program passes use a ``<program:name>`` pseudo-path and line 0.
+    ``source`` carries the stripped source line (or a program detail) and is
+    part of the fingerprint, so baselines survive line-number drift."""
+
+    rule: str
+    message: str
+    path: str
+    line: int = 0
+    col: int = 0
+    source: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        path = self.path.replace("\\", "/")
+        base = f"{self.rule}|{path}|{self.source.strip()}"
+        return hashlib.sha1(base.encode("utf-8", "replace")).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: {self.rule} [{RULES[self.rule].name}] {self.message}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["name"] = RULES[self.rule].name
+        d["fingerprint"] = self.fingerprint
+        return d
+
+
+class AnalysisViolation(RuntimeError):
+    """Raised by strict='error' mode / ``CollectiveContract.enforce`` when
+    findings survive. Carries the findings for programmatic handling."""
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = list(findings)
+        lines = "\n".join("  " + f.render() for f in self.findings)
+        super().__init__(
+            f"{len(self.findings)} static-analysis finding(s):\n{lines}"
+        )
+
+
+def run_cached_audit(cache: dict, key, mode: str, audit_fn, *,
+                     on_finding=None, label: str = "program") -> None:
+    """Once-per-key strict-mode audit bookkeeping, shared by
+    ``_CompiledTrainStep`` and the serving ``Engine``.
+
+    ``audit_fn()`` returns a list of :class:`Finding`. Semantics:
+
+    - key already audited clean: no-op.
+    - key cached a violation: the :class:`AnalysisViolation` is re-raised
+      WITHOUT re-running the audit, so ``on_finding`` (the telemetry
+      counter) sees each finding exactly once across caller retries.
+    - findings + ``mode == "error"``: violation cached under ``key`` and
+      raised before the program ever dispatches.
+    - findings + ``mode == "warn"``: counted, warned, cached clean — the
+      same program never re-warns.
+    - ``audit_fn`` itself raises (audit infrastructure failure, not a
+      finding): ``error`` propagates it UNCACHED (a transient failure may
+      heal on retry); ``warn`` logs and caches clean — strict="warn" must
+      never take down a working step.
+    """
+    if key in cache:
+        cached = cache[key]
+        if cached is not None:
+            raise cached
+        return
+    try:
+        findings = audit_fn()
+    except Exception:
+        if mode == "error":
+            raise
+        logging.getLogger(__name__).warning(
+            "strict-mode audit failed; continuing", exc_info=True)
+        cache[key] = None
+        return
+    if not findings:
+        cache[key] = None
+        return
+    if on_finding is not None:
+        for f in findings:
+            on_finding(f)
+    if mode == "error":
+        exc = AnalysisViolation(findings)
+        cache[key] = exc
+        raise exc
+    cache[key] = None
+    warnings.warn(
+        f"strict-mode findings on {label}:\n"
+        + "\n".join("  " + f.render() for f in findings),
+        stacklevel=3,
+    )
+
+
+# --------------------------------------------------------------- suppression
+#
+# Per-line:  any code line ending in `# atp: disable=ATP001,ATP003` (or bare
+#            `# atp: disable`) suppresses those rules on that line.
+# Per-file:  a line whose comment is `# atp: disable-file=ATP004` (or bare
+#            `# atp: disable-file`) suppresses file-wide, wherever it sits
+#            (conventionally near the top).
+#
+# Parsed from raw text lines, not the AST, so suppressions survive syntax
+# errors and never depend on token positions. The directive must END the
+# line: anchoring to $ keeps prose that merely *mentions* the syntax (a
+# doc comment, a string literal with trailing text) from silently
+# suppressing real findings.
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*atp:\s*disable(?P<file>-file)?\s*(?:=\s*(?P<rules>[A-Z0-9,\s]+?))?\s*$"
+)
+
+
+def parse_suppressions(text: str) -> tuple[set[str] | None, dict[int, set[str] | None]]:
+    """Returns ``(file_suppressed, line_suppressed)``.
+
+    ``file_suppressed`` is a set of rule IDs (empty set = none), or ``None``
+    meaning ALL rules are suppressed file-wide. ``line_suppressed`` maps a
+    1-based line number to a rule-ID set (or ``None`` = all rules)."""
+    file_rules: set[str] | None = set()
+    per_line: dict[int, set[str] | None] = {}
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = None
+        if m.group("rules"):
+            rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        if m.group("file"):
+            if rules is None:
+                file_rules = None
+            elif file_rules is not None:
+                file_rules |= rules
+        else:
+            prev = per_line.get(lineno, set())
+            if rules is None or prev is None:
+                per_line[lineno] = None
+            else:
+                per_line[lineno] = prev | rules
+    return file_rules, per_line
+
+
+def apply_suppressions(findings: Iterable[Finding], text: str) -> list[Finding]:
+    file_rules, per_line = parse_suppressions(text)
+    out = []
+    for f in findings:
+        if file_rules is None or f.rule in file_rules:
+            continue
+        line_rules = per_line.get(f.line, set())
+        if line_rules is None or f.rule in (line_rules or set()):
+            continue
+        out.append(f)
+    return out
+
+
+# ------------------------------------------------------------------ baseline
+#
+# A baseline is the accepted-findings ledger for CI: `lint --baseline f.json`
+# only fails on findings NOT in the ledger, so a tree with known debt still
+# gates new debt. Entries are fingerprint-keyed multisets (the same line
+# pattern can legitimately appear twice in one file).
+
+BASELINE_VERSION = 1
+
+
+def baseline_payload(findings: Iterable[Finding]) -> dict:
+    entries: dict[str, dict] = {}
+    for f in findings:
+        e = entries.setdefault(
+            f.fingerprint,
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "source": f.source.strip(), "count": 0},
+        )
+        e["count"] += 1
+        e["line"] = min(e["line"], f.line) or f.line
+    return {"version": BASELINE_VERSION, "findings": entries}
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    with open(path, "w") as fh:
+        json.dump(baseline_payload(findings), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} in {path}"
+        )
+    return data
+
+
+def new_findings(findings: Iterable[Finding], baseline: dict) -> list[Finding]:
+    """Findings beyond the baseline's per-fingerprint counts (order kept)."""
+    budget = {
+        fp: int(e.get("count", 1))
+        for fp, e in baseline.get("findings", {}).items()
+    }
+    fresh = []
+    for f in findings:
+        fp = f.fingerprint
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            fresh.append(f)
+    return fresh

@@ -1,0 +1,148 @@
+"""Lint driver: resolve targets, run source passes, apply suppressions and
+baselines, render human/JSON output.
+
+Pure stdlib — the CLI path must work (and stay fast) with no accelerator
+backend. Program passes are runtime APIs and don't run from here: a path
+on disk has no lowered programs to audit.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+from typing import Iterable
+
+from .findings import (
+    Finding,
+    RULES,
+    apply_suppressions,
+    load_baseline,
+    new_findings,
+)
+from .source import lint_text
+
+__all__ = [
+    "resolve_target",
+    "iter_python_files",
+    "lint_file",
+    "lint_paths",
+    "lint_target",
+    "render_human",
+    "render_json",
+]
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+def resolve_target(target: str) -> str:
+    """A filesystem path, or an importable module/package name resolved to
+    its file/directory WITHOUT executing the module."""
+    if os.path.exists(target):
+        return target
+    if "/" not in target and "\\" not in target:
+        try:
+            spec = importlib.util.find_spec(target)
+        except (ImportError, ModuleNotFoundError, ValueError):
+            spec = None
+        if spec is not None:
+            if spec.submodule_search_locations:
+                return list(spec.submodule_search_locations)[0]
+            if spec.origin and os.path.exists(spec.origin):
+                return spec.origin
+    raise FileNotFoundError(
+        f"lint target {target!r} is neither a path nor an importable module")
+
+
+def iter_python_files(path: str) -> list[str]:
+    if os.path.isfile(path):
+        return [path]
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def _rel(path: str, root: str | None) -> str:
+    if root:
+        try:
+            rel = os.path.relpath(path, root)
+            if not rel.startswith(".."):
+                return rel.replace(os.sep, "/")
+        except ValueError:
+            pass
+    return path.replace(os.sep, "/")
+
+
+def lint_file(path: str, root: str | None = None,
+              rules: set[str] | None = None) -> list[Finding]:
+    """Source passes + suppressions for one file. `root` relativizes paths
+    (stable fingerprints across checkouts); `rules` restricts to a subset
+    of rule IDs (ATP000 parse findings always pass through)."""
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        text = fh.read()
+    findings = lint_text(text, _rel(path, root))
+    findings = apply_suppressions(findings, text)
+    if rules is not None:
+        findings = [f for f in findings
+                    if f.rule in rules or f.rule == "ATP000"]
+    return findings
+
+
+def lint_paths(paths: Iterable[str], root: str | None = None,
+               rules: set[str] | None = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in paths:
+        for f in iter_python_files(path):
+            findings.extend(lint_file(f, root=root, rules=rules))
+    return findings
+
+
+def lint_target(target: str, root: str | None = None,
+                rules: set[str] | None = None,
+                baseline: str | None = None) -> tuple[list[Finding], list[Finding]]:
+    """Full pipeline for one CLI target. Returns ``(all_findings,
+    reportable)`` where ``reportable`` is what should gate (all findings,
+    minus the baseline's accepted ledger when one is given)."""
+    resolved = resolve_target(target)
+    if root is None:
+        base = resolved if os.path.isdir(resolved) else os.path.dirname(resolved)
+        root = os.path.dirname(os.path.abspath(base)) or "."
+    findings = lint_paths([resolved], root=root, rules=rules)
+    reportable = findings
+    if baseline is not None:
+        reportable = new_findings(findings, load_baseline(baseline))
+    return findings, reportable
+
+
+def render_human(findings: list[Finding], total: int | None = None) -> str:
+    lines = [f.render() for f in findings]
+    n = len(findings)
+    if total is not None and total != n:
+        lines.append(
+            f"{n} new finding(s) ({total} total, "
+            f"{total - n} accepted by baseline)")
+    else:
+        lines.append(f"{n} finding(s)")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], total: int | None = None) -> str:
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    payload = {
+        "findings": [f.to_dict() for f in findings],
+        "summary": {
+            "count": len(findings),
+            "total_before_baseline": len(findings) if total is None else total,
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+        "rules": {rid: {"name": r.name, "kind": r.kind, "summary": r.summary}
+                  for rid, r in sorted(RULES.items())},
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,569 @@
+"""AST source passes: find TPU hazards before anything is traced.
+
+No jax import anywhere in this module — `accelerate-tpu lint` runs on a
+machine that cannot initialize a backend, and the tier-1 self-lint gate
+costs parse time only.
+
+The passes work on one module at a time. "Traced code" is discovered
+structurally, not by executing anything:
+
+- functions decorated with a trace transform (`@jax.jit`, `@jit`,
+  `@partial(jax.jit, ...)`, `@jax.vmap`, ...);
+- functions passed BY NAME to a trace transform or control-flow
+  higher-order function in the same module (`jax.jit(f)`, `jax.lax.scan(f,
+  ...)`, `shard_map(f, ...)`, `jax.lax.cond(p, t, f)`), including this
+  repo's own step wrapper (`_CompiledTrainStep(step_fn, ...)`);
+- lambdas passed to any of the above;
+- functions nested inside, or called by name from, traced functions
+  (fixpoint over the module-local call graph).
+
+Within a traced function a lightweight forward taint pass tracks which
+names derive from the function's (non-static) parameters. Shape/dtype
+attribute access (`x.shape`, `x.ndim`, ...), `len()`, `isinstance()` and
+`is`/`is not` comparisons break taint — those are static under jit and
+branching on them is fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .findings import Finding
+
+__all__ = ["lint_source", "lint_text"]
+
+# Bare names that imply a trace transform when called/used as a decorator.
+_TRACE_NAMES = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "shard_map",
+    "remat", "checkpoint", "custom_vjp", "custom_jvp",
+}
+# Attribute tails that imply a trace transform on any value (jax.jit,
+# self.jit is implausible enough to accept).
+_TRACE_ATTRS = _TRACE_NAMES | {"while_loop", "fori_loop", "associative_scan"}
+# Common-word attribute tails that only count when the chain mentions lax.
+_TRACE_ATTRS_NEED_LAX = {"scan", "cond", "switch", "map"}
+# Repo-local wrappers whose first argument is compiled as a step program.
+_EXTRA_TRACE_WRAPPERS = {"_CompiledTrainStep"}
+
+# Attribute reads that are static under jit — accessing them breaks taint.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+                 "sharding", "aval", "weak_type"}
+# Calls whose result is static/host regardless of argument taint.
+_UNTAINT_CALLS = {"len", "isinstance", "type", "id", "repr", "str",
+                  "hasattr", "getattr", "callable"}
+
+_NP_NAMES = {"np", "numpy", "onp"}
+_ARRAY_PULLS = {"asarray", "array", "copy", "ascontiguousarray"}
+_SHAPE_FNS = {"zeros", "ones", "full", "empty", "eye", "arange"}
+_RESHAPE_METHODS = {"reshape", "broadcast_to", "tile"}
+
+
+def _attr_chain(node: ast.AST) -> list[str]:
+    """`jax.lax.scan` -> ["jax", "lax", "scan"]; non-chains -> []."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _is_trace_callable(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _TRACE_NAMES or node.id in _EXTRA_TRACE_WRAPPERS
+    chain = _attr_chain(node)
+    if not chain:
+        return False
+    tail = chain[-1]
+    if tail in _TRACE_ATTRS or tail in _EXTRA_TRACE_WRAPPERS:
+        return True
+    if tail in _TRACE_ATTRS_NEED_LAX:
+        return "lax" in chain[:-1]
+    return False
+
+
+def _is_partial(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id == "partial"
+    chain = _attr_chain(node)
+    return bool(chain) and chain[-1] == "partial"
+
+
+def _static_names(call: ast.Call | None, fn: ast.AST | None) -> set[str]:
+    """Parameter names pinned static by static_argnums/static_argnames (or
+    custom_vjp's nondiff_argnums) on a jit call/decorator — exempt from
+    taint and ATP007."""
+    names: set[str] = set()
+    if call is None:
+        return names
+    params: list[str] = []
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    elif isinstance(fn, ast.Lambda):
+        params = [a.arg for a in fn.args.args]
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "nondiff_argnums"):
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(params):
+                        names.add(params[v.value])
+        elif kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.add(v.value)
+    return names
+
+
+_FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _collect_defs(tree: ast.Module) -> dict[str, list[ast.AST]]:
+    defs: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    return defs
+
+
+def _decorator_trace_call(dec: ast.AST) -> tuple[bool, ast.Call | None]:
+    """(is_traced, jit-call-node-carrying-static-kwargs) for a decorator."""
+    if _is_trace_callable(dec):
+        return True, None
+    if isinstance(dec, ast.Call):
+        if _is_trace_callable(dec.func):
+            return True, dec
+        if _is_partial(dec.func) and dec.args and _is_trace_callable(dec.args[0]):
+            return True, dec
+    return False, None
+
+
+def _find_traced(tree: ast.Module) -> dict[ast.AST, tuple[set[str], bool]]:
+    """Map of traced function/lambda nodes -> (static param names, direct).
+
+    *Direct* functions were explicitly handed to a trace transform
+    (decorator or wrapper call) or nest inside one — their parameters are
+    known tracers, so the full taint-based rule set applies. *Propagated*
+    functions only entered the set through the module-local call graph;
+    their parameters are frequently static Python config (model configs,
+    axis sizes, backend strings), so only taint-free rules run on them."""
+    defs = _collect_defs(tree)
+    traced: dict[ast.AST, tuple[set[str], bool]] = {}
+
+    def mark(node: ast.AST, statics: set[str], direct: bool) -> None:
+        if node not in traced:
+            traced[node] = (set(statics), direct)
+        else:
+            prev_statics, prev_direct = traced[node]
+            traced[node] = (prev_statics | statics, prev_direct or direct)
+
+    # decorators
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                is_traced, call = _decorator_trace_call(dec)
+                if is_traced:
+                    mark(node, _static_names(call, node), True)
+    # wrapper calls: jax.jit(f), jax.lax.scan(f, ...), shard_map(f, ...),
+    # _CompiledTrainStep(step_fn, ...) — any Name argument naming a local
+    # def, and any inline lambda argument
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_trace_callable(node.func)):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                for fn in defs.get(arg.id, []):
+                    mark(fn, _static_names(node, fn), True)
+            elif isinstance(arg, ast.Lambda):
+                mark(arg, _static_names(node, arg), True)
+    # fixpoint: nesting (inherits directness) + call graph (propagated only)
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            _, direct = traced[fn]
+            for inner in ast.walk(fn):
+                if inner is fn:
+                    continue
+                if isinstance(inner, _FunctionNode):
+                    if inner not in traced:
+                        traced[inner] = (set(), direct)
+                        changed = True
+                    elif direct and not traced[inner][1]:
+                        traced[inner] = (traced[inner][0], True)
+                        changed = True
+                elif isinstance(inner, ast.Call) and isinstance(inner.func, ast.Name):
+                    for target in defs.get(inner.func.id, []):
+                        if target not in traced:
+                            traced[target] = (set(), False)
+                            changed = True
+    return traced
+
+
+class _TaintedChecker:
+    def __init__(self, tainted: set[str]):
+        self.tainted = tainted
+
+    def check(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.check(node.value)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.check(node.left) or any(
+                self.check(c) for c in node.comparators
+            )
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) and node.func.id in _UNTAINT_CALLS:
+                return False
+            if isinstance(node.func, ast.Attribute) and node.func.attr in _STATIC_ATTRS:
+                return False
+            return any(self.check(c) for c in ast.iter_child_nodes(node))
+        if isinstance(node, _FunctionNode):
+            return False
+        return any(self.check(c) for c in ast.iter_child_nodes(node))
+
+
+# float is deliberately ABSENT: `x: float` args to a jitted fn are traced
+# weak-typed scalars (loss scale, temperature — the classic branch-on-a-
+# tracer hazards), whereas int/str/bool annotations overwhelmingly mark
+# genuinely-static config (layer counts, mode flags)
+_SCALAR_ANNOTATIONS = {"int", "str", "bool"}
+
+
+def _scalar_params(fn: ast.AST) -> set[str]:
+    """Params whose annotation or default pins them as host scalars/config
+    (str/bool/int constants, `x: int` annotations): static at trace time,
+    so branching on them is fine."""
+    out: set[str] = set()
+    args = fn.args
+    pos = args.posonlyargs + args.args
+    defaults = args.defaults
+    for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+        if isinstance(default, ast.Constant) and isinstance(
+                default.value, (str, bool)):
+            out.add(arg.arg)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(default, ast.Constant) and isinstance(
+                default.value, (str, bool)):
+            out.add(arg.arg)
+    for arg in pos + args.kwonlyargs:
+        ann = arg.annotation
+        if isinstance(ann, ast.Name) and ann.id in _SCALAR_ANNOTATIONS:
+            out.add(arg.arg)
+        elif (isinstance(ann, ast.Constant)
+              and str(ann.value) in _SCALAR_ANNOTATIONS):
+            out.add(arg.arg)
+    return out
+
+
+class _TracedFunctionLinter(ast.NodeVisitor):
+    """Runs the per-rule checks over ONE traced function body.
+
+    ``direct=False`` (functions that entered the traced set only through
+    the call graph) restricts to the taint-free rules (ATP001, ATP005):
+    such functions often take static Python config as parameters and the
+    taint pass would flag legitimate trace-time branching on them."""
+
+    def __init__(self, fn: ast.AST, statics: set[str], path: str,
+                 lines: list[str], findings: list[Finding],
+                 direct: bool = True):
+        self.fn = fn
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self.direct = direct
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            params.append(args.vararg.arg)
+        if args.kwarg:
+            params.append(args.kwarg.arg)
+        self.params = [p for p in params if p not in ("self", "cls")]
+        # declared statics (static_argnums/argnames, nondiff_argnums) are
+        # exempt everywhere; scalar-annotated/defaulted params are exempt
+        # from TAINT only (branching on a config flag is trace-time
+        # legal) — an `n: int` in a shape position without static_argnums
+        # is still exactly the ATP007 hazard
+        self.statics = statics
+        taint_exempt = statics | (
+            _scalar_params(fn) if not isinstance(fn, ast.Lambda) else set())
+        # propagated functions: empty taint kills every taint-gated rule
+        # while the taint-free ones (ATP001/ATP005) still run
+        self.tainted = (set(self.params) - taint_exempt) if direct else set()
+        self.taint = _TaintedChecker(self.tainted)
+
+    # -- helpers -----------------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        src = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            rule=rule, message=message, path=self.path, line=line,
+            col=getattr(node, "col_offset", 0), source=src,
+        ))
+
+    def run(self) -> None:
+        body = self.fn.body if isinstance(self.fn.body, list) else [self.fn.body]
+        for stmt in body:
+            self.visit(stmt)
+
+    # nested defs are traced in their own right (own parameter taint);
+    # don't double-lint their bodies here
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    # -- taint propagation -------------------------------------------------
+    def _bind(self, target: ast.AST, is_tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if is_tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, is_tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, is_tainted)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        t = self.taint.check(node.value)
+        for target in node.targets:
+            self._bind(target, t)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self.taint.check(node.value))
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if self.taint.check(node.value):
+            self._bind(node.target, True)
+
+    # -- control flow (ATP006) ---------------------------------------------
+    def _check_branch(self, node: ast.AST, test: ast.AST, kind: str) -> None:
+        if self.taint.check(test):
+            self._emit(
+                "ATP006", node,
+                f"Python `{kind}` on a value derived from traced arguments "
+                f"({', '.join(sorted(self.tainted & _names_in(test))) or 'traced expr'}); "
+                "under jit this is a TracerBoolConversionError or a silently "
+                "baked trace-time constant — use jax.lax.cond/select.",
+            )
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_branch(node, node.test, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_branch(node, node.test, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_branch(node, node.test, "ternary if")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_branch(node, node.test, "assert")
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range")
+        # `for _ in range(n)` with tainted n is ATP007's recompile/static
+        # story, handled at the range() call below — don't double-report
+        if not is_range and self.taint.check(node.iter):
+            self._emit(
+                "ATP006", node,
+                "Python `for` iterates a traced value; under jit the loop "
+                "unrolls at trace time or fails — use jax.lax.scan/fori_loop.",
+            )
+        self.visit(node.iter)  # range(n) lands in visit_Call (ATP007)
+        # loop targets derive from the iterable
+        self._bind(node.target, self.taint.check(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    # -- calls (ATP001/2/3/4/5/7) ------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # ATP001: .item()/.tolist() — inside traced code this is wrong on
+        # every input kind, taint not required
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist"):
+            self._emit(
+                "ATP001", node,
+                f".{func.attr}() inside traced code blocks on the device "
+                "and breaks tracing; return the array and read it outside "
+                "the compiled function.",
+            )
+        # ATP002: float(x)/int(x)/bool(x) of a traced value
+        if (isinstance(func, ast.Name) and func.id in ("float", "int", "bool")
+                and node.args and self.taint.check(node.args[0])):
+            self._emit(
+                "ATP002", node,
+                f"{func.id}() of a traced value forces a device->host sync "
+                "(or a ConcretizationTypeError); keep it as an array.",
+            )
+        # ATP003: np.asarray/np.array of a traced value
+        if isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            if (chain and chain[0] in _NP_NAMES and chain[-1] in _ARRAY_PULLS
+                    and node.args and self.taint.check(node.args[0])):
+                self._emit(
+                    "ATP003", node,
+                    f"{'.'.join(chain)}() of a traced value pulls it to the "
+                    "host mid-program; use jnp equivalents or move the read "
+                    "outside the compiled function.",
+                )
+            # ATP005: np.random.* (one sample baked at trace time)
+            if len(chain) >= 2 and chain[0] in _NP_NAMES and chain[1] == "random":
+                self._emit(
+                    "ATP005", node,
+                    f"{'.'.join(chain)}() inside traced code runs ONCE at "
+                    "trace time — every execution reuses the same sample; "
+                    "thread a jax.random key instead.",
+                )
+            elif chain and chain[0] == "random" and len(chain) == 2:
+                self._emit(
+                    "ATP005", node,
+                    f"stdlib {'.'.join(chain)}() inside traced code is a "
+                    "trace-time constant; thread a jax.random key instead.",
+                )
+        # ATP004: print of a traced value
+        if isinstance(func, ast.Name) and func.id == "print":
+            if any(self.taint.check(a) for a in node.args):
+                self._emit(
+                    "ATP004", node,
+                    "print() of a traced value shows an abstract tracer at "
+                    "trace time (or forces a sync); use jax.debug.print.",
+                )
+        # ATP007: non-static parameter in a static position
+        self._check_static_position(node)
+        self.generic_visit(node)
+
+    def _param_args(self, args: Iterable[ast.AST]) -> list[str]:
+        hits = []
+        for a in args:
+            if isinstance(a, ast.Name) and a.id in self.params \
+                    and a.id not in self.statics:
+                hits.append(a.id)
+        return hits
+
+    def _check_static_position(self, node: ast.Call) -> None:
+        if not self.direct:
+            return
+        func = node.func
+        hits: list[str] = []
+        where = ""
+        if isinstance(func, ast.Name) and func.id == "range":
+            hits, where = self._param_args(node.args), "range()"
+        elif isinstance(func, ast.Attribute):
+            chain = _attr_chain(func)
+            if (len(chain) >= 2 and chain[0] in _NP_NAMES | {"jnp", "jax"}
+                    and chain[-1] in _SHAPE_FNS and node.args):
+                hits, where = self._param_args(node.args[:1]), f"{chain[-1]}() shape"
+            elif func.attr in _RESHAPE_METHODS:
+                hits, where = self._param_args(node.args), f".{func.attr}() shape"
+        if hits:
+            self._emit(
+                "ATP007", node,
+                f"argument {', '.join(sorted(set(hits)))!s} of this jitted "
+                f"function is used in a static position ({where}) but is not "
+                "in static_argnums/static_argnames: tracing fails — and once "
+                "static, every distinct value recompiles the program.",
+            )
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _lint_donation_aliasing(tree: ast.Module, text: str, path: str,
+                            lines: list[str], findings: list[Finding]) -> None:
+    """ATP008: a pytree literal reaching the same object through two paths,
+    in a module that donates buffers. Donating such a tree hands XLA one
+    buffer twice ('Attempt to donate the same buffer twice' — the PR 1
+    optimizer-aliasing crash class)."""
+    if "donate" not in text:
+        return
+    dicts = [n for n in ast.walk(tree) if isinstance(n, ast.Dict)]
+    nested: set[ast.Dict] = set()
+    for d in dicts:
+        for child in ast.walk(d):
+            if isinstance(child, ast.Dict) and child is not d:
+                nested.add(child)
+    for d in dicts:
+        if d in nested:
+            continue  # audited as part of its outermost literal
+        leaves: dict[str, int] = {}
+
+        def collect(value: ast.AST) -> None:
+            if isinstance(value, (ast.Dict,)):
+                for v in value.values:
+                    if v is not None:
+                        collect(v)
+            elif isinstance(value, (ast.List, ast.Tuple)):
+                for v in value.elts:
+                    collect(v)
+            elif isinstance(value, (ast.Name, ast.Attribute)):
+                chain = _attr_chain(value)
+                if chain:
+                    key = ".".join(chain)
+                    leaves[key] = leaves.get(key, 0) + 1
+
+        collect(d)
+        dups = sorted(k for k, n in leaves.items() if n > 1)
+        if dups:
+            line = d.lineno
+            src = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+            findings.append(Finding(
+                rule="ATP008",
+                message=(
+                    f"pytree literal references {', '.join(dups)} through "
+                    "multiple paths; donating this tree aliases one buffer "
+                    "twice ('donate the same buffer twice'). Copy the leaf "
+                    "(jnp.array(x)) on one path."),
+                path=path, line=line, col=d.col_offset, source=src,
+            ))
+
+
+def lint_text(text: str, path: str = "<string>") -> list[Finding]:
+    """Run every source pass over one module's text. Suppressions are NOT
+    applied here (see runner.lint_file for the full pipeline)."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [Finding(
+            rule="ATP000",
+            message=f"could not parse: {e.msg}",
+            path=path, line=e.lineno or 0, col=e.offset or 0,
+            source=(e.text or "").strip(),
+        )]
+    lines = text.splitlines()
+    findings: list[Finding] = []
+    for fn, (statics, direct) in _find_traced(tree).items():
+        _TracedFunctionLinter(
+            fn, statics, path, lines, findings, direct=direct).run()
+    _lint_donation_aliasing(tree, text, path, lines, findings)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_source(path: str) -> list[Finding]:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        return lint_text(fh.read(), path)

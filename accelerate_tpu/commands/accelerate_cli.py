@@ -18,6 +18,7 @@ SUBCOMMAND_MODULES = [
     "accelerate_tpu.commands.estimate",
     "accelerate_tpu.commands.tpu",
     "accelerate_tpu.commands.cloud",
+    "accelerate_tpu.commands.lint",
 ]
 
 

@@ -1,0 +1,98 @@
+"""`accelerate-tpu lint` — run the source passes over paths or modules.
+
+Exit codes are a stable contract for CI:
+  0  clean (no findings beyond the baseline)
+  1  findings
+  2  internal error (unreadable target, bad baseline, crash)
+
+Imports stay jax-free end to end: lint runs on builders and dev boxes
+that cannot initialize an accelerator backend, and the tier-1 self-lint
+gate calls `run_lint` in-process so the gate costs AST time only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "lint",
+        help="static TPU-hazard analysis over paths or importable modules",
+        description=(
+            "Run the accelerate_tpu.analysis source passes (rules "
+            "ATP001-ATP008) over one or more files, directories, or "
+            "importable module names. See docs/static-analysis.md for the "
+            "rule catalog and `# atp: disable=` suppression syntax."
+        ),
+    )
+    parser.add_argument(
+        "targets", nargs="+",
+        help="files, directories, or importable module names")
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="output format (json is machine-readable and includes the "
+             "rule catalog)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="accepted-findings ledger: only findings NOT in FILE fail")
+    parser.add_argument(
+        "--write-baseline", default=None, metavar="FILE",
+        help="write the current findings to FILE as the new baseline and "
+             "exit 0")
+    parser.add_argument(
+        "--rules", default=None, metavar="IDS",
+        help="comma-separated rule IDs to run (default: all source rules)")
+    parser.add_argument(
+        "--root", default=None,
+        help="directory findings paths are reported relative to "
+             "(default: the target's parent)")
+    parser.set_defaults(func=run_lint)
+
+
+def run_lint(args: argparse.Namespace) -> int:
+    from ..analysis import runner
+    from ..analysis.findings import RULES, save_baseline
+
+    try:
+        rules = None
+        if args.rules:
+            rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+            unknown = rules - set(RULES)
+            if unknown:
+                print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                      file=sys.stderr)
+                return 2
+        all_findings = []
+        reportable = []
+        for target in args.targets:
+            found, report = runner.lint_target(
+                target, root=args.root, rules=rules, baseline=args.baseline)
+            all_findings.extend(found)
+            reportable.extend(report)
+        if args.write_baseline:
+            save_baseline(args.write_baseline, all_findings)
+            print(f"wrote baseline with {len(all_findings)} finding(s) to "
+                  f"{args.write_baseline}")
+            return 0
+        if args.format == "json":
+            print(runner.render_json(reportable, total=len(all_findings)))
+        else:
+            print(runner.render_human(reportable, total=len(all_findings)))
+        return 1 if reportable else 0
+    except BrokenPipeError:
+        raise
+    except Exception as e:  # unreadable target, bad baseline, bugs: exit 2
+        print(f"lint: internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    # `python -m accelerate_tpu.commands.lint ...` must behave exactly like
+    # `accelerate-tpu lint ...` — without this guard the invocation imports
+    # the module and exits 0, which reads as "clean" to any CI wired that way.
+    from .accelerate_cli import main
+
+    sys.exit(main(["lint", *sys.argv[1:]]))

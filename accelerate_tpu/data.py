@@ -83,8 +83,18 @@ def _to_numpy(x: Any) -> Any:
     return x
 
 
-def batch_to_numpy(batch: Any) -> Any:
-    """Convert a host batch (torch tensors / lists / numpy) to numpy leaves."""
+def batch_to_numpy(batch: Any, keep_device_arrays: bool = False) -> Any:
+    """Convert a host batch (torch tensors / lists / numpy) to numpy leaves.
+
+    `keep_device_arrays=True` passes `jax.Array` leaves through untouched:
+    the device-placement path (`make_global_batch`) reshards them
+    device->device, so converting here would force a synchronous
+    device->host pull that immediately gets pushed back (the self-lint
+    ATP003 hazard, in host-code form)."""
+    if keep_device_arrays:
+        return jax.tree_util.tree_map(
+            lambda x: x if isinstance(x, jax.Array) else _to_numpy(x), batch
+        )
     return jax.tree_util.tree_map(_to_numpy, batch)
 
 
@@ -627,6 +637,14 @@ def make_global_batch(batch: Any, mesh=None, batch_axes=BATCH_AXES) -> Any:
     sharded, replicated, dp = _mesh_batch_layout(mesh, tuple(batch_axes))
 
     def _make(x):
+        if isinstance(x, jax.Array) and jax.process_count() == 1:
+            # already on device: reshard device->device (a no-op when the
+            # layout matches) instead of round-tripping through the host.
+            # Multi-host keeps the numpy path — assembling a global array
+            # from per-host locals needs addressable host data.
+            if x.ndim == 0 or x.shape[0] % dp != 0:
+                return jax.device_put(x, replicated)
+            return jax.device_put(x, sharded)
         x = _to_numpy(x)
         if not isinstance(x, np.ndarray):
             return x
@@ -850,7 +868,11 @@ class DataLoaderShard(DataLoaderStateMixin):
         `DevicePrefetchIterator` so its depth (not the host queue's) bounds
         in-flight HBM."""
         with span("data.host_prep"):
-            batch = batch_to_numpy(batch)
+            # device-resident leaves stay on device when they are about to
+            # be placed anyway; pad_batch_to converts the rare uneven tail
+            # itself
+            batch = batch_to_numpy(
+                batch, keep_device_arrays=self.put_on_device)
             n = _batch_size(batch)
             per_host = self.dp_size // jax.process_count()
             remainder = -1

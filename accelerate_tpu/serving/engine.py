@@ -77,6 +77,19 @@ class EngineConfig:
     donate: bool = True
     metrics_port: int | None = None
     watchdog_timeout_s: float | None = None
+    # strict="warn"|"error" audits each engine program ONCE, at its first
+    # use: a mesh-placement check on the argument arrays (params leaked
+    # onto a multi-device mesh -> ATP101, caught at the placement, since
+    # GSPMD-inserted collectives don't exist yet in the lowering) plus the
+    # lowered (pre-XLA, tracing cost only) program text: host-transfer
+    # scan (ATP102) and the program's CollectiveContract over explicit
+    # collectives (a psum snuck into the family forward). `contracts`
+    # maps program name ("admit"/"prefill"/"decode") to an
+    # analysis.CollectiveContract; None = the single-host default (NO
+    # collectives, exhaustively). Findings land in the engine registry as
+    # analysis_findings_total{rule=...}.
+    strict: str | None = None
+    contracts: Any = None
 
 
 def _cache_spec(config) -> tuple[int, int, int]:
@@ -127,6 +140,20 @@ class Engine:
         self._log_every = log_every
         self._last_logged = 0
         self._clock = clock
+
+        # validate config BEFORE any thread/port side effects below — a
+        # bad value must not leak a bound metrics port or a live watchdog
+        if ec.strict is not None and ec.strict not in ("warn", "error"):
+            raise ValueError(
+                f"strict must be None, 'warn', or 'error'; got {ec.strict!r}")
+        self._contracts = ec.contracts
+        if ec.strict is not None and self._contracts is None:
+            from ..analysis.contracts import serving_program_contracts
+
+            self._contracts = serving_program_contracts()
+        # name -> None (audited clean/warned) | AnalysisViolation (cached:
+        # re-raised on every later use without re-counting the findings)
+        self._audited: dict = {}
 
         num_layers, num_kv, head_dim = _cache_spec(config)
         self.cache = SlotKVCache.create(
@@ -345,16 +372,69 @@ class Engine:
         for slot, req in self.scheduler.admissions(now):
             self._run_admit(slot, req)
 
+    def _strict_audit(self, name: str, jitted, args: tuple) -> None:
+        """Strict-mode program passes, once per program, at first use.
+
+        Two layers: (1) a direct mesh-placement check on the argument
+        arrays — an arg spanning >1 device means GSPMD will insert
+        collectives at partitioning time, AFTER the lowering this audit
+        reads, so the 'params leaked onto a mesh' hazard is caught here at
+        the placement itself, not in program text; (2) the lowered text
+        (tracing cost, no XLA compile) — shard_map-explicit collectives
+        and host callbacks ARE visible there, and the program's
+        CollectiveContract is checked against it."""
+        if self.engine_config.strict is None:
+            return
+        from ..analysis.findings import Finding, run_cached_audit
+        from ..analysis.program import find_host_transfers
+
+        pname = f"serving.{name}"
+
+        def audit():
+            findings = []
+            meshed = [
+                leaf for leaf in jax.tree_util.tree_leaves(args)
+                if isinstance(leaf, jax.Array)
+                and len(leaf.sharding.device_set) > 1
+            ]
+            if meshed:
+                ndev = max(len(leaf.sharding.device_set) for leaf in meshed)
+                findings.append(Finding(
+                    rule="ATP101",
+                    message=(
+                        f"{len(meshed)} argument array(s) span {ndev} "
+                        "devices: GSPMD inserts collectives after lowering, "
+                        "invisible to this audit — a single-host engine "
+                        "expects unplaced params (sharded-serving setups "
+                        "must pass their own EngineConfig(contracts=...) "
+                        "and audit compiled HLO)"),
+                    path=f"<program:{pname}>",
+                    source=f"mesh-placed args x{len(meshed)}",
+                ))
+            text = jitted.lower(*args).as_text()
+            findings += find_host_transfers(text, name=pname)
+            contract = (self._contracts or {}).get(name)
+            if contract is not None:
+                findings += contract.check(text)
+            return findings
+
+        run_cached_audit(
+            self._audited, name, self.engine_config.strict, audit,
+            on_finding=lambda f: self.registry.counter(
+                "analysis_findings_total", rule=f.rule).inc(),
+            label=f"engine program {pname!r}",
+        )
+
     def _run_admit(self, slot: Slot, req: Request) -> None:
         key_raw = _as_raw_key(req.key)
         if key_raw is None:
             key_raw = jax.random.key_data(
                 jax.random.fold_in(self._base_key, req.request_id))
+        args = (self.cache, self._slot_keys, self._temps,
+                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature))
+        self._strict_audit("admit", self._admit_p, args)
         with span("serving.admit"):
-            self.cache, self._slot_keys, self._temps = self._admit_p(
-                self.cache, self._slot_keys, self._temps,
-                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
-            )
+            self.cache, self._slot_keys, self._temps = self._admit_p(*args)
 
     def _run_prefill_chunk(self, slot: Slot) -> None:
         chunk = self.engine_config.prefill_chunk
@@ -363,16 +443,18 @@ class Engine:
         real = min(chunk, req.prompt_len - start)
         ids = np.zeros((chunk,), np.int32)
         ids[:real] = req.prompt[start:start + real]
+        args = (self.params, self.cache, self._tokens, self._slot_keys,
+                self._temps, jnp.int32(slot.index), ids, jnp.int32(real))
+        self._strict_audit("prefill", self._prefill_p, args)
         with span("serving.prefill"), self.timer.dispatch():
-            self.cache, self._tokens = self._prefill_p(
-                self.params, self.cache, self._tokens, self._slot_keys,
-                self._temps, jnp.int32(slot.index), ids, jnp.int32(real),
-            )
+            self.cache, self._tokens = self._prefill_p(*args)
         self.metrics.note_prefill_chunk()
         if self.scheduler.note_prefill_chunk(slot, real):
             # the chunk that completed the prompt also produced the
-            # request's first token — fetch it (TTFT is measured here)
-            tok = int(np.asarray(self._tokens)[slot.index])
+            # request's first token — fetch it (TTFT is measured here).
+            # Index on device first: only ONE element crosses to the host,
+            # not the whole [S] token vector (self-lint ATP003 class).
+            tok = int(self._tokens[slot.index])
             if self.scheduler.note_token(slot, tok):
                 self.metrics.observe_request(req)
 
@@ -380,11 +462,11 @@ class Engine:
         live = np.zeros((self.engine_config.num_slots,), bool)
         for s in slots:
             live[s.index] = True
+        args = (self.params, self.cache, self._tokens, self._slot_keys,
+                self._temps, live)
+        self._strict_audit("decode", self._decode_p, args)
         with span("serving.decode"), self.timer.dispatch():
-            self.cache, self._tokens = self._decode_p(
-                self.params, self.cache, self._tokens, self._slot_keys,
-                self._temps, live,
-            )
+            self.cache, self._tokens = self._decode_p(*args)
         toks = np.asarray(self._tokens)  # the per-step host read
         self.timer.tick(block_on=None)
         self.metrics.note_decode_step()

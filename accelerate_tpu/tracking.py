@@ -41,6 +41,24 @@ def _scalarize(v):
     return v
 
 
+def _scalarize_all(values: dict) -> dict:
+    """Batch-scalarize a metrics dict: ALL device-resident 0-d values cross
+    to the host in ONE `jax.device_get` transfer instead of one blocking
+    `.item()` round trip per metric (N syncs per `log()` call was the
+    telemetry hot-path host-sync the self-lint flagged). Host values pass
+    through `_scalarize` unchanged."""
+    device = {
+        k: v for k, v in values.items()
+        if hasattr(v, "item") and getattr(v, "ndim", 1) == 0
+        and hasattr(v, "is_fully_replicated")  # jax.Array, not numpy
+    }
+    if device:
+        import jax
+
+        values = {**values, **jax.device_get(device)}
+    return {k: _scalarize(v) for k, v in values.items()}
+
+
 def on_main_process(function):
     """ref tracking.py:67-84."""
 
@@ -106,7 +124,10 @@ class JSONLTracker(GeneralTracker):
 
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
-        self._write({"event": "log", "step": step, "ts": time.time(), **_jsonable(values)})
+        # batch the top-level device scalars into one transfer; _jsonable
+        # still catches stragglers in nested containers
+        self._write({"event": "log", "step": step, "ts": time.time(),
+                     **_jsonable(_scalarize_all(values))})
 
     def _write(self, obj: dict) -> None:
         self._fh.write(json.dumps(obj) + "\n")
@@ -145,8 +166,7 @@ class TensorBoardTracker(GeneralTracker):
 
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
-        for k, v in values.items():
-            v = _scalarize(v)
+        for k, v in _scalarize_all(values).items():
             if isinstance(v, (int, float)):
                 self.writer.add_scalar(k, v, global_step=step, **kwargs)
             elif isinstance(v, str):
@@ -219,7 +239,7 @@ class MLflowTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
         metrics = {
-            k: v for k, v in ((k, _scalarize(v)) for k, v in values.items())
+            k: v for k, v in _scalarize_all(values).items()
             if isinstance(v, (int, float))
         }
         self._mlflow.log_metrics(metrics, step=step)
@@ -317,8 +337,7 @@ class ClearMLTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
         logger_obj = self.task.get_logger()
-        for k, v in values.items():
-            v = _scalarize(v)
+        for k, v in _scalarize_all(values).items():
             if isinstance(v, (int, float)):
                 logger_obj.report_scalar(title=k, series=k, value=v, iteration=step or 0)
 
@@ -352,8 +371,7 @@ class DVCLiveTracker(GeneralTracker):
     def log(self, values: dict, step: int | None = None, **kwargs) -> None:
         if step is not None:
             self.live.step = step
-        for k, v in values.items():
-            v = _scalarize(v)
+        for k, v in _scalarize_all(values).items():
             if isinstance(v, (int, float)):
                 self.live.log_metric(k, v, **kwargs)
         self.live.next_step()

@@ -1,0 +1,12 @@
+"""ATP001 negative: the read happens OUTSIDE the compiled function."""
+import jax
+
+
+@jax.jit
+def good_step(x):
+    return (x * x).sum()
+
+
+def driver(x):
+    loss = good_step(x)
+    return loss.item()  # host code: fine

@@ -1,0 +1,15 @@
+"""ATP001 positive: .item() inside a jitted function (acceptance fixture)."""
+import jax
+
+
+@jax.jit
+def bad_step(x):
+    loss = (x * x).sum()
+    return loss.item()  # blocks on device, breaks under trace
+
+
+def also_bad(batch):
+    return batch.tolist()
+
+
+wrapped = jax.jit(also_bad)

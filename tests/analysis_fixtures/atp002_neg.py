@@ -1,0 +1,8 @@
+"""ATP002 negative: casts of static values only."""
+import jax
+
+
+@jax.jit
+def good(x, scale: float):
+    n = float(len(x.shape))  # len() of a static attr: host arithmetic
+    return x * scale * n

@@ -1,0 +1,10 @@
+"""ATP002 positive: float()/bool() of a traced value in jitted code."""
+import jax
+
+
+@jax.jit
+def bad(x):
+    y = x.sum()
+    if bool(y > 0):  # noqa — also an ATP006, the cast is the ATP002
+        return float(y)
+    return 0.0

@@ -1,0 +1,10 @@
+"""ATP003 negative: np work on trace-time constants is idiomatic."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good(x):
+    table = jnp.asarray(np.arange(16))  # np on host constants: fine
+    return x + table.sum()

@@ -1,0 +1,9 @@
+"""ATP003 positive: np.asarray of a traced value mid-program."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad(x):
+    host = np.asarray(x)  # pulls the tracer to the host
+    return host.sum()

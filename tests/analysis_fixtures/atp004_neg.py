@@ -1,0 +1,10 @@
+"""ATP004 negative: jax.debug.print and static prints are fine."""
+import jax
+
+
+@jax.jit
+def good(x):
+    y = x * 2
+    jax.debug.print("y = {}", y)
+    print("tracing good()")  # static string: trace-time log, harmless
+    return y

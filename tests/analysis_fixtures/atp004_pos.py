@@ -1,0 +1,9 @@
+"""ATP004 positive: print of a traced value inside jitted code."""
+import jax
+
+
+@jax.jit
+def bad(x):
+    y = x * 2
+    print(y)  # prints an abstract tracer, once, at trace time
+    return y

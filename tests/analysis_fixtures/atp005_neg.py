@@ -1,0 +1,8 @@
+"""ATP005 negative: threaded jax.random key."""
+import jax
+
+
+@jax.jit
+def good_dropout(x, key):
+    mask = jax.random.bernoulli(key, 0.5, x.shape)
+    return x * mask

@@ -1,0 +1,9 @@
+"""ATP005 positive: np.random inside traced code bakes ONE sample."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_dropout(x):
+    mask = np.random.rand(*x.shape) > 0.5  # same mask every call
+    return x * mask

@@ -1,0 +1,12 @@
+"""ATP006 negative: branching on shapes / None-ness / lax.cond."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good(x, mask=None):
+    if mask is not None:  # identity check: static
+        x = x * mask
+    if x.ndim == 2:  # shape attr: static under jit
+        x = x[None]
+    return jax.lax.cond(x.sum() > 0, lambda v: v, lambda v: -v, x)

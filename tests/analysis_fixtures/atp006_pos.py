@@ -1,0 +1,10 @@
+"""ATP006 positive: Python control flow on a traced value."""
+import jax
+
+
+@jax.jit
+def bad(x):
+    s = x.sum()
+    if s > 0:  # TracerBoolConversionError under jit
+        return x
+    return -x

@@ -1,0 +1,13 @@
+"""ATP007 negative: the shape argument is declared static."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(0,))
+def good(n, x):
+    acc = jnp.zeros(n)
+    for _ in range(n):
+        acc = acc + x
+    return acc

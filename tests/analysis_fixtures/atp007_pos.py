@@ -1,0 +1,11 @@
+"""ATP007 positive: shape/range use of a non-static jit argument."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad(n, x):
+    acc = jnp.zeros(n)  # n must be static_argnums to trace
+    for _ in range(n):
+        acc = acc + x
+    return acc

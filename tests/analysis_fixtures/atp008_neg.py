@@ -1,0 +1,9 @@
+"""ATP008 negative: the aliased leaf is copied before donation."""
+import jax
+import jax.numpy as jnp
+
+
+def make_state(w):
+    state = {"params": w, "ema": jnp.array(w)}  # distinct buffers
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+    return step(state)

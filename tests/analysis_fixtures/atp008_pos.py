@@ -1,0 +1,12 @@
+"""ATP008 positive: aliased-donation pytree (acceptance fixture).
+
+The same buffer reachable through two pytree paths makes a donated call
+die with "Attempt to donate the same buffer twice" — the PR 1
+optimizer-state aliasing crash class."""
+import jax
+
+
+def make_state(w):
+    state = {"params": w, "ema": w}  # both paths hit the SAME buffer
+    step = jax.jit(lambda s: s, donate_argnums=(0,))
+    return step(state)

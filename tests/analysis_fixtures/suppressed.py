@@ -1,0 +1,19 @@
+"""Suppression fixture: line-level and file-level `# atp:` markers.
+
+File-level: ATP004 is accepted everywhere in this file.
+"""
+# atp: disable-file=ATP004
+import jax
+
+
+@jax.jit
+def f(x):
+    print(x)  # would be ATP004; suppressed file-wide
+    # deliberate, measured sync; the directive must END its line
+    y = x.sum().item()  # atp: disable=ATP001
+    return y
+
+
+@jax.jit
+def g(x):
+    return x.sum().item()  # NOT suppressed: must still be reported

@@ -24,6 +24,21 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 
+# The suite is XLA-compile-bound (parity tests compile many shard_map /
+# pipeline / serving programs). Point jax's persistent compilation cache at
+# a stable per-checkout dir so repeat runs deserialize instead of
+# recompiling; jax's own >=1s-compile-time threshold keeps the cache small.
+# ACCELERATE_TPU_COMPILATION_CACHE=off disables (the helper honors it).
+from accelerate_tpu.utils.constants import ENV_COMPILATION_CACHE  # noqa: E402
+from accelerate_tpu.utils.environment import configure_compilation_cache  # noqa: E402
+
+os.environ.setdefault(
+    ENV_COMPILATION_CACHE,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".xla_test_cache"),
+)
+configure_compilation_cache()
+
 
 def pytest_collection_modifyitems(config, items):
     """Gate @pytest.mark.slow behind RUN_SLOW=1 (ref testing.py slow

@@ -1,0 +1,738 @@
+"""Static analysis subsystem (ISSUE 4): source passes, program passes,
+contracts, CLI, baselines, and strict mode.
+
+The per-rule fixtures live in tests/analysis_fixtures/ — one known-positive
+and one known-negative file per rule ID, so every rule's firing condition
+AND its non-firing idiom are pinned. The self-lint test is the CI gate: the
+source passes run in-process over accelerate_tpu/ against the checked-in
+baseline (tests/analysis_baseline.json), so any NEW finding fails tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.analysis import (
+    AnalysisViolation,
+    CollectiveContract,
+    RULES,
+    audit_replication,
+    collective_counts,
+    contract_for,
+    find_host_transfers,
+    lint_file,
+    lint_paths,
+    lint_target,
+    lint_text,
+    new_findings,
+    render_human,
+    render_json,
+    save_baseline,
+)
+from accelerate_tpu.commands.accelerate_cli import main as cli_main
+from accelerate_tpu.utils.imports import resolve_shard_map
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TESTS_DIR)
+FIXTURES = os.path.join(TESTS_DIR, "analysis_fixtures")
+BASELINE = os.path.join(TESTS_DIR, "analysis_baseline.json")
+
+ALL_RULE_IDS = [f"ATP00{i}" for i in range(1, 9)]
+
+
+# ---------------------------------------------------------------------------
+# source passes: one positive + one negative fixture per rule
+# ---------------------------------------------------------------------------
+
+
+class TestSourceRules:
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS)
+    def test_positive_fixture_fires(self, rule):
+        path = os.path.join(FIXTURES, f"{rule.lower()}_pos.py")
+        got = {f.rule for f in lint_file(path)}
+        assert rule in got, f"{path} did not produce {rule} (got {got})"
+
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS)
+    def test_negative_fixture_is_clean(self, rule):
+        path = os.path.join(FIXTURES, f"{rule.lower()}_neg.py")
+        found = [f for f in lint_file(path) if f.rule == rule]
+        assert not found, (
+            f"false positive: {path} produced "
+            f"{[f.render() for f in found]}"
+        )
+
+    def test_parse_error_is_a_finding_not_a_crash(self):
+        findings = lint_text("def broken(:\n", "broken.py")
+        assert [f.rule for f in findings] == ["ATP000"]
+
+    def test_rule_catalog_is_stable(self):
+        """Rule IDs are public API: renumbering breaks suppressions and
+        baselines in user trees."""
+        for rid in ALL_RULE_IDS + ["ATP000", "ATP101", "ATP102", "ATP103"]:
+            assert rid in RULES
+        assert RULES["ATP001"].name == "host-sync-item"
+        assert RULES["ATP101"].name == "collective-contract"
+
+    def test_host_code_is_never_linted(self):
+        """The same hazards OUTSIDE traced code are legitimate host idioms."""
+        src = (
+            "import numpy as np\n"
+            "def metrics_loop(history):\n"
+            "    v = history[-1].item()\n"
+            "    arr = np.asarray(history)\n"
+            "    print(arr)\n"
+            "    if v > 0:\n"
+            "        np.random.seed(0)\n"
+            "    return float(v)\n"
+        )
+        assert lint_text(src, "host.py") == []
+
+
+class TestScalarAnnotations:
+    def test_float_annotated_param_stays_tainted(self):
+        """`x: float` on a jitted fn is a traced weak-typed scalar (loss
+        scale, temperature — the classic branch-on-a-tracer hazards);
+        unlike int/str/bool config annotations it must stay tainted."""
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def step(state, loss_scale: float):\n"
+            "    if loss_scale > 0:\n"
+            "        return state\n"
+            "    return state\n"
+        )
+        assert "ATP006" in {f.rule for f in lint_text(src, "t.py")}
+        src_int = src.replace("loss_scale: float", "n_layers: int")
+        assert "ATP006" not in {f.rule for f in lint_text(src_int, "t.py")}
+
+
+class TestSuppression:
+    def test_line_and_file_suppression(self):
+        findings = lint_file(os.path.join(FIXTURES, "suppressed.py"))
+        # file-wide ATP004 gone, line-suppressed ATP001 gone; the
+        # unsuppressed .item() in g() must survive
+        assert [f.rule for f in findings] == ["ATP001"]
+        (f,) = findings
+        assert "item" in f.source
+
+    def test_bare_disable_suppresses_all_rules_on_line(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x.sum().item())  # atp: disable\n"
+        )
+        from accelerate_tpu.analysis import apply_suppressions
+
+        assert apply_suppressions(lint_text(src, "t.py"), src) == []
+
+    def test_prose_mention_of_syntax_does_not_suppress(self):
+        """The directive must END its line: a comment or docstring that
+        merely *documents* `# atp: disable-file` (trailing text) must not
+        silently suppress the whole file."""
+        from accelerate_tpu.analysis import apply_suppressions
+        from accelerate_tpu.analysis.findings import parse_suppressions
+
+        src = (
+            '"""Docs: `# atp: disable-file` suppresses file-wide."""\n'
+            "# the `# atp: disable=ATP001` marker goes at line end\n"
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x.sum().item()\n"
+        )
+        file_rules, per_line = parse_suppressions(src)
+        assert file_rules == set() and per_line == {}
+        assert [f.rule for f in
+                apply_suppressions(lint_text(src, "t.py"), src)] == ["ATP001"]
+        # the suppression module's own documentation must not disarm it
+        import accelerate_tpu.analysis.findings as findings_mod
+
+        with open(findings_mod.__file__) as fh:
+            own_file_rules, _ = parse_suppressions(fh.read())
+        assert own_file_rules == set()
+
+
+class TestBaseline:
+    def test_roundtrip_and_new_finding_detection(self, tmp_path):
+        pos = os.path.join(FIXTURES, "atp001_pos.py")
+        findings = lint_file(pos, root=REPO)
+        assert findings
+        bl = tmp_path / "bl.json"
+        save_baseline(str(bl), findings)
+        data = json.loads(bl.read_text())
+        assert data["version"] == 1
+        # everything accepted -> nothing new
+        assert new_findings(findings, data) == []
+        # one extra occurrence of the same pattern overflows its count
+        assert len(new_findings(findings + findings[:1], data)) == 1
+        # a different rule is always new
+        other = lint_file(os.path.join(FIXTURES, "atp005_pos.py"), root=REPO)
+        assert new_findings(other, data) == other
+
+    def test_fingerprints_survive_line_drift(self):
+        src = "import jax\n@jax.jit\ndef f(x):\n    return x.item()\n"
+        moved = "import jax\n\n\n@jax.jit\ndef f(x):\n    return x.item()\n"
+        (a,) = lint_text(src, "t.py")
+        (b,) = lint_text(moved, "t.py")
+        assert a.line != b.line and a.fingerprint == b.fingerprint
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes 0/1/2, json format, module targets, baseline flags
+# ---------------------------------------------------------------------------
+
+
+class TestLintCLI:
+    def test_findings_exit_1_human(self, capsys):
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp001_pos.py")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "ATP001" in out and "host-sync-item" in out
+
+    def test_clean_exit_0(self, capsys):
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp001_neg.py")])
+        assert rc == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_internal_error_exit_2(self, capsys):
+        rc = cli_main(["lint", "/nonexistent/not_a_module_either"])
+        assert rc == 2
+        assert "internal error" in capsys.readouterr().err
+
+    def test_unknown_rule_exit_2(self, capsys):
+        rc = cli_main(["lint", FIXTURES, "--rules", "ATP999"])
+        assert rc == 2
+
+    def test_json_format_is_machine_readable(self, capsys):
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp008_pos.py"),
+                       "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["summary"]["count"] >= 1
+        assert payload["summary"]["by_rule"].get("ATP008") == 1
+        (f,) = [x for x in payload["findings"] if x["rule"] == "ATP008"]
+        assert f["line"] > 0 and f["fingerprint"]
+        assert payload["rules"]["ATP008"]["name"] == "donation-aliasing"
+
+    def test_module_target_resolution(self, capsys):
+        rc = cli_main(["lint", "accelerate_tpu.analysis"])
+        assert rc == 0
+
+    def test_baseline_workflow(self, tmp_path, capsys):
+        bl = str(tmp_path / "bl.json")
+        rc = cli_main(["lint", FIXTURES, "--root", REPO,
+                       "--write-baseline", bl])
+        assert rc == 0 and os.path.exists(bl)
+        capsys.readouterr()
+        rc = cli_main(["lint", FIXTURES, "--root", REPO, "--baseline", bl])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "accepted by baseline" in out
+
+    def test_rule_selection(self, capsys):
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp002_pos.py"),
+                       "--rules", "ATP006", "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["summary"]["by_rule"]) == {"ATP006"}
+
+    def test_lint_does_not_initialize_a_backend(self):
+        """`accelerate-tpu lint` must run on boxes that cannot init an
+        accelerator backend (same guard as the telemetry import test)."""
+        code = (
+            "from accelerate_tpu.commands.accelerate_cli import main\n"
+            f"rc = main(['lint', {FIXTURES!r}])\n"
+            "assert rc == 1, rc\n"
+            "import sys\n"
+            "if 'jax' in sys.modules:\n"
+            "    from jax._src import xla_bridge\n"
+            "    assert not xla_bridge.backends_are_initialized(), (\n"
+            "        'lint initialized a jax backend')\n"
+        )
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120,
+                             cwd=REPO, stdin=subprocess.DEVNULL)
+        assert out.returncode == 0, out.stderr[-2000:]
+
+    def test_python_m_lint_is_not_a_silent_noop(self):
+        """`python -m accelerate_tpu.commands.lint` must lint, not import-and-
+        exit-0 — a CI job wired that way would otherwise always pass."""
+        out = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.lint",
+             os.path.join(FIXTURES, "atp001_pos.py")],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+            stdin=subprocess.DEVNULL)
+        assert out.returncode == 1, (out.returncode, out.stderr[-2000:])
+        assert "ATP001" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# the CI gates: self-lint + examples false-positive guard
+# ---------------------------------------------------------------------------
+
+
+class TestSelfLint:
+    def test_accelerate_tpu_clean_against_checked_in_baseline(self):
+        """THE tier-1 gate: new findings in accelerate_tpu/ fail CI. Runs
+        in-process (AST only), so the gate is cheap."""
+        t0 = time.monotonic()
+        _, fresh = lint_target(
+            os.path.join(REPO, "accelerate_tpu"), root=REPO,
+            baseline=BASELINE)
+        elapsed = time.monotonic() - t0
+        assert fresh == [], (
+            "NEW static-analysis findings (fix them, suppress with a "
+            "justified `# atp: disable=`, or re-baseline via `accelerate-tpu "
+            "lint accelerate_tpu --root . --write-baseline "
+            "tests/analysis_baseline.json`):\n" + render_human(fresh)
+        )
+        assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s; gate must stay cheap"
+
+    def test_examples_are_clean(self):
+        """False-positive guard: examples/ is idiomatic user code — the
+        linter flagging any of it means a rule is too aggressive."""
+        findings = lint_paths([os.path.join(REPO, "examples")], root=REPO)
+        assert findings == [], render_human(findings)
+
+    def test_render_json_on_empty(self):
+        payload = json.loads(render_json([]))
+        assert payload["summary"]["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# program passes
+# ---------------------------------------------------------------------------
+
+
+def _psum_program():
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("i",))
+    sm = resolve_shard_map()
+    f = sm(lambda x: jax.lax.psum(x, "i"), mesh=mesh,
+           in_specs=P("i"), out_specs=P())
+    return jax.jit(f), jnp.arange(8.0)
+
+
+class TestCollectiveCounts:
+    def test_counts_from_jaxpr(self):
+        fn, x = _psum_program()
+        counts = collective_counts(jax.make_jaxpr(fn)(x))
+        assert counts["all-reduce"] == 1
+
+    def test_counts_from_lowered_stablehlo(self):
+        fn, x = _psum_program()
+        counts = collective_counts(fn.lower(x))
+        assert counts["all-reduce"] >= 1
+
+    def test_counts_from_compiled_hlo_text(self):
+        fn, x = _psum_program()
+        counts = collective_counts(fn.lower(x).compile().as_text())
+        assert counts["all-reduce"] >= 1
+
+    def test_async_pairs_not_double_counted(self):
+        text = ("%ag = all-gather-start(...)\n"
+                "%agd = all-gather-done(...)\n")
+        assert collective_counts(text)["all-gather"] == 1
+
+
+class TestCollectiveContract:
+    def test_undeclared_extra_psum_produces_atp101(self):
+        """Acceptance: an extra psum nothing declared -> its rule ID."""
+        fn, x = _psum_program()
+        contract = CollectiveContract(name="quiet_program", exhaustive=True)
+        findings = contract.check(fn.lower(x).as_text())
+        assert [f.rule for f in findings] == ["ATP101"]
+        assert "all-reduce" in findings[0].message
+        with pytest.raises(AnalysisViolation):
+            contract.enforce(fn.lower(x).as_text())
+
+    def test_exact_forbid_require_clauses(self):
+        counts_text = "all-reduce\nall-gather\nall-gather\n"
+        ok = CollectiveContract(
+            name="ok", exact={"all-gather": 2},
+            require=("all-reduce",), forbid=("collective-permute",))
+        assert ok.check(counts_text) == []
+        bad = CollectiveContract(name="bad", exact={"all-gather": 1})
+        (f,) = bad.check(counts_text)
+        assert "expected exactly 1, got 2" in f.message
+
+    def test_require_group_accepts_alternatives(self):
+        c = CollectiveContract(
+            name="rs", require=(("reduce-scatter", "all-to-all"),))
+        assert c.check("all-to-all\n") == []
+        assert len(c.check("all-reduce\n")) == 1
+
+    def test_non_exhaustive_ignores_undeclared(self):
+        c = CollectiveContract(name="loose", require=("all-reduce",))
+        assert c.check("all-reduce\ncollective-permute\n") == []
+
+    def test_contract_table_resolves_per_flavor(self):
+        native = contract_for("ring_attention.forward", flavor="native")
+        exp = contract_for("ring_attention.forward", flavor="experimental")
+        assert dict(native.exact)["collective-permute"] == 2
+        assert dict(exp.exact)["collective-permute"] == 8
+        assert "all-gather" in native.forbid and "all-gather" in exp.forbid
+        with pytest.raises(KeyError):
+            contract_for("no_such_program")
+
+
+class TestTransferDetector:
+    def test_pure_callback_in_jaxpr(self):
+        def f(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v), jax.ShapeDtypeStruct((4,), jnp.float32), x)
+
+        findings = find_host_transfers(jax.make_jaxpr(f)(jnp.ones(4)),
+                                       name="cb_program")
+        assert [f_.rule for f_ in findings] == ["ATP102"]
+        assert "pure_callback" in findings[0].message
+
+    def test_device_put_in_jaxpr(self):
+        def f(x):
+            return jax.device_put(x) * 2
+
+        findings = find_host_transfers(jax.make_jaxpr(f)(jnp.ones(4)))
+        assert any("device_put" in f_.message for f_ in findings)
+
+    def test_clean_program(self):
+        fn, x = _psum_program()
+        assert find_host_transfers(jax.make_jaxpr(fn)(x)) == []
+
+    def test_hlo_text_callback_targets(self):
+        text = 'custom-call(...), custom_call_target="xla_python_cpu_callback"'
+        (f,) = find_host_transfers(text, name="p")
+        assert f.rule == "ATP102"
+
+
+class TestReplicationAudit:
+    def _mesh(self):
+        return Mesh(np.array(jax.devices()).reshape(8), ("data",))
+
+    def test_replicated_big_leaf_flags(self):
+        mesh = self._mesh()
+        rep = jax.device_put(np.zeros((512, 1024), np.float32),
+                             NamedSharding(mesh, P()))  # 2 MiB replicated
+        (f,) = audit_replication({"w": rep}, threshold_bytes=1 << 20)
+        assert f.rule == "ATP103" and "'w'" in f.message
+
+    def test_sharded_and_small_leaves_pass(self):
+        mesh = self._mesh()
+        sharded = jax.device_put(np.zeros((512, 1024), np.float32),
+                                 NamedSharding(mesh, P("data")))
+        small = jax.device_put(np.zeros((8,), np.float32),
+                               NamedSharding(mesh, P()))
+        assert audit_replication(
+            {"w": sharded, "b": small}, threshold_bytes=1 << 20) == []
+
+    def test_threshold_is_respected(self):
+        mesh = self._mesh()
+        rep = jax.device_put(np.zeros((512, 1024), np.float32),
+                             NamedSharding(mesh, P()))
+        assert audit_replication({"w": rep}, threshold_bytes=1 << 30) == []
+
+
+# ---------------------------------------------------------------------------
+# strict mode: Accelerator + serving engine
+# ---------------------------------------------------------------------------
+
+
+def _loss_fn(p, b):
+    return jnp.mean((b["x"] @ p["w"]) ** 2)
+
+
+def _dp_accelerator(strict):
+    from accelerate_tpu import TrainState
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.utils import MeshConfig
+
+    acc = Accelerator(mesh_config=MeshConfig(axes={"data": 8}), strict=strict)
+    ts = acc.prepare(TrainState.create(
+        apply_fn=None, params={"w": np.ones((16, 16), np.float32)},
+        tx=optax.sgd(1e-2)))
+    loader = acc.prepare([{"x": np.ones((8, 16), np.float32)}])
+    (batch,) = list(loader)
+    return acc, ts, batch
+
+
+class TestStrictMode:
+    def test_error_mode_raises_at_trace_time_on_contract_violation(self):
+        """Acceptance: strict='error' + a train step whose lowered
+        collectives violate its declared contract -> AnalysisViolation
+        before the program ever dispatches."""
+        acc, ts, batch = _dp_accelerator("error")
+        try:
+            step = acc.train_step(_loss_fn, contract=CollectiveContract(
+                name="dp_step", forbid=("all-reduce",)))  # DP MUST all-reduce
+            with pytest.raises(AnalysisViolation, match="ATP101"):
+                step(ts, batch)
+            # a violating program raises on EVERY dispatch, not just #1
+            with pytest.raises(AnalysisViolation):
+                step(ts, batch)
+        finally:
+            acc.end_training()
+
+    def test_error_mode_clean_contract_trains(self):
+        acc, ts, batch = _dp_accelerator("error")
+        try:
+            step = acc.train_step(_loss_fn, contract=CollectiveContract(
+                name="dp_step", require=("all-reduce",)))
+            ts, m = step(ts, batch)
+            assert bool(jax.device_get(jnp.isfinite(m["loss"])))
+        finally:
+            acc.end_training()
+
+    def test_warn_mode_warns_and_counts_findings(self):
+        acc, ts, batch = _dp_accelerator("warn")
+        try:
+            counter = acc.telemetry.counter(
+                "analysis_findings_total", rule="ATP101")
+            before = counter.value
+            step = acc.train_step(_loss_fn, contract=CollectiveContract(
+                name="dp_step", forbid=("all-reduce",)))
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                ts, _ = step(ts, batch)  # runs despite the finding
+            assert any("ATP101" in str(x.message) for x in w)
+            assert counter.value == before + 1
+            # steady state: second call with the same layout never re-audits
+            with warnings.catch_warnings(record=True) as w2:
+                warnings.simplefilter("always")
+                ts, _ = step(ts, batch)
+            assert not any("ATP101" in str(x.message) for x in w2)
+            assert counter.value == before + 1
+        finally:
+            acc.end_training()
+
+    def test_error_mode_counts_findings_once_across_retries(self):
+        """A caller that catches AnalysisViolation and retries must not
+        inflate analysis_findings_total: the violation is cached per
+        (layout, batch-sig) and re-raised without re-running the audit."""
+        acc, ts, batch = _dp_accelerator("error")
+        try:
+            counter = acc.telemetry.counter(
+                "analysis_findings_total", rule="ATP101")
+            before = counter.value
+            step = acc.train_step(_loss_fn, contract=CollectiveContract(
+                name="dp_step", forbid=("all-reduce",)))
+            for _ in range(3):
+                with pytest.raises(AnalysisViolation):
+                    step(ts, batch)
+            assert counter.value == before + 1
+        finally:
+            acc.end_training()
+
+    def test_batch_shape_drift_fallback_still_audits(self):
+        """The identity-fast-path retry (batch shape drifts mid-loop, the
+        stale AOT executable rejects the args) must route the NEW batch
+        signature through the audit, not sidestep strict mode via the
+        bare jit fallback."""
+        from accelerate_tpu.data import make_global_batch
+
+        acc, ts, batch = _dp_accelerator("warn")
+        try:
+            step = acc.train_step(_loss_fn, contract=CollectiveContract(
+                name="dp_step", forbid=("all-reduce",)))
+            with warnings.catch_warnings(record=True) as w1:
+                warnings.simplefilter("always")
+                ts, _ = step(ts, batch)  # audits signature A
+            assert any("ATP101" in str(x.message) for x in w1)
+            batch_b = make_global_batch(
+                {"x": np.ones((16, 16), np.float32)}, acc.mesh)
+            with warnings.catch_warnings(record=True) as w2:
+                warnings.simplefilter("always")
+                # ts is the previous output -> identity fast path -> the
+                # signature-A executable rejects batch B -> fallback
+                ts, _ = step(ts, batch_b)
+            assert any("ATP101" in str(x.message) for x in w2), (
+                "shape-drift fallback bypassed the strict audit")
+        finally:
+            acc.end_training()
+
+    def test_transfer_guard_armed_and_restored(self):
+        from accelerate_tpu.accelerator import Accelerator
+        from accelerate_tpu.utils import MeshConfig
+
+        prev = getattr(jax.config, "jax_transfer_guard_device_to_host",
+                       "allow") or "allow"
+        acc = Accelerator(mesh_config=MeshConfig(axes={"data": 8}),
+                          strict="error")
+        try:
+            assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+        finally:
+            acc.end_training()
+        assert (getattr(jax.config, "jax_transfer_guard_device_to_host")
+                or "allow") == prev
+
+    def test_strict_rejects_bad_value(self):
+        from accelerate_tpu.accelerator import Accelerator
+
+        with pytest.raises(ValueError, match="strict"):
+            Accelerator(strict="yes please")
+
+    def test_strict_rejected_before_metrics_and_watchdog_start(self):
+        """A bad strict value must not leak a bound metrics port or a live
+        watchdog thread (same ordering guarantee as EngineConfig.strict)."""
+        from accelerate_tpu.accelerator import Accelerator
+
+        threads_before = {t.name for t in threading.enumerate()}
+        with pytest.raises(ValueError, match="strict"):
+            Accelerator(metrics_port=0, stall_timeout_s=60, strict="eror")
+        leaked = {t.name for t in threading.enumerate()} - threads_before
+        assert not leaked, f"failed init leaked threads: {leaked}"
+
+    def test_warn_mode_replication_audit_flags_big_replicated_state(self):
+        """The replication auditor reaches strict mode end to end: a DP
+        state whose params exceed the (lowered) threshold is fully
+        replicated by design and must be reported."""
+        acc, ts, batch = _dp_accelerator("warn")
+        try:
+            step = acc.train_step(_loss_fn, replication_threshold=256)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                step(ts, batch)
+            assert any("ATP103" in str(x.message) for x in w)
+        finally:
+            acc.end_training()
+
+
+class TestServingStrict:
+    def _engine(self, **kw):
+        from accelerate_tpu.models import gpt2
+        from accelerate_tpu.serving.engine import Engine, EngineConfig
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.key(0))
+        return Engine(gpt2, cfg, params, EngineConfig(
+            num_slots=2, max_len=64, prefill_chunk=8, **kw))
+
+    def test_default_contracts_pass_on_clean_engine(self):
+        eng = self._engine(strict="error")
+        try:
+            req = eng.submit(np.arange(5), max_new_tokens=3)
+            eng.run_until_idle()
+            assert len(req.tokens) == 3
+            # every program audited, all recorded clean (None)
+            assert eng._audited == {
+                "admit": None, "prefill": None, "decode": None}
+            snap = eng.registry.snapshot()
+            assert not any("analysis_findings" in k
+                           for k in snap["counters"])
+        finally:
+            eng.close()
+
+    def test_violating_contract_raises_in_error_mode(self):
+        eng = self._engine(
+            strict="error",
+            contracts={"prefill": CollectiveContract(
+                name="serving.prefill", require=("all-reduce",))})
+        try:
+            eng.submit(np.arange(5), max_new_tokens=2)
+            with pytest.raises(AnalysisViolation, match="ATP101"):
+                eng.run_until_idle()
+        finally:
+            eng.close()
+
+    def test_invalid_strict_rejected_before_side_effects(self):
+        """A bad strict value must raise BEFORE the metrics port binds or
+        the watchdog thread starts — nothing to leak on a failed init."""
+        import threading
+
+        from accelerate_tpu.models import gpt2
+        from accelerate_tpu.serving.engine import Engine, EngineConfig
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.key(0))
+        threads_before = {t.name for t in threading.enumerate()}
+        with pytest.raises(ValueError, match="strict"):
+            Engine(gpt2, cfg, params, EngineConfig(
+                num_slots=2, max_len=64, prefill_chunk=8,
+                metrics_port=0, watchdog_timeout_s=60, strict="eror"))
+        leaked = {t.name for t in threading.enumerate()} - threads_before
+        assert not leaked, f"failed init leaked threads: {leaked}"
+
+    def test_warn_mode_survives_audit_infrastructure_failure(self, monkeypatch):
+        """strict='warn' promises 'warn and keep going': a crash in the
+        audit machinery itself (not a finding) must not take down a
+        serving step — same guarantee as the Accelerator's warn mode."""
+        from accelerate_tpu.analysis import program as program_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("audit infrastructure down")
+
+        monkeypatch.setattr(program_mod, "find_host_transfers", boom)
+        eng = self._engine(strict="warn")
+        try:
+            req = eng.submit(np.arange(5), max_new_tokens=3)
+            eng.run_until_idle()
+            assert len(req.tokens) == 3
+        finally:
+            eng.close()
+
+    def test_error_mode_counts_findings_once_across_retries(self):
+        eng = self._engine(
+            strict="error",
+            contracts={"prefill": CollectiveContract(
+                name="serving.prefill", require=("all-reduce",))})
+        try:
+            eng.submit(np.arange(5), max_new_tokens=2)
+            # every step() retries the same pending prefill: each attempt
+            # re-raises the cached violation, the finding counts ONCE
+            for _ in range(3):
+                with pytest.raises(AnalysisViolation, match="ATP101"):
+                    eng.step()
+            snap = eng.registry.snapshot()
+            assert snap["counters"][
+                'analysis_findings_total{rule="ATP101"}'] == 1.0
+        finally:
+            eng.close()
+
+    def test_mesh_placed_params_flagged(self):
+        """'Params leaked onto a mesh': GSPMD inserts its collectives
+        after the lowering the audit reads, so multi-device argument
+        placement is caught directly at the placement."""
+        from accelerate_tpu.models import gpt2
+        from accelerate_tpu.serving.engine import Engine, EngineConfig
+
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.key(0))
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+        params = jax.device_put(
+            params, NamedSharding(mesh, P()))  # replicated over 8 devices
+        eng = Engine(gpt2, cfg, params, EngineConfig(
+            num_slots=2, max_len=64, prefill_chunk=8, strict="error"))
+        try:
+            with pytest.raises(AnalysisViolation, match="devices"):
+                eng.submit(np.arange(5), max_new_tokens=2)
+                eng.run_until_idle()
+        finally:
+            eng.close()
+
+    def test_violating_contract_warns_and_counts_in_warn_mode(self):
+        eng = self._engine(
+            strict="warn",
+            contracts={"decode": CollectiveContract(
+                name="serving.decode", require=("all-gather",))})
+        try:
+            req = eng.submit(np.arange(5), max_new_tokens=2)
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                eng.run_until_idle()
+            assert any("ATP101" in str(x.message) for x in w)
+            assert req.tokens  # engine kept serving
+            snap = eng.registry.snapshot()
+            assert snap["counters"][
+                'analysis_findings_total{rule="ATP101"}'] == 1.0
+        finally:
+            eng.close()

@@ -27,18 +27,16 @@ to program text instead of accuracy):
    no recompile.
 
 Note: XLA's CPU backend lowers reduce-scatter to all-to-all(+local reduce)
-in optimized HLO, so the reduce-scatter assertions accept either spelling.
+in optimized HLO, so the reduce-scatter clauses accept either spelling
+(`require` groups).
 
-The exact collective-permute pins are per shard_map lowering
-(`has_native_shard_map`): the modern top-level `jax.shard_map` CSEs the
-rotation permutes (2/8/2 for ring fwd / ring bwd / pipeline), the 0.4.x
-experimental lowering duplicates them across unrolled+transposed bodies
-(8/28/6, measured on jax 0.4.37). Both pins guard against silent
-rewrites on their line; the no-gather structure is asserted on both.
+Contracts are `accelerate_tpu.analysis.CollectiveContract`s (ISSUE 4).
+The per-shard_map-lowering collective-permute pins (native CSE'd vs 0.4.x
+experimental duplicated bodies) live in ONE table —
+`analysis.contracts._SHARD_MAP_TABLE` — resolved per running jax by
+`contract_for`; the scattered `has_native_shard_map()` branches this file
+used to carry are gone.
 """
-
-import re
-from collections import Counter
 
 import jax
 import jax.numpy as jnp
@@ -49,20 +47,14 @@ from jax.sharding import Mesh
 
 from accelerate_tpu import TrainState
 from accelerate_tpu.accelerator import Accelerator
+from accelerate_tpu.analysis import (
+    CollectiveContract,
+    collective_counts,
+    contract_for,
+)
 from accelerate_tpu.models import llama
 from accelerate_tpu.utils import MeshConfig
 from accelerate_tpu.utils.dataclasses import DeepSpeedPlugin
-from accelerate_tpu.utils.imports import has_native_shard_map
-
-_NATIVE_SM = has_native_shard_map()
-
-_COLLECTIVE = re.compile(
-    r"(all-gather|reduce-scatter|all-reduce|collective-permute|all-to-all)\b"
-)
-
-
-def collective_counts(hlo_text: str) -> Counter:
-    return Counter(m.group(1) for m in _COLLECTIVE.finditer(hlo_text))
 
 
 def _zero_step_and_batch(
@@ -87,38 +79,33 @@ def _zero_step_and_batch(
 
 
 class TestZeroCollectiveStructure:
+    # params sharded on fsdp: fwd+bwd must materialize them via all-gather,
+    # and grads must come back SHARDED (reduce-scatter, spelled all-to-all
+    # + local reduce by the CPU partitioner), never as a replicated
+    # all-reduce-only step
+    ZERO3_FWD_BWD = CollectiveContract(
+        name="zero3.fwd_bwd",
+        at_least={"all-gather": 1},
+        require=(("reduce-scatter", "all-to-all"),),
+    )
+    # ZeRO-1 params are replicated: an all-gather in fwd+bwd means the
+    # planner sharded them; grads must all-reduce across the data shards
+    ZERO1_FWD_BWD = CollectiveContract(
+        name="zero1.fwd_bwd",
+        forbid=("all-gather", "all-to-all"),
+        at_least={"all-reduce": 1},
+    )
+
     def test_zero3_gathers_params_and_scatters_grads(self):
         _, ts, batch, step, grad_only = _zero_step_and_batch(3)
-        fwd_bwd = collective_counts(
+        self.ZERO3_FWD_BWD.enforce(
             grad_only.lower(ts.params, batch).compile().as_text()
-        )
-        # params sharded on fsdp: the forward/backward must materialize
-        # them via all-gather ...
-        assert fwd_bwd["all-gather"] > 0, (
-            "ZeRO-3 fwd+bwd has no all-gather: params are not actually "
-            f"sharded (collectives: {dict(fwd_bwd)})"
-        )
-        # ... and grads must come back sharded (reduce-scatter; the CPU
-        # partitioner spells it all-to-all + local reduce), NOT as a
-        # replicated all-reduce-only step.
-        assert fwd_bwd["reduce-scatter"] + fwd_bwd["all-to-all"] > 0, (
-            "ZeRO-3 fwd+bwd grad sync degenerated to replicated "
-            f"all-reduce (collectives: {dict(fwd_bwd)})"
         )
 
     def test_zero1_fwd_bwd_never_gathers_params(self):
         _, ts, batch, step, grad_only = _zero_step_and_batch(1)
-        fwd_bwd = collective_counts(
+        self.ZERO1_FWD_BWD.enforce(
             grad_only.lower(ts.params, batch).compile().as_text()
-        )
-        assert fwd_bwd["all-gather"] == 0, (
-            "ZeRO-1 params are replicated; an all-gather in fwd+bwd means "
-            f"the planner sharded them (collectives: {dict(fwd_bwd)})"
-        )
-        assert fwd_bwd["all-to-all"] == 0, dict(fwd_bwd)
-        assert fwd_bwd["all-reduce"] > 0, (
-            "ZeRO-1 fwd+bwd must all-reduce grads across the data shards "
-            f"(collectives: {dict(fwd_bwd)})"
         )
 
     def test_zero1_update_shards_moments(self):
@@ -143,15 +130,13 @@ class TestZeroCollectiveStructure:
             "ZeRO-1 optimizer moments are fully replicated — the stage "
             "degenerated to DDP"
         )
-        full = collective_counts(step.lower(ts, batch).compile().as_text())
-        assert full["all-gather"] > 0, (
-            "ZeRO-1 full step should all-gather the param delta from "
-            f"moment shards (collectives: {dict(full)})"
-        )
-        assert full["reduce-scatter"] + full["all-to-all"] > 0, (
-            "ZeRO-1 full step should reduce-scatter grads into moment "
-            f"shards (collectives: {dict(full)})"
-        )
+        # the update path reduce-scatters grads into moment shards and
+        # all-gathers only the param delta
+        CollectiveContract(
+            name="zero1.full_step",
+            at_least={"all-gather": 1},
+            require=(("reduce-scatter", "all-to-all"),),
+        ).enforce(step.lower(ts, batch).compile().as_text())
 
     def test_zero3_step_executes(self):
         """The contract programs must also run (shape/dtype sanity)."""
@@ -176,16 +161,11 @@ class TestRingCollectiveStructure:
         fwd = jax.jit(
             lambda q, k, v: ring_attention(q, k, v, causal=True, mesh=mesh)
         )
-        counts = collective_counts(fwd.lower(q, k, v).compile().as_text())
-        # one rotation = one permute each for the K and V buffers, inside
-        # the scan body (so the program text carries them exactly once on
-        # the native lowering; the experimental one duplicates the pair
-        # fourfold across its unrolled bodies)
-        assert counts["collective-permute"] == (2 if _NATIVE_SM else 8), (
-            dict(counts))
-        # the ring must never fall back to gathering the full sequence
-        assert counts["all-gather"] == 0, dict(counts)
-        assert counts["all-to-all"] == 0, dict(counts)
+        # exact permute pin per shard_map lowering + never-gather structure,
+        # both from the shared per-jax-version table
+        contract_for("ring_attention.forward").enforce(
+            fwd.lower(q, k, v).compile().as_text()
+        )
 
     def test_ring_backward_keeps_ring_structure(self):
         from accelerate_tpu.parallel.ring_attention import ring_attention
@@ -200,13 +180,12 @@ class TestRingCollectiveStructure:
                 argnums=(0, 1, 2),
             )
         )
-        counts = collective_counts(bwd.lower(q, k, v).compile().as_text())
         # fwd K/V + bwd recompute K/V/mask-free + dK/dV return rings: the
-        # exact figure is pinned so a rewrite that silently gathers or
-        # doubles rotations fails here
-        assert counts["collective-permute"] == (8 if _NATIVE_SM else 28), (
-            dict(counts))
-        assert counts["all-gather"] == 0, dict(counts)
+        # exact figure is pinned (per lowering, in the shared table) so a
+        # rewrite that silently gathers or doubles rotations fails here
+        contract_for("ring_attention.backward").enforce(
+            bwd.lower(q, k, v).compile().as_text()
+        )
 
 
 class TestAttentionAutoSelection:
@@ -353,6 +332,7 @@ class TestUlyssesCollectiveStructure:
         q = jnp.ones((B, S, H, D))
         k = jnp.ones((B, S, 8, D))
         v = jnp.ones((B, S, 8, D))
+        contract = contract_for("ulysses.attention")
         for fn in (
             jax.jit(lambda q, k, v: ulysses_attention(
                 q, k, v, causal=True, mesh=mesh)),
@@ -362,10 +342,7 @@ class TestUlyssesCollectiveStructure:
                 argnums=(0, 1, 2),
             )),
         ):
-            counts = collective_counts(fn.lower(q, k, v).compile().as_text())
-            assert counts["all-to-all"] > 0, dict(counts)
-            assert counts["all-gather"] == 0, dict(counts)
-            assert counts["collective-permute"] == 0, dict(counts)
+            contract.enforce(fn.lower(q, k, v).compile().as_text())
 
 
 class TestZero2GradAccumSharding:
@@ -420,14 +397,9 @@ class TestPipelineCollectiveStructure:
                 lambda p, xx: jnp.tanh(xx @ p["w"][0]),
                 lambda y, tt: jnp.mean((y - tt) ** 2),
                 sp, x, t, num_micro_batches=4, mesh=mesh, schedule=s))
-            counts = collective_counts(
+            contract_for("pipeline.step").enforce(
                 fn.lower(staged, x, t).compile().as_text()
             )
-            assert counts["collective-permute"] == (2 if _NATIVE_SM else 6), (
-                sched, dict(counts))
-            assert counts["all-gather"] == 0, (sched, dict(counts))
-            assert counts["all-to-all"] == 0, (sched, dict(counts))
-            assert counts["all-reduce"] > 0, (sched, dict(counts))
 
 
 class TestFp8StepStability:

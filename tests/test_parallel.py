@@ -1112,7 +1112,10 @@ def test_1f1b_interleaved_matches_autodiff_and_sequential():
         return jnp.mean((y - t) ** 2)
 
     vparams = stack_layers_into_virtual_stages(layers, S, V)
-    for M in (4, 6, 8, 12):
+    # M=8 (multiple of S=4) and M=6 (not) pin both schedule classes; each
+    # extra M is three full pipeline recompiles (~15s) for the same code
+    # paths — M=4/12 were dropped for the tier-1 time budget (CHANGES.md)
+    for M in (6, 8):
         def ref_loss(layers, M=M):
             ym = _mlp_stage_fn(layers, x)
             per = jax.vmap(loss_fn)(
@@ -1180,7 +1183,10 @@ def test_ring_attention_sliding_window_matches_reference():
     from accelerate_tpu.parallel import ring_attention
 
     mesh = MeshConfig(axes={"seq": 8}).build()
-    for w, kv in ((5, None), (16, None), (24, 2), (64, None)):
+    # w=5 sub-chunk, w=24 multi-chunk + GQA, w=64 full reach; the w=16
+    # multi-chunk case was dropped for the tier-1 time budget — its forward
+    # is exercised by the gradient-parity check below (CHANGES.md)
+    for w, kv in ((5, None), (24, 2), (64, None)):
         q, k, v = make_qkv(jax.random.key(90 + w), s=64, kv_heads=kv)
         from accelerate_tpu.models.common import repeat_kv
 
