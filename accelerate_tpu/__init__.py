@@ -58,6 +58,8 @@ _LAZY = {
     "ServingEngine": ".serving",
     "EngineConfig": ".serving",
     "SlotKVCache": ".serving",
+    "PagedKVCache": ".serving",
+    "PrefixIndex": ".serving",
     "MetricsRegistry": ".telemetry",
     "StreamingHistogram": ".telemetry",
     "get_registry": ".telemetry",

@@ -101,8 +101,12 @@ def serving_program_contracts() -> dict[str, CollectiveContract]:
     """Default contracts for the serving engine's three programs: a
     single-host engine's admit/prefill/decode must carry NO collectives —
     one appearing means a sharding leak (params accidentally mesh-placed)
-    or an explicit psum snuck into a model forward. Engines deliberately
-    serving sharded models pass their own contracts via
+    or an explicit psum snuck into a model forward. The paged-KV cache's
+    page-table gathers/scatters (serving/cache.py) are plain data
+    movement — `gather`/`scatter` HLO, deliberately NOT in
+    CANONICAL_COLLECTIVES — so the exhaustive no-collectives clause
+    covers the paged programs unchanged. Engines deliberately serving
+    sharded models pass their own contracts via
     `EngineConfig(contracts=...)`."""
     return {
         name: CollectiveContract(

@@ -3,13 +3,22 @@ decode program.
 
 The training half of the repo compiles one program and feeds it batches;
 this package does the same for inference traffic: `Engine` multiplexes many
-concurrent generation requests through a fixed set of cache slots
-(`SlotKVCache`), a `Scheduler` that admits/sheds/retires requests and
+concurrent generation requests through a paged KV pool (`PagedKVCache`,
+with cross-request prompt-prefix reuse via the host-side `PrefixIndex`
+radix tree), a `Scheduler` that admits/sheds/retires requests and
 interleaves chunked prefill with batched decode, and per-request streaming
-with TTFT/per-token metrics. See docs/serving.md.
+with TTFT/per-token metrics. `SlotKVCache` is the simpler contiguous
+slot-dense layout the pool generalizes. See docs/serving.md.
 """
 
-from .cache import SlotKVCache
+from .cache import (
+    PagedAllocator,
+    PagedKVCache,
+    PageAllocation,
+    PagePool,
+    PrefixIndex,
+    SlotKVCache,
+)
 from .engine import Engine, EngineConfig
 from .metrics import ServingMetrics
 from .scheduler import Request, RequestStatus, Scheduler, Slot, SlotState
@@ -22,6 +31,11 @@ __all__ = [
     "ServingEngine",
     "EngineConfig",
     "SlotKVCache",
+    "PagedKVCache",
+    "PagedAllocator",
+    "PageAllocation",
+    "PagePool",
+    "PrefixIndex",
     "ServingMetrics",
     "Scheduler",
     "Request",

@@ -1,4 +1,4 @@
-"""Slot-indexed KV cache for continuous batching.
+"""KV caches for continuous batching: slot-dense and paged-with-prefix-reuse.
 
 `models/decode.py`'s caches carry ONE `cache_len` scalar for the whole
 batch — every sequence must sit at the same depth, which is exactly what a
@@ -8,14 +8,47 @@ so requests at different decode depths share one fixed-shape batch and one
 compiled program (the pjit/TPUv4 static-shapes rule: the program is
 compiled once, the *data* changes).
 
+`PagedKVCache` goes one step further: the physical buffer is a pool of
+fixed-size pages ([L, pages, page_size, H, D]) and each slot owns an
+ordered page table instead of a contiguous stripe. Two things fall out:
+
+- per-request memory is sized by the request (pages allocated at
+  admission), not by the engine-wide max_len;
+- a page's content is position-addressed but *location-free*, so pages
+  holding a shared prompt prefix can be mapped read-only into many slots
+  at once. The host-side `PrefixIndex` (a radix tree over page-sized
+  token chunks) remembers which pages encode which prompt prefixes;
+  `PagedAllocator` matches the longest cached prefix at admission, maps
+  those pages copy-on-write (refcounted — they are FULL pages and are
+  never written again, so "copy" never actually happens), and releases a
+  retiring request's full prompt pages back into the tree instead of
+  wiping them. Prefill then runs only on the uncached suffix.
+
+Every program stays jit-able because page tables are fixed-shape
+([slots, pages_per_slot] int32, padded with a reserved trash page): the
+compiled programs gather a slot's pages into the familiar contiguous
+[L, 1, rows, H, D] view, run the unchanged family forward, and scatter
+the updated pages back. Gather/scatter indices are traced data — the
+request mix, hit/miss pattern, and eviction history never change a
+program shape, so the engine's compile count stays flat.
+
+Write-safety under sharing, the invariant the allocator maintains: only
+FULL prompt pages ever enter the tree, and reuse is capped at
+`(prompt_len - 1) // page_size` pages (the last prompt token always
+prefills, producing the first output logits). Writes land at a slot's
+current `length`, which always lies in a private page; the scatter of a
+slot's whole view re-writes shared pages with their unchanged values,
+which is a byte-identical no-op however many sharers race.
+
 Correctness invariant (why retired slots never need zeroing): a write
 always lands at the slot's current `length`, and the position mask
 (`cached_attention_mask`) only lets queries attend cache rows `<= position
 < length`. Rows at or beyond `length` — stale K/V from a retired request,
 or padding from a chunked prefill — are never attended, and are overwritten
-as the slot's length advances. Admission therefore just resets `length` to
-zero; the O(L*M*H*D) cache wipe a naive design would pay per request is a
-single scalar store.
+as the slot's length advances. Admission therefore just resets `length`
+(to zero, or to the reused prefix length on a paged prefix hit); the
+O(L*M*H*D) cache wipe a naive design would pay per request is a single
+scalar store.
 
 Prefill chunks are padded to a fixed size so every chunk hits the same
 compiled program; the padded tail can spill up to `chunk - 1` rows past the
@@ -27,10 +60,12 @@ advances by *real* token counts, keeping the invariant above.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+import heapq
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
@@ -130,3 +165,496 @@ def _unflatten(aux, children):
 
 
 jax.tree_util.register_pytree_node(SlotKVCache, _flatten, _unflatten)
+
+
+# ---------------------------------------------------------------------------
+# paged pool (device side)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVCache:
+    """Paged KV pool with fixed-shape per-slot page tables.
+
+    k/v: [num_layers, num_pages + 1, page_size, num_kv_heads, head_dim] —
+    the last page is the reserved TRASH page backing padded page-table
+    entries (idle lanes gather it, masked rows and dead writes land in
+    it, and it is never allocated). lengths: [num_slots] int32, the
+    per-slot decode depth (which STARTS at the reused prefix length on a
+    prefix hit). The arrays are pytree children so the cache threads
+    through jit and donates; `page_size`/`pages_per_slot`/... are static.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+    page_size: int
+    pages_per_slot: int
+    max_len: int
+    pad_slack: int
+
+    @classmethod
+    def create(
+        cls,
+        num_layers: int,
+        num_slots: int,
+        max_len: int,
+        num_kv_heads: int,
+        head_dim: int,
+        dtype: Any = jnp.bfloat16,
+        page_size: int = 16,
+        pad_slack: int = 0,
+        num_pages: int | None = None,
+    ) -> "PagedKVCache":
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        # a slot's view must cover max_len rows plus the chunk-padding
+        # spill (see SlotKVCache docstring) — round up to whole pages
+        pages_per_slot = -(-(max_len + pad_slack) // page_size)
+        if num_pages is None:
+            num_pages = num_slots * pages_per_slot
+        if num_pages < pages_per_slot:
+            raise ValueError(
+                f"num_pages({num_pages}) < pages_per_slot({pages_per_slot}):"
+                " a max-size request could never be admitted")
+        shape = (num_layers, num_pages + 1, page_size, num_kv_heads, head_dim)
+        return cls(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((num_slots,), jnp.int32),
+            page_size=page_size,
+            pages_per_slot=pages_per_slot,
+            max_len=max_len,
+            pad_slack=pad_slack,
+        )
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        """Allocatable pages (the +1 trash page is excluded)."""
+        return self.k.shape[1] - 1
+
+    @property
+    def trash_page(self) -> int:
+        """Reserved page index backing padded page-table entries."""
+        return self.k.shape[1] - 1
+
+    @property
+    def num_slots(self) -> int:
+        return self.lengths.shape[0]
+
+    @property
+    def rows(self) -> int:
+        """Rows in one slot's gathered view (pages_per_slot * page_size)."""
+        return self.pages_per_slot * self.page_size
+
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+def paged_slot_view(cache: PagedKVCache, table_row: jax.Array,
+                    slot: jax.Array):
+    """One slot's pages gathered into `models/decode.py` layout:
+    (k [L, 1, R, H, D], v [L, 1, R, H, D], length scalar), R =
+    pages_per_slot * page_size. `table_row` ([pages_per_slot] int32) and
+    `slot` are traced — one compiled program covers every slot and every
+    page mapping."""
+    L, _, ps, H, D = cache.k.shape
+    P = cache.pages_per_slot
+    ks = cache.k[:, table_row].reshape(L, 1, P * ps, H, D)
+    vs = cache.v[:, table_row].reshape(L, 1, P * ps, H, D)
+    return ks, vs, cache.lengths[slot]
+
+
+def paged_write_slot(cache: PagedKVCache, table_row: jax.Array,
+                     slot: jax.Array, new_k: jax.Array, new_v: jax.Array,
+                     advance: jax.Array, chunk: int) -> PagedKVCache:
+    """Scatter the pages a prefill chunk can touch back to the pool and
+    advance the slot's length by `advance` REAL tokens. The chunk only
+    writes view rows [length, length + chunk) — at most
+    ceil(chunk/page_size) + 1 consecutive pages — so scattering just that
+    window keeps per-chunk write traffic O(chunk), not O(max_len) (a
+    full-view scatter with traced page indices also defeats XLA's
+    donation aliasing: a pool copy per chunk). `chunk` must be a static
+    python int. When the window clamps at the view's tail, or starts
+    mid-page, the extra pages receive their unchanged gathered bytes —
+    shared pages are only ever re-written with their own values
+    (value-identical no-op); the rows that DO change always lie in
+    private pages by the allocator's invariant."""
+    L, _, ps, H, D = cache.k.shape
+    P = cache.pages_per_slot
+    n = min(P, -(-chunk // ps) + 1)
+    length = cache.lengths[slot]
+    first = jnp.minimum(length // ps, P - n).astype(jnp.int32)
+    pages = jax.lax.dynamic_slice(table_row, (first,), (n,))
+    win_k = jax.lax.dynamic_slice(
+        new_k.reshape(L, P, ps, H, D), (0, first, 0, 0, 0), (L, n, ps, H, D))
+    win_v = jax.lax.dynamic_slice(
+        new_v.reshape(L, P, ps, H, D), (0, first, 0, 0, 0), (L, n, ps, H, D))
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[:, pages].set(win_k),
+        v=cache.v.at[:, pages].set(win_v),
+        lengths=cache.lengths.at[slot].set(length + advance),
+    )
+
+
+def paged_batch_view(cache: PagedKVCache, table: jax.Array):
+    """All slots' pages gathered into the dense decode layout:
+    (k [L, S, R, H, D], v [L, S, R, H, D]). `table` is the full
+    [S, pages_per_slot] int32 page table (traced)."""
+    L, _, ps, H, D = cache.k.shape
+    S = cache.num_slots
+    P = cache.pages_per_slot
+    ks = cache.k[:, table].reshape(L, S, P * ps, H, D)
+    vs = cache.v[:, table].reshape(L, S, P * ps, H, D)
+    return ks, vs
+
+
+def paged_append_batch(cache: PagedKVCache, table: jax.Array,
+                       new_k: jax.Array, new_v: jax.Array,
+                       live: jax.Array) -> PagedKVCache:
+    """Write each slot's SINGLE new row (the K/V of the token decode just
+    produced, at view row `length`) back to its page and advance live
+    lanes' lengths by one. The family forward returns the whole updated
+    [L, S, R, H, D] views, but decode only ever changes one row per slot
+    — scattering just that row keeps per-token write traffic O(slots),
+    not O(pool) (a full-view scatter with dynamic page indices also
+    defeats XLA's donation aliasing, so it would copy the pool every
+    step). A live slot's current-length row always lies in a PRIVATE page
+    (allocator invariant), so no two live lanes collide; retired lanes'
+    tables are all-trash (the engine resets them at release), so their
+    dead writes land in the trash page — never in a page that may have
+    been reallocated."""
+    _, _, ps, _, _ = cache.k.shape
+    row = cache.lengths                                  # [S] view row
+    page = jnp.take_along_axis(table, (row // ps)[:, None], axis=1)[:, 0]
+    off = row % ps
+    idx = row[None, :, None, None, None]
+    row_k = jnp.take_along_axis(new_k, idx, axis=2)[:, :, 0]   # [L, S, H, D]
+    row_v = jnp.take_along_axis(new_v, idx, axis=2)[:, :, 0]
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[:, page, off].set(row_k),
+        v=cache.v.at[:, page, off].set(row_v),
+        lengths=cache.lengths + live.astype(jnp.int32),
+    )
+
+
+def paged_admit_slot(cache: PagedKVCache, slot: jax.Array,
+                     reused_len: jax.Array) -> PagedKVCache:
+    """Admit a request into `slot`: length starts at the reused prefix
+    length (0 on a cold miss). Nothing is wiped — reused pages carry the
+    prefix K/V, rows past `length` are masked until overwritten."""
+    return dataclasses.replace(
+        cache, lengths=cache.lengths.at[slot].set(reused_len))
+
+
+def _flatten_paged(cache: PagedKVCache):
+    return (cache.k, cache.v, cache.lengths), (
+        cache.page_size, cache.pages_per_slot, cache.max_len, cache.pad_slack)
+
+
+def _unflatten_paged(aux, children):
+    k, v, lengths = children
+    page_size, pages_per_slot, max_len, pad_slack = aux
+    return PagedKVCache(k=k, v=v, lengths=lengths, page_size=page_size,
+                        pages_per_slot=pages_per_slot, max_len=max_len,
+                        pad_slack=pad_slack)
+
+
+jax.tree_util.register_pytree_node(PagedKVCache, _flatten_paged,
+                                   _unflatten_paged)
+
+
+# ---------------------------------------------------------------------------
+# host-side page accounting: free list + prefix radix tree + allocator
+# ---------------------------------------------------------------------------
+
+
+class PagePool:
+    """Free list over the allocatable pages (the trash page never enters).
+
+    Pure host bookkeeping — which physical page holds which bytes is
+    entirely decided here and in `PrefixIndex`; the device only ever sees
+    page indices as traced data."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """Pop `n` free pages, or None (and no change) if short."""
+        if n > len(self._free):
+            return None
+        taken = self._free[len(self._free) - n:]
+        del self._free[len(self._free) - n:]
+        return taken[::-1]
+
+    def release(self, pages) -> None:
+        self._free.extend(pages)
+
+
+class _RadixNode:
+    """One cached page: `key` is the page's token chunk (bytes of
+    page_size int32 tokens), `page` its physical index. `refcount` counts
+    live slots currently mapping the page; 0 means cached-but-unmapped
+    (evictable once it is a leaf)."""
+
+    __slots__ = ("key", "page", "children", "refcount", "last_used", "parent")
+
+    def __init__(self, key: bytes, page: int, parent: "_RadixNode | None"):
+        self.key = key
+        self.page = page
+        self.children: dict[bytes, _RadixNode] = {}
+        self.refcount = 0
+        self.last_used = 0
+        self.parent = parent
+
+
+class PrefixIndex:
+    """Radix tree over page-sized token chunks -> cached KV pages.
+
+    Each edge consumes exactly `page_size` token IDs (reuse is
+    page-granular: a prefix is reusable only in whole pages, which is
+    also what makes the cached pages immutable — see the module
+    docstring), so the tree IS the map from prompt prefixes to page
+    lists. Nodes are LRU-stamped on every match/insert; eviction frees
+    refcount-0 LEAVES oldest-first, which keeps every cached path
+    contiguous from the root (an interior node is unevictable while any
+    descendant survives, and a mapped page — refcount > 0 — is never
+    evicted)."""
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self.root = _RadixNode(b"", -1, None)
+        self._tick = 0
+        self.cached_pages = 0
+        self.mapped_pages = 0   # nodes with refcount > 0
+
+    def _touch(self, node: _RadixNode) -> None:
+        self._tick += 1
+        node.last_used = self._tick
+
+    def _chunk(self, prompt: np.ndarray, i: int) -> bytes:
+        ps = self.page_size
+        return np.ascontiguousarray(
+            prompt[i * ps:(i + 1) * ps], dtype=np.int32).tobytes()
+
+    def match(self, prompt: np.ndarray) -> list[_RadixNode]:
+        """Longest cached prefix of `prompt`, as the node path from the
+        root, capped at (prompt_len - 1) // page_size pages so at least
+        one prompt token always prefills (the first output token's
+        logits have to come from somewhere)."""
+        limit = (int(prompt.shape[0]) - 1) // self.page_size
+        node, path = self.root, []
+        for i in range(limit):
+            child = node.children.get(self._chunk(prompt, i))
+            if child is None:
+                break
+            path.append(child)
+            node = child
+        for n in path:
+            self._touch(n)
+        return path
+
+    def acquire(self, nodes: list[_RadixNode]) -> None:
+        for n in nodes:
+            n.refcount += 1
+            if n.refcount == 1:
+                self.mapped_pages += 1
+
+    def release(self, nodes: list[_RadixNode]) -> None:
+        for n in nodes:
+            n.refcount -= 1
+            if n.refcount == 0:
+                self.mapped_pages -= 1
+
+    def insert(self, prompt: np.ndarray, pages: list[int],
+               upto_pages: int) -> list[int]:
+        """Cache prompt pages [0, upto_pages): walk/create the node path,
+        adopting `pages[i]` for chunks not yet cached. Returns the pages
+        NOT adopted (an equal chunk was cached concurrently by another
+        request — the caller frees the duplicates)."""
+        node, spare = self.root, []
+        for i in range(upto_pages):
+            key = self._chunk(prompt, i)
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(key, pages[i], node)
+                node.children[key] = child
+                self.cached_pages += 1
+            elif child.page != pages[i]:
+                spare.append(pages[i])
+            self._touch(child)
+            node = child
+        return spare
+
+    def evict_lru(self, n: int) -> list[int]:
+        """Free exactly `n` pages, detaching least-recently-used
+        refcount-0 leaves (evicting a leaf can turn its parent into the
+        next candidate). Mapped pages (refcount > 0) are never touched.
+        ALL-OR-NOTHING: if fewer than `n` pages are evictable the tree is
+        left intact and [] returned — a failed admission must not cost
+        the cache its reusable prefixes, and (key for a queue head that
+        stays blocked for many engine steps) that case bails in O(1).
+
+        Why `cached - mapped` IS the evictable total: acquire/release
+        always ref whole root-paths (`match` returns contiguous paths
+        from the root), so refcounts are downward-closed — a refcount-0
+        node can never have a mapped descendant, and every refcount-0
+        subtree drains leaf-first. The sufficient case pays one DFS plus
+        a min-heap of candidate leaves: O(tree + n log tree), once per
+        actual eviction burst, never per blocked step."""
+        if n <= 0 or self.cached_pages - self.mapped_pages < n:
+            return []
+        heap = []
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif node.refcount == 0:
+                heap.append((node.last_used, node.page, node))
+        heapq.heapify(heap)
+        freed: list[int] = []
+        while len(freed) < n:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            del parent.children[victim.key]
+            victim.parent = None
+            self.cached_pages -= 1
+            freed.append(victim.page)
+            if parent is not self.root and not parent.children \
+                    and parent.refcount == 0:
+                heapq.heappush(heap, (parent.last_used, parent.page, parent))
+        return freed
+
+
+@dataclasses.dataclass
+class PageAllocation:
+    """One admitted request's page mapping: `pages` is the ordered table
+    row prefix (cached prefix pages first, then private pages); `nodes`
+    are the mapped radix nodes backing pages[:len(nodes)]."""
+
+    reused_len: int
+    nodes: list
+    pages: list[int]
+
+
+class PagedAllocator:
+    """Admission-time page allocation with prefix reuse.
+
+    The scheduler calls `allocate()` before admitting a queued request
+    (None = not enough pages yet, the request stays queued — transient
+    pressure, relieved as running slots retire) and `release()` when a
+    slot retires or is cancelled. Worst-case pages are reserved at
+    admission, so a running request can never hit pool pressure
+    mid-flight and never needs preemption."""
+
+    def __init__(
+        self,
+        page_size: int,
+        num_pages: int,
+        pad_slack: int = 0,
+        prefix_cache: bool = True,
+        on_evict: Callable[[int], None] | None = None,
+        on_unmap: Callable[[int], None] | None = None,
+    ):
+        self.page_size = page_size
+        self.pad_slack = pad_slack
+        self.prefix_cache = prefix_cache
+        self.pool = PagePool(num_pages)
+        self.index = PrefixIndex(page_size)
+        self.on_evict = on_evict
+        self.on_unmap = on_unmap
+        # running totals for host-side (model-free) observability and
+        # tests. The engine's registry counters are booked separately:
+        # evictions reach it through on_evict, admission outcomes through
+        # Engine._run_admit reading the same PageAllocation.
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+
+    @property
+    def pages_free(self) -> int:
+        return self.pool.free_count
+
+    @property
+    def pages_in_use(self) -> int:
+        """Allocated to live slots OR cached in the prefix tree."""
+        return self.pool.used_count
+
+    def pages_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case pages for one request: every prompt+generated row
+        plus the chunk-padding spill, in whole pages."""
+        rows = prompt_len + max_new_tokens + self.pad_slack
+        return -(-rows // self.page_size)
+
+    def allocate(self, request) -> PageAllocation | None:
+        """Match the longest cached prefix and reserve the remaining
+        private pages, evicting LRU refcount-0 pages under pressure.
+        None = insufficient pages even with eviction (keep queued) — and
+        in that case NOTHING was evicted (evict_lru is all-or-nothing),
+        so a too-big queue head can't strip the cache while it waits."""
+        nodes = (self.index.match(request.prompt)
+                 if self.prefix_cache else [])
+        n_total = self.pages_needed(request.prompt_len,
+                                    request.max_new_tokens)
+        n_private = n_total - len(nodes)
+        # acquire BEFORE evicting: matched nodes are refcount-0 until
+        # mapped, and eviction must never free a page we are about to use
+        self.index.acquire(nodes)
+        private = self.pool.alloc(n_private)
+        if private is None:
+            freed = self.index.evict_lru(n_private - self.pool.free_count)
+            if freed:
+                self.evictions += len(freed)
+                self.pool.release(freed)
+                if self.on_evict is not None:
+                    self.on_evict(len(freed))
+            private = self.pool.alloc(n_private)
+        if private is None:
+            self.index.release(nodes)
+            return None
+        self.lookups += 1
+        if nodes:
+            self.hits += 1
+            self.tokens_reused += len(nodes) * self.page_size
+        return PageAllocation(
+            reused_len=len(nodes) * self.page_size,
+            nodes=nodes,
+            pages=[n.page for n in nodes] + private,
+        )
+
+    def release(self, slot, finished: bool) -> None:
+        """Return a retiring slot's pages: shared nodes drop a refcount
+        (other sharers keep decoding untouched); on a normal finish the
+        FULL prompt pages are inserted into the tree (content intact —
+        this is the 'release to the tree, not wipe' half of reuse); the
+        rest — generation pages, the partial last prompt page, and pages
+        whose chunks a concurrent request cached first — go back to the
+        free list. `finished=False` (cancel) caches nothing: a
+        mid-prefill page may hold garbage."""
+        alloc, req = slot.alloc, slot.request
+        self.index.release(alloc.nodes)
+        n_cached = len(alloc.nodes)
+        full = req.prompt_len // self.page_size \
+            if (finished and self.prefix_cache) else n_cached
+        spare = (self.index.insert(req.prompt, alloc.pages, full)
+                 if full > n_cached else [])
+        self.pool.release(spare + alloc.pages[full:])
+        if self.on_unmap is not None:
+            self.on_unmap(slot.index)

@@ -6,8 +6,9 @@ the same depth, no cross-request multiplexing. The engine instead drives
 exactly three compiled programs for its whole lifetime, whatever the
 request mix:
 
-- `admit`:   reset a slot's length, install the request's PRNG key and
-             temperature (slot index is traced — one program for any slot);
+- `admit`:   set a slot's length to the reused prefix length (0 on a cold
+             miss), install the request's PRNG key and temperature (slot
+             index is traced — one program for any slot);
 - `prefill`: one fixed-size prompt chunk into one slot (prompts pad to the
              chunk, lengths advance by real tokens only — serving/cache.py);
 - `decode`:  one token for EVERY slot, the family `forward` vmapped over
@@ -15,6 +16,22 @@ request mix:
              slots ride along as masked lanes — fixed shapes are the price
              of never recompiling, and their lanes are reused the moment a
              queued request lands.
+
+The KV store behind all three is a PAGED pool (`serving/cache.py
+PagedKVCache`): each slot maps an ordered list of fixed-size pages
+instead of a contiguous stripe, and the programs gather the slot's pages
+into the familiar contiguous view / scatter the update back. Page tables
+are host-side numpy ([slots, pages_per_slot] int32, padded with the
+reserved trash page) passed to each dispatch as traced data — hit/miss
+mixes, evictions, and remapping never change a program shape, so the
+compile count stays flat at three. The host-side `PrefixIndex` +
+`PagedAllocator` give cross-request prefix reuse: at admission the
+longest cached prompt prefix is matched in a radix tree and those pages
+are mapped copy-on-write (refcounted, full pages only — never written
+again), so prefill runs ONLY on the uncached suffix; at retirement the
+request's full prompt pages are released back into the tree instead of
+wiped. Under shared-prefix traffic (system prompts, few-shot headers)
+this removes the dominant prefill FLOPs and the TTFT they cost.
 
 Sampling is per-slot: each request's PRNG key is installed at admit and
 the step key derives as `fold_in(request_key, position)`, so streams never
@@ -47,7 +64,15 @@ from ..telemetry.export import start_metrics_server
 from ..telemetry.registry import MetricsRegistry
 from ..telemetry.trace import span
 from ..telemetry.watchdog import StallWatchdog, resolve_stall_timeout
-from .cache import SlotKVCache, reset_slot, slot_caches, write_slot
+from .cache import (
+    PagedAllocator,
+    PagedKVCache,
+    paged_admit_slot,
+    paged_append_batch,
+    paged_batch_view,
+    paged_slot_view,
+    paged_write_slot,
+)
 from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler, Slot, SlotState
 
@@ -75,6 +100,18 @@ class EngineConfig:
     cache_dtype: Any = jnp.bfloat16
     seed: int = 0
     donate: bool = True
+    # paged KV pool: per-request memory is allocated in `page_size`-token
+    # pages at admission, and prompt prefixes already cached (full pages
+    # of an earlier request's prompt) are mapped instead of recomputed.
+    # `num_pages` sizes the pool (None = num_slots * pages_per_slot —
+    # capacity parity with the old dense cache; MORE keeps retired
+    # prefixes cached longer, LESS trades HBM for eviction churn).
+    # `prefix_cache=False` keeps the paged layout but disables
+    # cross-request reuse (every admission is a cold miss) — the A/B
+    # baseline for the prefill-savings benchmark.
+    page_size: int = 16
+    num_pages: int | None = None
+    prefix_cache: bool = True
     metrics_port: int | None = None
     watchdog_timeout_s: float | None = None
     # strict="warn"|"error" audits each engine program ONCE, at its first
@@ -156,12 +193,11 @@ class Engine:
         self._audited: dict = {}
 
         num_layers, num_kv, head_dim = _cache_spec(config)
-        self.cache = SlotKVCache.create(
+        self.cache = PagedKVCache.create(
             num_layers, ec.num_slots, ec.max_len, num_kv, head_dim,
-            dtype=ec.cache_dtype, pad_slack=ec.prefill_chunk,
+            dtype=ec.cache_dtype, page_size=ec.page_size,
+            pad_slack=ec.prefill_chunk, num_pages=ec.num_pages,
         )
-        self.scheduler = Scheduler(ec.num_slots, ec.max_len,
-                                   max_queue=ec.max_queue, clock=clock)
         # per-engine registry (not the process default) so concurrent
         # engines in one process never collide on series; the histograms
         # are streaming sketches, so a server that steps forever still
@@ -170,6 +206,25 @@ class Engine:
         self.metrics = ServingMetrics(registry=self.registry)
         self.timer = StepTimer(warmup_steps=1, registry=self.registry,
                                name="serving_step")
+        # host-side page accounting: prefix radix tree + free list. The
+        # lambdas read self.metrics at call time, so reset_metrics()'s
+        # replacement instance keeps receiving events.
+        self.allocator = PagedAllocator(
+            page_size=ec.page_size,
+            num_pages=self.cache.num_pages,
+            pad_slack=ec.prefill_chunk,
+            prefix_cache=ec.prefix_cache,
+            on_evict=lambda n: self.metrics.note_page_evictions(n),
+            on_unmap=self._unmap_slot,
+        )
+        self.scheduler = Scheduler(ec.num_slots, ec.max_len,
+                                   max_queue=ec.max_queue, clock=clock,
+                                   allocator=self.allocator)
+        # host-side page tables, one row per slot, padded with the trash
+        # page: idle/retired lanes gather (and dead-write) only trash
+        self._table = np.full(
+            (ec.num_slots, self.cache.pages_per_slot),
+            self.cache.trash_page, np.int32)
         # opt-in observability: Prometheus endpoint + stall watchdog
         self.metrics_server = start_metrics_server(
             ec.metrics_port, registry=self.registry)
@@ -209,22 +264,25 @@ class Engine:
             return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
 
         @partial(jax.jit, donate_argnums=don_admit)
-        def admit(cache, slot_keys, temps, slot, key_raw, temp):
-            cache = reset_slot(cache, slot)
+        def admit(cache, slot_keys, temps, slot, key_raw, temp, reused_len):
+            # a prefix hit starts the slot's length at the reused prefix
+            # (those pages already hold its K/V); a miss starts at zero
+            cache = paged_admit_slot(cache, slot, reused_len)
             slot_keys = slot_keys.at[slot].set(key_raw)
             temps = temps.at[slot].set(temp)
             return cache, slot_keys, temps
 
         @partial(jax.jit, donate_argnums=don)
-        def prefill(params, cache, tokens, slot_keys, temps, slot, ids,
-                    real_len):
-            ks, vs, length = slot_caches(cache, slot)
+        def prefill(params, cache, tokens, slot_keys, temps, slot,
+                    table_row, ids, real_len):
+            ks, vs, length = paged_slot_view(cache, table_row, slot)
             positions = (length + jnp.arange(chunk, dtype=jnp.int32))[None, :]
             logits, (nk, nv, _) = forward(
                 config, params, ids[None, :], positions=positions,
                 kv_caches=(ks, vs, length),
             )
-            cache = write_slot(cache, slot, nk, nv, real_len)
+            cache = paged_write_slot(cache, table_row, slot, nk, nv, real_len,
+                                     chunk)
             new_len = length + real_len
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), real_len - 1, keepdims=False)
@@ -233,7 +291,12 @@ class Engine:
             return cache, tokens
 
         @partial(jax.jit, donate_argnums=don)
-        def decode(params, cache, tokens, slot_keys, temps, live):
+        def decode(params, cache, tokens, slot_keys, temps, live, table):
+            # gather OUTSIDE the vmap: one [L, S, R, H, D] view of every
+            # slot's pages, exactly the dense layout the family forward
+            # already vmaps over; the per-page indices are traced data
+            k_all, v_all = paged_batch_view(cache, table)
+
             def single(tok, length, k_slot, v_slot):
                 logits, (nk, nv, _) = forward(
                     config, params, tok[None, None],
@@ -244,13 +307,11 @@ class Engine:
 
             last, nk, nv = jax.vmap(
                 single, in_axes=(0, 0, 1, 1), out_axes=(0, 1, 1)
-            )(tokens, cache.lengths, cache.k, cache.v)
+            )(tokens, cache.lengths, k_all, v_all)
             next_tok = jax.vmap(sample_slot)(
                 last, slot_keys, cache.lengths + 1, temps)
             tokens = jnp.where(live, next_tok, tokens)
-            cache = dataclasses.replace(
-                cache, k=nk, v=nv,
-                lengths=cache.lengths + live.astype(jnp.int32))
+            cache = paged_append_batch(cache, table, nk, nv, live)
             return cache, tokens
 
         self._admit_p, self._prefill_p, self._decode_p = admit, prefill, decode
@@ -425,13 +486,30 @@ class Engine:
             label=f"engine program {pname!r}",
         )
 
+    def _unmap_slot(self, index: int) -> None:
+        """Allocator callback at release: reset the slot's page table to
+        all-trash BEFORE its pages can be reallocated, so the retired
+        lane's masked ride-along writes in later decode steps can never
+        land in a page now owned by someone else."""
+        self._table[index, :] = self.cache.trash_page
+        self.metrics.set_page_gauges(self.allocator.pages_in_use,
+                                     self.allocator.pages_free)
+
     def _run_admit(self, slot: Slot, req: Request) -> None:
         key_raw = _as_raw_key(req.key)
         if key_raw is None:
             key_raw = jax.random.key_data(
                 jax.random.fold_in(self._base_key, req.request_id))
+        alloc = slot.alloc
+        row = self._table[slot.index]
+        row[:] = self.cache.trash_page
+        row[:len(alloc.pages)] = alloc.pages
+        self.metrics.note_admission(req.prompt_len, alloc.reused_len)
+        self.metrics.set_page_gauges(self.allocator.pages_in_use,
+                                     self.allocator.pages_free)
         args = (self.cache, self._slot_keys, self._temps,
-                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature))
+                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
+                jnp.int32(alloc.reused_len))
         self._strict_audit("admit", self._admit_p, args)
         with span("serving.admit"):
             self.cache, self._slot_keys, self._temps = self._admit_p(*args)
@@ -439,12 +517,13 @@ class Engine:
     def _run_prefill_chunk(self, slot: Slot) -> None:
         chunk = self.engine_config.prefill_chunk
         req = slot.request
-        start = slot.prompt_done
+        start = slot.prompt_done  # includes the reused prefix on a hit
         real = min(chunk, req.prompt_len - start)
         ids = np.zeros((chunk,), np.int32)
         ids[:real] = req.prompt[start:start + real]
         args = (self.params, self.cache, self._tokens, self._slot_keys,
-                self._temps, jnp.int32(slot.index), ids, jnp.int32(real))
+                self._temps, jnp.int32(slot.index),
+                self._table[slot.index], ids, jnp.int32(real))
         self._strict_audit("prefill", self._prefill_p, args)
         with span("serving.prefill"), self.timer.dispatch():
             self.cache, self._tokens = self._prefill_p(*args)
@@ -463,7 +542,7 @@ class Engine:
         for s in slots:
             live[s.index] = True
         args = (self.params, self.cache, self._tokens, self._slot_keys,
-                self._temps, live)
+                self._temps, live, self._table)
         self._strict_audit("decode", self._decode_p, args)
         with span("serving.decode"), self.timer.dispatch():
             self.cache, self._tokens = self._decode_p(*args)
@@ -486,6 +565,10 @@ class Engine:
         self.metrics = ServingMetrics(registry=self.registry)
         self.timer = StepTimer(warmup_steps=0, registry=self.registry,
                                name="serving_step")
+        # page-pool gauges reflect CURRENT state, not a window: re-sync
+        # (the prefix tree and its cached pages survive a metrics reset)
+        self.metrics.set_page_gauges(self.allocator.pages_in_use,
+                                     self.allocator.pages_free)
         # decode_steps restarts from 0, so the log guard must too — a stale
         # value would swallow the first post-reset log point
         self._last_logged = 0

@@ -50,9 +50,19 @@ class ServingMetrics:
         self._c_tokens = r.counter("serving_tokens_out_total")
         self._c_decode = r.counter("serving_decode_steps_total")
         self._c_prefill = r.counter("serving_prefill_chunks_total")
+        # paged-KV prefix reuse: lookups = admissions, hits = admissions
+        # that mapped >= 1 cached page; prompt-token totals make the
+        # cached-token fraction derivable from counters alone
+        self._c_prefix_lookups = r.counter("serving_prefix_lookups_total")
+        self._c_prefix_hits = r.counter("serving_prefix_hits_total")
+        self._c_prefix_tokens = r.counter("serving_prefix_tokens_reused_total")
+        self._c_prompt_tokens = r.counter("serving_prompt_tokens_total")
+        self._c_evictions = r.counter("serving_page_evictions_total")
         self._g_queue_depth = r.gauge("serving_queue_depth_current")
         self._g_occupancy = r.gauge("serving_slot_occupancy_current")
         self._g_tokens_per_sec = r.gauge("serving_tokens_per_sec")
+        self._g_pages_in_use = r.gauge("serving_pages_in_use")
+        self._g_pages_free = r.gauge("serving_pages_free")
         self.started_at: float | None = None
         self.stopped_at: float | None = None
 
@@ -85,11 +95,46 @@ class ServingMetrics:
     def prefill_chunks(self) -> int:
         return int(self._c_prefill.value)
 
+    @property
+    def prefix_lookups(self) -> int:
+        return int(self._c_prefix_lookups.value)
+
+    @property
+    def prefix_hits(self) -> int:
+        return int(self._c_prefix_hits.value)
+
+    @property
+    def prefix_tokens_reused(self) -> int:
+        return int(self._c_prefix_tokens.value)
+
+    @property
+    def prompt_tokens(self) -> int:
+        return int(self._c_prompt_tokens.value)
+
+    @property
+    def page_evictions(self) -> int:
+        return int(self._c_evictions.value)
+
     def note_decode_step(self) -> None:
         self._c_decode.inc()
 
     def note_prefill_chunk(self) -> None:
         self._c_prefill.inc()
+
+    def note_admission(self, prompt_len: int, reused_len: int) -> None:
+        """One admitted request's prefix-cache outcome."""
+        self._c_prefix_lookups.inc()
+        self._c_prompt_tokens.inc(prompt_len)
+        if reused_len > 0:
+            self._c_prefix_hits.inc()
+            self._c_prefix_tokens.inc(reused_len)
+
+    def note_page_evictions(self, n: int) -> None:
+        self._c_evictions.inc(n)
+
+    def set_page_gauges(self, in_use: int, free: int) -> None:
+        self._g_pages_in_use.set(in_use)
+        self._g_pages_free.set(free)
 
     def observe_step(self, live_slots: int, num_slots: int,
                      queue_depth: int) -> None:
@@ -132,7 +177,17 @@ class ServingMetrics:
             "tokens_out": float(self.tokens_out),
             "decode_steps": float(self.decode_steps),
             "prefill_chunks": float(self.prefill_chunks),
+            "prefix_hits": float(self.prefix_hits),
+            "prefix_tokens_reused": float(self.prefix_tokens_reused),
+            "page_evictions": float(self.page_evictions),
+            "pages_in_use": float(self._g_pages_in_use.value),
+            "pages_free": float(self._g_pages_free.value),
         }
+        if self.prefix_lookups:
+            out["prefix_hit_rate"] = self.prefix_hits / self.prefix_lookups
+        if self.prompt_tokens:
+            out["cached_token_fraction"] = (
+                self.prefix_tokens_reused / self.prompt_tokens)
         out.update(_percentiles(self.ttft_s, "ttft"))
         out.update(_percentiles(self.tpot_s, "per_token"))
         out.update(_percentiles(self.queue_wait_s, "queue_wait"))

@@ -95,12 +95,14 @@ class Slot:
     index: int
     state: SlotState = SlotState.IDLE
     request: Request | None = None
-    prompt_done: int = 0   # prompt tokens prefilled so far
+    prompt_done: int = 0   # prompt tokens prefilled so far (incl. reused)
+    alloc: Any = None      # PageAllocation when a paged allocator is wired
 
     def free(self) -> None:
         self.state = SlotState.IDLE
         self.request = None
         self.prompt_done = 0
+        self.alloc = None
 
 
 class Scheduler:
@@ -112,12 +114,19 @@ class Scheduler:
         max_len: int,
         max_queue: int = 128,
         clock: Callable[[], float] = time.monotonic,
+        allocator: Any = None,
     ):
         self.slots = [Slot(i) for i in range(num_slots)]
         self.max_len = max_len
         self.max_queue = max_queue
         self.queue: deque[Request] = deque()
         self.clock = clock
+        # optional paged-KV allocator (serving/cache.py PagedAllocator
+        # protocol: allocate(request) -> alloc | None, release(slot,
+        # finished)). Admission then ALSO requires pages: the FIFO head
+        # waits while the pool is tight (no skip-ahead — small requests
+        # must not starve a big one) and retirement returns pages.
+        self.allocator = allocator
         self._ids = itertools.count()
         self._last_was_prefill = False
         self.rejected_full = 0
@@ -165,18 +174,29 @@ class Scheduler:
         return shed
 
     def admissions(self, now: float | None = None) -> list[tuple[Slot, Request]]:
-        """Pop queued requests into free slots (FIFO)."""
+        """Pop queued requests into free slots (FIFO). With a paged
+        allocator, admission also reserves the request's worst-case
+        pages; the FIFO head blocks admission while the pool is tight
+        (pages free up as running slots retire). A prefix hit starts
+        `prompt_done` at the reused length — prefill covers only the
+        uncached suffix."""
         now = self.clock() if now is None else now
         admitted = []
         for slot in self.slots:
             if slot.state is not SlotState.IDLE or not self.queue:
                 continue
+            alloc = None
+            if self.allocator is not None:
+                alloc = self.allocator.allocate(self.queue[0])
+                if alloc is None:
+                    break
             req = self.queue.popleft()
             req.status = RequestStatus.RUNNING
             req.admitted_at = now
             slot.request = req
             slot.state = SlotState.PREFILL
-            slot.prompt_done = 0
+            slot.alloc = alloc
+            slot.prompt_done = alloc.reused_len if alloc is not None else 0
             admitted.append((slot, req))
         return admitted
 
@@ -232,9 +252,17 @@ class Scheduler:
         if eos or len(req.tokens) >= req.max_new_tokens:
             req.status = RequestStatus.FINISHED
             req.finished_at = now
-            slot.free()
+            self._retire(slot, finished=True)
             return True
         return False
+
+    def _retire(self, slot: Slot, finished: bool) -> None:
+        """Free a slot, returning its pages first when paged: a finished
+        request's full prompt pages go back into the prefix tree (reuse),
+        a cancelled one's pages to the free list."""
+        if self.allocator is not None and slot.alloc is not None:
+            self.allocator.release(slot, finished=finished)
+        slot.free()
 
     def cancel(self, request: Request) -> bool:
         """Cancel a queued or running request; no-op on finished ones."""
@@ -247,7 +275,7 @@ class Scheduler:
             return True
         for slot in self.slots:
             if slot.request is request:
-                slot.free()
+                self._retire(slot, finished=False)
                 request.status = RequestStatus.CANCELLED
                 request.finished_at = self.clock()
                 return True

@@ -35,6 +35,12 @@ import time
 # windows). A hung tunnel costs this once; a healthy run initializes the
 # backend exactly once (the child IS the bench — no separate probe).
 _TPU_TIMEOUT = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+# per-phase ceiling for the extra rows (serving, serving_prefix): each
+# phase is its OWN child with its own budget, so a device that wedges
+# mid-phase costs that phase only — its row carries "error" and the rest
+# of the line survives (BENCH_r05: one hung phase used to eat the whole
+# 900s budget and the entire line with it).
+_PHASE_TIMEOUT = int(os.environ.get("BENCH_PHASE_TIMEOUT", "300"))
 
 
 def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
@@ -150,16 +156,8 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
             "host_dispatch_us_mean": round(host_dispatch_us, 1),
         },
     }
-    # serving row: the continuous-batching engine's offered-load numbers
-    # next to the training row (tiny-config smoke on either backend — it
-    # reports the serving subsystem's steady state, not a model headline;
-    # benchmarks/serve_bench.py is the full harness). Never allowed to
-    # kill the bench line: failures fold into extra.serving.error.
-    if os.environ.get("BENCH_SERVING", "1") == "1":
-        try:
-            extra["serving"] = _serving_row()
-        except Exception as e:  # the one-line contract outranks the row
-            extra["serving"] = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    # (the serving rows are attached by the PARENT as separate phase
+    # children with their own timeouts — see _attach_phase_rows)
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "unit": "tokens/s/chip",
@@ -186,9 +184,7 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
     return result
 
 
-def _serving_row() -> dict:
-    """Offered-load smoke through the continuous-batching engine
-    (benchmarks/serve_bench.py): tokens/sec + TTFT/per-token percentiles."""
+def _load_serve_bench():
     import importlib.util
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -196,6 +192,13 @@ def _serving_row() -> dict:
     spec = importlib.util.spec_from_file_location("serve_bench", path)
     sb = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(sb)
+    return sb
+
+
+def _serving_row() -> dict:
+    """Offered-load smoke through the continuous-batching engine
+    (benchmarks/serve_bench.py): tokens/sec + TTFT/per-token percentiles."""
+    sb = _load_serve_bench()
     engine, cfg = sb.build_tiny_engine("llama", num_slots=4, max_len=128,
                                        prefill_chunk=16)
     s = sb.run_offered_load(engine, cfg.vocab_size, num_requests=12,
@@ -206,15 +209,57 @@ def _serving_row() -> dict:
     return {k: round(float(s[k]), 2) for k in keep if k in s}
 
 
+def _serving_prefix_row(num_requests: int = 12, prefix_pool: int = 4,
+                        prefix_len: int = 32, page_size: int = 8) -> dict:
+    """Shared-prefix offered-load smoke: the paged KV cache's radix-tree
+    prefix reuse under the traffic it targets — reports the hit rate and
+    cached-token fraction next to the latency percentiles, so a reuse
+    regression (hit rate -> 0, prefill chunks up) is visible in the same
+    one-line JSON as the training row."""
+    sb = _load_serve_bench()
+    engine, cfg = sb.build_tiny_engine(
+        "llama", num_slots=4, max_len=prefix_len + 48, prefill_chunk=16,
+        page_size=page_size)
+    s = sb.run_offered_load(
+        engine, cfg.vocab_size, num_requests=num_requests, rate_hz=200.0,
+        prompt_len=(4, 16), max_new_tokens=(4, 8),
+        prefix_pool=prefix_pool, prefix_len=prefix_len)
+    keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+            "prefill_chunks", "prefix_hits", "prefix_hit_rate",
+            "cached_token_fraction", "page_evictions", "requests_finished")
+    return {k: round(float(s[k]), 3) for k in keep if k in s}
+
+
 def _child_main() -> None:
-    """Runs inside a bench child process (BENCH_CHILD=1)."""
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    """Runs inside a bench child process (BENCH_CHILD=1). BENCH_PHASE
+    selects which phase this child IS: "train" (default, the full
+    training bench) or one of the serving rows — each phase child owns
+    exactly one backend init and one failure domain."""
+    phase = os.environ.get("BENCH_PHASE", "train") or "train"
+    on_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    if on_cpu:
         # the hosted image pins jax_platforms to the tunnel backend at
         # import time, silently overriding the env var — force CPU via the
         # config before any backend initializes (tests/conftest.py fix)
         from accelerate_tpu.utils.environment import force_cpu_platform
 
         force_cpu_platform()
+    if phase in ("serving", "serving_prefix"):
+        if not on_cpu:
+            # spawned on the TPU-success path: if the tunnel dropped
+            # after the train child, jax would silently fall back to CPU
+            # and this row would report CPU numbers under a TPU headline
+            # — exit 3 so the parent reports it in the row's error field
+            import jax
+
+            dev0 = jax.devices()[0]
+            if "tpu" not in (
+                    dev0.platform + getattr(dev0, "device_kind", "")).lower():
+                sys.exit(3)
+        row = _serving_row() if phase == "serving" else _serving_prefix_row()
+        print(json.dumps(row))
+        return
+    if on_cpu:
         print(json.dumps(run_bench(os.environ.get("BENCH_TPU_ERROR") or None)))
         return
     result = run_bench(None, require_tpu=True)
@@ -230,6 +275,49 @@ def _last_json_line(text: str) -> str | None:
     )
 
 
+def _spawn_child(phase: str, timeout: int, **env_overrides):
+    """Run bench.py as a BENCH_CHILD subprocess — one phase, one backend
+    init, one failure domain. The single place that knows the child
+    protocol (env assembly, JSON-line extraction, error-tail capture).
+    Returns (returncode, last JSON line or None, one-line error tail);
+    TimeoutExpired propagates — each caller owns its hang message."""
+    env = {**os.environ, "BENCH_CHILD": "1", "BENCH_PHASE": phase,
+           **env_overrides}
+    out = subprocess.run([sys.executable, __file__], env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    tail = (out.stderr or out.stdout).strip().splitlines()
+    return (out.returncode, _last_json_line(out.stdout),
+            tail[-1][:300] if tail else "no output")
+
+
+def _run_phase(phase: str, cpu: bool) -> dict:
+    """One extra-row phase in its own child with its own timeout: a
+    wedged device (or a crash) yields a row with "error" populated, never
+    a hang or a poisoned line — each phase is failure-isolated."""
+    try:
+        rc, line, tail = _spawn_child(
+            phase, _PHASE_TIMEOUT, JAX_PLATFORMS="cpu" if cpu else "")
+        if rc == 0 and line:
+            return json.loads(line)
+        if rc == 3:
+            return {"error": f"{phase} bench skipped: no tpu visible "
+                    "(tunnel dropped after the train phase)"}
+        return {"error": f"{phase} bench failed: {tail}"}
+    except subprocess.TimeoutExpired:
+        return {"error": f"{phase} bench hung >{_PHASE_TIMEOUT}s "
+                "(tunnel unresponsive)"}
+
+
+def _emit(payload: dict, cpu: bool) -> None:
+    """Attach the serving phase rows (each its own timed child) and print
+    the one contract line."""
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        extra = payload.setdefault("extra", {})
+        extra["serving"] = _run_phase("serving", cpu)
+        extra["serving_prefix"] = _run_phase("serving_prefix", cpu)
+    print(json.dumps(payload))
+
+
 def main() -> None:
     if os.environ.get("BENCH_CHILD") == "1":
         _child_main()
@@ -240,55 +328,45 @@ def main() -> None:
     error = None
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         # operator explicitly forced CPU — don't pay the TPU hang budget
-        _run_cpu_fallback(
+        _emit(_run_cpu_fallback(
             "JAX_PLATFORMS=cpu set by operator; tpu attempt skipped",
             skipped=True,
-        )
+        ), cpu=True)
         return
     try:
-        out = subprocess.run(
-            [sys.executable, __file__],
-            env={**os.environ, "BENCH_CHILD": "1", "JAX_PLATFORMS": ""},
-            capture_output=True, text=True, timeout=_TPU_TIMEOUT,
-        )
-        line = _last_json_line(out.stdout)
-        if out.returncode == 0 and line:
-            print(line)
+        rc, line, tail = _spawn_child("train", _TPU_TIMEOUT, JAX_PLATFORMS="")
+        if rc == 0 and line:
+            _emit(json.loads(line), cpu=False)
             return
-        if out.returncode == 3:
+        if rc == 3:
             error = "no tpu visible (tunnel backend came up without one)"
         else:
-            tail = (out.stderr or out.stdout).strip().splitlines()
-            error = "tpu bench failed: " + (
-                tail[-1][:300] if tail else "no output"
-            )
+            error = f"tpu bench failed: {tail}"
     except subprocess.TimeoutExpired:
         error = f"tpu bench hung >{_TPU_TIMEOUT}s (tunnel unresponsive)"
-    _run_cpu_fallback(error)
+    _emit(_run_cpu_fallback(error), cpu=True)
 
 
-def _run_cpu_fallback(error: str, skipped: bool = False) -> None:
+def _run_cpu_fallback(error: str, skipped: bool = False) -> dict:
     """TPU unusable: CPU child so no poisoned backend state survives.
     The child nulls value/vs_baseline (degraded runs carry no headline
     number — only extra.cpu_smoke_tokens_per_sec and the error field).
     skipped=True marks a deliberate operator pin, reported under
-    "skipped" rather than "error"."""
-    env = {**os.environ, "BENCH_CHILD": "1", "JAX_PLATFORMS": "cpu",
-           "BENCH_TPU_ERROR": error}
+    "skipped" rather than "error". Returns the payload dict (the caller
+    attaches phase rows and prints)."""
+    env_extra = {"JAX_PLATFORMS": "cpu", "BENCH_TPU_ERROR": error}
     if skipped:
-        env["BENCH_TPU_SKIPPED"] = "1"
-    out = subprocess.run([sys.executable, __file__], env=env,
-                         capture_output=True, text=True, timeout=900)
-    line = _last_json_line(out.stdout)
+        env_extra["BENCH_TPU_SKIPPED"] = "1"
+    _, line, tail = _spawn_child("train", 900, **env_extra)
     if line:
-        print(line)
-    else:  # last resort: the contract line, hand-built
-        print(json.dumps({
-            "metric": "llama_train_tokens_per_sec_per_chip",
-            "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
-            "error": error,
-            "fallback_stderr": (out.stderr or "")[-500:],
-        }))
+        return json.loads(line)
+    # last resort: the contract line, hand-built
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
+        "error": error,
+        "fallback_stderr": tail,
+    }
 
 
 if __name__ == "__main__":

@@ -15,7 +15,14 @@ Emits ONE JSON line:
              "per_token_p50_ms": ..., "per_token_p99_ms": ...,
              "requests_finished": ..., "requests_rejected": ...,
              "requests_expired": ..., "slot_occupancy_mean": ...,
+             "prefix_hit_rate": ..., "cached_token_fraction": ...,
              "compiles_decode": 1, ...}}
+
+`--prefix-pool N --prefix-len L` switches the prompt generator to
+shared-prefix traffic (each prompt = one of N fixed L-token prefixes + a
+unique suffix) — the workload the paged KV cache's radix-tree prefix
+reuse is built for; `--no-prefix-cache` is the A/B baseline on the same
+trace.
 
 `python benchmarks/serve_bench.py --help` for knobs; the defaults are a
 CPU-safe tiny-llama smoke. `run_offered_load` is importable — the tier-1
@@ -33,10 +40,13 @@ import time
 def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       max_len: int = 128, prefill_chunk: int = 16,
                       max_queue: int = 64, seed: int = 0,
-                      metrics_port: int | None = None):
+                      metrics_port: int | None = None,
+                      page_size: int = 16, prefix_cache: bool = True):
     """A small engine on the named family (tiny config, fresh params).
     `metrics_port` turns on the engine's Prometheus endpoint (0 binds an
-    ephemeral port, reported on `engine.metrics_server.port`)."""
+    ephemeral port, reported on `engine.metrics_server.port`);
+    `prefix_cache=False` keeps the paged cache but disables cross-request
+    prefix reuse (the A/B baseline for the shared-prefix workload)."""
     import jax
     import jax.numpy as jnp
 
@@ -56,6 +66,7 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
     ec = EngineConfig(num_slots=num_slots, max_len=max_len,
                       prefill_chunk=prefill_chunk, max_queue=max_queue,
                       cache_dtype=jnp.bfloat16, seed=seed,
+                      page_size=page_size, prefix_cache=prefix_cache,
                       metrics_port=metrics_port)
     return Engine(family, cfg, params, ec), cfg
 
@@ -71,20 +82,36 @@ def run_offered_load(
     deadline_s: float | None = None,
     seed: int = 0,
     warmup_requests: int = 1,
+    prefix_pool: int = 0,
+    prefix_len: int = 0,
 ) -> dict:
     """Drive `num_requests` Poisson arrivals at `rate_hz` through the
     engine; returns the flat metrics summary plus load parameters.
 
     `warmup_requests` run to completion first (compile + first dispatch)
     and are excluded from the reported distributions.
+
+    With `prefix_pool`/`prefix_len` set, prompts model shared-prefix
+    traffic (system prompts, few-shot headers): each prompt is a prefix
+    sampled from a pool of `prefix_pool` fixed `prefix_len`-token
+    prefixes, plus a unique suffix drawn from `prompt_len`. The summary
+    then carries `prefix_hit_rate` and `cached_token_fraction` from the
+    engine's prefix-cache counters.
     """
     import numpy as np
 
     rng = np.random.default_rng(seed)
+    prefixes = [rng.integers(0, vocab_size, (prefix_len,)).astype(np.int32)
+                for _ in range(prefix_pool)] if prefix_pool and prefix_len \
+        else []
 
     def make_prompt():
         n = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
-        return rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        suffix = rng.integers(0, vocab_size, (n,)).astype(np.int32)
+        if not prefixes:
+            return suffix
+        return np.concatenate(
+            [prefixes[int(rng.integers(len(prefixes)))], suffix])
 
     def budget():
         return int(rng.integers(max_new_tokens[0], max_new_tokens[1] + 1))
@@ -115,6 +142,9 @@ def run_offered_load(
         "num_requests": float(num_requests),
         "wall_s": round(time.perf_counter() - start, 3),
     })
+    if prefixes:
+        out.update({"prefix_pool": float(prefix_pool),
+                    "prefix_len": float(prefix_len)})
     return out
 
 
@@ -131,14 +161,32 @@ def main() -> None:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--deadline-s", type=float, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prefix-pool", type=int, default=0,
+                   help="shared-prefix workload: number of distinct "
+                        "prefixes prompts draw from (0 = off)")
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="tokens per shared prefix; prompts become "
+                        "prefix + unique suffix")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="KV pool page size (prefix reuse is page-granular)")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable cross-request prefix reuse (paged cache "
+                        "kept) — the A/B baseline")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics while the load runs "
                         "(0 = ephemeral port, printed to stderr)")
     args = p.parse_args()
 
+    # a shared-prefix workload must fit prefix + suffix + budget in a
+    # slot; grow max_len rather than silently rejecting every request
+    max_len = args.max_len
+    if args.prefix_pool and args.prefix_len:
+        max_len = max(max_len, args.prefix_len + args.prompt_len[1]
+                      + args.max_new_tokens[1])
     engine, cfg = build_tiny_engine(
-        args.family, num_slots=args.slots, max_len=args.max_len,
+        args.family, num_slots=args.slots, max_len=max_len,
         prefill_chunk=args.prefill_chunk, seed=args.seed,
+        page_size=args.page_size, prefix_cache=not args.no_prefix_cache,
         metrics_port=args.metrics_port)
     if engine.metrics_server is not None:
         import sys
@@ -150,7 +198,8 @@ def main() -> None:
         rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),
         max_new_tokens=tuple(args.max_new_tokens),
         temperature=args.temperature, deadline_s=args.deadline_s,
-        seed=args.seed)
+        seed=args.seed, prefix_pool=args.prefix_pool,
+        prefix_len=args.prefix_len)
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(summary.get("tokens_per_sec", 0.0), 2),

@@ -51,8 +51,11 @@ def test_bench_emits_one_json_line_when_tpu_hangs():
     lives under extra.cpu_smoke_tokens_per_sec."""
     # pytest's conftest exports JAX_PLATFORMS=cpu, which bench.py treats
     # as a deliberate operator pin (-> "skipped"); clear it so this test
-    # exercises the hang->error path the driver would hit
-    env = {**os.environ, "BENCH_TPU_TIMEOUT": "3", "JAX_PLATFORMS": ""}
+    # exercises the hang->error path the driver would hit. The serving
+    # phase rows are exercised by the stubbed tests below — skipping them
+    # here keeps this end-to-end run inside its timeout.
+    env = {**os.environ, "BENCH_TPU_TIMEOUT": "3", "JAX_PLATFORMS": "",
+           "BENCH_SERVING": "0"}
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         env=env, capture_output=True, text=True, timeout=600,
@@ -102,10 +105,26 @@ def test_bench_serving_row_shape():
         assert row[field] > 0, row
 
 
+def test_bench_serving_prefix_row_shape():
+    """The shared-prefix row (ISSUE 5): hit rate and cached-token
+    fraction next to the latency percentiles — a reuse regression shows
+    up as prefix_hit_rate 0 in the bench line. Tiny parameters keep this
+    tier-1-safe."""
+    bench = _load_bench()
+    row = bench._serving_prefix_row(num_requests=6, prefix_pool=2,
+                                    prefix_len=16, page_size=8)
+    assert row["requests_finished"] == 6
+    assert row["prefix_hit_rate"] > 0
+    assert row["cached_token_fraction"] > 0
+    assert row["prefill_chunks"] > 0
+    assert row["tokens_per_sec"] > 0
+
+
 def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
     """ADVICE r4: an operator who exported JAX_PLATFORMS=cpu must not pay
     the TPU hang budget. Behavioral: run main() with subprocess stubbed —
-    exactly ONE child may be spawned, pinned to CPU and marked skipped
+    every spawned child (the train fallback AND the per-phase serving
+    children) must be pinned to CPU; the train child is marked skipped
     (not error: a deliberate pin is not an outage)."""
     bench = _load_bench()
     calls = []
@@ -125,8 +144,78 @@ def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
     monkeypatch.setenv("JAX_PLATFORMS", "cpu")
     monkeypatch.delenv("BENCH_CHILD", raising=False)
     bench.main()
-    assert len(calls) == 1, "TPU child must not be spawned under a cpu pin"
-    assert calls[0]["JAX_PLATFORMS"] == "cpu"
-    assert calls[0]["BENCH_TPU_SKIPPED"] == "1"
+    train = [e for e in calls if e.get("BENCH_PHASE") == "train"]
+    phases = [e.get("BENCH_PHASE") for e in calls
+              if e.get("BENCH_PHASE") != "train"]
+    assert len(train) == 1, "TPU child must not be spawned under a cpu pin"
+    assert train[0]["BENCH_TPU_SKIPPED"] == "1"
+    assert phases == ["serving", "serving_prefix"]
+    assert all(e["JAX_PLATFORMS"] == "cpu" for e in calls)
     line = json.loads(capsys.readouterr().out.strip())
     assert "skipped" in line and "error" not in line
+
+
+def test_hung_phase_is_isolated_to_its_row(monkeypatch, capsys):
+    """BENCH_r05 regression: a wedged device during an extra-row phase
+    must cost that phase only — its row carries "error", the train
+    numbers and the one-line contract survive. Stubbed: the train child
+    succeeds, every phase child 'hangs' (TimeoutExpired)."""
+    bench = _load_bench()
+
+    class FakeOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 123.0, "vs_baseline": 1.0, "unit": "tokens/s/chip",
+            "extra": {"mfu": 0.5}}) + "\n"
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PHASE") != "train":
+            raise bench.subprocess.TimeoutExpired(cmd, timeout)
+        return FakeOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 123.0          # the headline survived
+    assert "error" not in line             # ... unpoisoned
+    assert "hung" in line["extra"]["serving"]["error"]
+    assert "hung" in line["extra"]["serving_prefix"]["error"]
+
+
+def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
+                                                             capsys):
+    """A phase child on the TPU-success path that finds no TPU (tunnel
+    dropped after the train child) must exit 3 and the parent report it in
+    the row's error — never silently attach CPU serving numbers under a
+    TPU headline. Stubbed: the train child succeeds, phase children exit
+    3."""
+    bench = _load_bench()
+
+    class TrainOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 123.0, "vs_baseline": 1.0, "unit": "tokens/s/chip",
+            "extra": {"mfu": 0.5}}) + "\n"
+
+    class NoTpuOut:
+        returncode = 3
+        stderr = ""
+        stdout = ""
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        return TrainOut() if env.get("BENCH_PHASE") == "train" else NoTpuOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 123.0
+    for row in ("serving", "serving_prefix"):
+        assert "no tpu visible" in line["extra"][row]["error"]

@@ -1,0 +1,392 @@
+"""Paged KV cache + radix-tree prefix reuse (serving/cache.py, ISSUE 5).
+
+Host-side contracts (no model): page pool accounting, radix-tree
+match/insert/refcounts, LRU eviction that never touches a mapped page,
+deferred admission under pool exhaustion. Engine contracts (tiny gpt2):
+a prefix-hit request is token-exact vs the cold path with strictly fewer
+prefill chunks, copy-on-write sharing isolates concurrent sharers from
+each other's cancellation/retirement, the compile count stays flat
+across hit/miss/eviction mixes, and strict-mode audits pass on the
+gather/scatter programs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.serving import (
+    Engine,
+    EngineConfig,
+    PagedAllocator,
+    PagedKVCache,
+    PagePool,
+    PrefixIndex,
+    Request,
+    RequestStatus,
+)
+from accelerate_tpu.serving.scheduler import Slot
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _persistent_compile_cache(tmp_path_factory):
+    """Engines here compile the same three tiny programs as
+    tests/test_serving.py; the persistent cache turns repeats into
+    deserializes."""
+    import os
+
+    from accelerate_tpu.utils.environment import configure_compilation_cache
+
+    os.environ.setdefault(
+        "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
+    configure_compilation_cache(
+        str(tmp_path_factory.mktemp("xla_cache")), force=True)
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **overrides):
+    defaults = dict(num_slots=2, max_len=64, prefill_chunk=8, page_size=8,
+                    cache_dtype=jnp.float32)
+    defaults.update(overrides)
+    return Engine(gpt2, cfg, params, EngineConfig(**defaults))
+
+
+def _ref_tokens(cfg, params, prompt, n):
+    out = gpt2.generate(cfg, params, jnp.asarray(prompt)[None, :],
+                        max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _req(tokens, mnt=4):
+    return Request(prompt=np.asarray(tokens, np.int32), max_new_tokens=mnt)
+
+
+def _slot(alloc, req, index=0):
+    s = Slot(index)
+    s.alloc, s.request = alloc, req
+    return s
+
+
+# ---------------------------------------------------------------------------
+# host-side accounting (no model, no jit)
+# ---------------------------------------------------------------------------
+
+
+def test_page_pool_alloc_release_exact():
+    pool = PagePool(4)
+    assert pool.free_count == 4 and pool.used_count == 0
+    got = pool.alloc(3)
+    assert len(got) == 3 and len(set(got)) == 3
+    assert pool.alloc(2) is None          # only 1 left: no partial grants
+    assert pool.free_count == 1           # failed alloc changed nothing
+    pool.release(got)
+    assert pool.free_count == 4
+
+
+def test_prefix_index_match_caps_below_full_prompt():
+    """Reuse never covers the whole prompt: the last token must prefill
+    to produce the first output logits."""
+    idx = PrefixIndex(page_size=4)
+    prompt = np.arange(8, dtype=np.int32)
+    idx.insert(prompt, [0, 1], 2)         # both full pages cached
+    assert len(idx.match(prompt)) == 1    # (8-1)//4 = 1, not 2
+    longer = np.arange(9, dtype=np.int32)
+    assert [n.page for n in idx.match(longer)] == [0, 1]
+
+
+def test_prefix_index_insert_dedupes_concurrent_equal_chunks():
+    idx = PrefixIndex(page_size=4)
+    prompt = np.arange(8, dtype=np.int32)
+    assert idx.insert(prompt, [0, 1], 2) == []
+    # a second request computed the same prefix into different pages:
+    # the tree keeps the first copy, the duplicates come back to free
+    assert idx.insert(prompt, [5, 6], 2) == [5, 6]
+    assert idx.cached_pages == 2
+
+
+def test_allocator_lru_eviction_never_evicts_mapped_pages():
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0)
+    A = _req(list(range(100, 112)), mnt=0)    # 3 pages
+    B = _req(list(range(200, 212)), mnt=0)    # 3 pages
+    for r in (A, B):
+        al.release(_slot(al.allocate(r), r), finished=True)
+    assert al.index.cached_pages == 6 and al.pages_free == 2
+    # touch A (now most-recent AND mapped), then demand 4 cold pages:
+    # only B's pages are evictable, leaf-first, oldest-first
+    a2 = al.allocate(_req(list(range(100, 112)) + [7], mnt=0))
+    assert a2.reused_len == 12
+    c = al.allocate(_req(list(range(300, 316)), mnt=0))
+    assert c is not None and c.reused_len == 0
+    assert al.evictions == 3                  # exactly B's three pages
+    assert all(n.parent is not None for n in a2.nodes)  # A survived
+
+
+def test_allocator_defers_admission_until_pages_free():
+    al = PagedAllocator(page_size=4, num_pages=4, pad_slack=0)
+    D = _req(list(range(16)), mnt=0)          # takes the whole pool
+    d = al.allocate(D)
+    assert d is not None
+    E = _req(list(range(50, 62)), mnt=0)
+    assert al.allocate(E) is None             # mapped pages: unevictable
+    al.release(_slot(d, D), finished=True)    # retire -> pages cached
+    e = al.allocate(E)                        # now evictable
+    assert e is not None and al.evictions == 3
+
+
+def test_failed_admission_evicts_nothing():
+    """evict_lru is all-or-nothing: when even full eviction cannot cover
+    the queue head, the cached prefixes survive untouched — a too-big
+    request waiting in queue must not strip reuse from everyone else."""
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0)
+    A = _req(list(range(100, 112)), mnt=0)    # 3 pages, caches 3
+    al.release(_slot(al.allocate(A), A), finished=True)
+    B = _req(list(range(200, 212)), mnt=0)    # 3 more pages mapped
+    b = al.allocate(B)
+    assert b is not None                      # free: 8 - 3 - 3 = 2
+    big = _req(list(range(300, 324)), mnt=0)  # needs 6 > 2 free + 3 cached
+    assert al.index.mapped_pages == 0         # B's pages are all private
+    assert al.allocate(big) is None
+    assert al.evictions == 0                  # nothing was destroyed
+    assert al.index.cached_pages == 3         # A's prefix still reusable
+    a2 = al.allocate(_req(list(range(100, 113)), mnt=0))
+    assert a2 is not None and a2.reused_len == 12
+    assert al.index.mapped_pages == 3         # the evictable-count books
+
+
+def test_allocator_cancel_caches_nothing():
+    """A cancelled request's pages may hold garbage mid-prefill: they go
+    to the free list, never into the tree."""
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0)
+    A = _req(list(range(16)), mnt=0)
+    a = al.allocate(A)
+    al.release(_slot(a, A), finished=False)
+    assert al.index.cached_pages == 0 and al.pages_free == 8
+
+
+def test_allocator_prefix_cache_off_is_always_cold():
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0,
+                        prefix_cache=False)
+    A = _req(list(range(16)), mnt=0)
+    al.release(_slot(al.allocate(A), A), finished=True)
+    assert al.index.cached_pages == 0
+    assert al.allocate(A).reused_len == 0
+    assert al.hits == 0
+
+
+def test_paged_cache_shapes_and_pytree():
+    cache = PagedKVCache.create(num_layers=2, num_slots=3, max_len=16,
+                                num_kv_heads=4, head_dim=8,
+                                dtype=jnp.float32, page_size=8, pad_slack=4)
+    # ceil((16+4)/8) = 3 pages/slot, default pool 9 pages + 1 trash
+    assert cache.pages_per_slot == 3 and cache.num_pages == 9
+    assert cache.k.shape == (2, 10, 8, 4, 8)
+    assert cache.rows == 24 and cache.trash_page == 9
+    leaves, treedef = jax.tree_util.tree_flatten(cache)
+    assert len(leaves) == 3
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.page_size == 8 and rebuilt.pages_per_slot == 3
+    with pytest.raises(ValueError):
+        PagedKVCache.create(2, 3, 16, 4, 8, page_size=8, num_pages=1)
+
+
+# ---------------------------------------------------------------------------
+# engine contracts
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_is_token_exact_and_skips_prefill(gpt2_setup):
+    """The acceptance contract: a request sharing a cached prefix decodes
+    token-identically to the cold path while running strictly fewer
+    prefill chunks, through the same three compiled programs."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params)
+    rng = np.random.default_rng(0)
+    prefix = rng.integers(0, cfg.vocab_size, (24,)).astype(np.int32)
+    p1 = np.concatenate([prefix,
+                         rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)])
+    p2 = np.concatenate([prefix,
+                         rng.integers(0, cfg.vocab_size, (6,)).astype(np.int32)])
+    r1 = eng.submit(p1, max_new_tokens=6)
+    eng.run_until_idle()
+    cold_chunks = eng.metrics.prefill_chunks
+    r2 = eng.submit(p2, max_new_tokens=6)
+    eng.run_until_idle()
+    warm_chunks = eng.metrics.prefill_chunks - cold_chunks
+    assert r1.tokens == _ref_tokens(cfg, params, p1, 6)
+    assert r2.tokens == _ref_tokens(cfg, params, p2, 6)
+    assert eng.metrics.prefix_hits == 1
+    assert eng.metrics.prefix_tokens_reused == 24
+    assert warm_chunks < cold_chunks
+    assert eng.compile_stats() == {"admit": 1, "prefill": 1, "decode": 1}
+
+
+def test_cow_sharers_isolated_under_cancel_and_retire(gpt2_setup):
+    """Two live requests mapping the same cached prefix pages: cancelling
+    one (and letting the other retire first/later) never perturbs the
+    survivor's tokens — shared pages are refcounted, never written, and a
+    release only frees PRIVATE pages."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params)
+    rng = np.random.default_rng(1)
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+
+    def with_suffix(n):
+        return np.concatenate(
+            [prefix, rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)])
+
+    warm = eng.submit(with_suffix(4), max_new_tokens=2)
+    eng.run_until_idle()                      # prefix pages now cached
+    # equal suffix lengths + budgets keep the reference `generate` to
+    # ONE compiled shape across c and d (tier-1 budget)
+    pb, pc = with_suffix(5), with_suffix(7)
+    b = eng.submit(pb, max_new_tokens=10)
+    c = eng.submit(pc, max_new_tokens=10)
+    for _ in range(6):                        # both mid-flight, sharing
+        eng.step()
+    assert eng.metrics.prefix_hits == 2
+    assert eng.cancel(b)
+    eng.run_until_idle()
+    assert b.status is RequestStatus.CANCELLED
+    assert c.status is RequestStatus.FINISHED
+    assert c.tokens == _ref_tokens(cfg, params, pc, 10)
+    # and the prefix is STILL reusable after both sharers are gone
+    pd = with_suffix(7)
+    d = eng.submit(pd, max_new_tokens=10)
+    eng.run_until_idle()
+    assert eng.metrics.prefix_hits == 3
+    assert d.tokens == _ref_tokens(cfg, params, pd, 10)
+
+
+def test_eviction_under_pool_pressure_stays_exact(gpt2_setup):
+    """A pool sized below the cached working set forces LRU evictions;
+    outputs stay exact and no compiled program is added."""
+    cfg, params = gpt2_setup
+    # pool at the floor (pages_per_slot = ceil((64+8)/8) = 9): each
+    # 40-token prompt needs ceil((40+4+8)/8) = 7 pages but a retired one
+    # caches 5, so every later admission must evict. Equal lengths keep
+    # the reference `generate` to ONE compiled shape (tier-1 budget).
+    eng = _engine(cfg, params, num_pages=9)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32)
+               for _ in range(3)]
+    for p in prompts:
+        r = eng.submit(p, max_new_tokens=4)
+        eng.run_until_idle()
+        assert r.tokens == _ref_tokens(cfg, params, p, 4)
+    assert eng.metrics.page_evictions > 0
+    assert eng.compile_stats() == {"admit": 1, "prefill": 1, "decode": 1}
+    s = eng.metrics_summary()
+    assert s["page_evictions"] > 0
+    assert s["pages_in_use"] + s["pages_free"] == 9
+
+
+def test_compile_count_flat_across_hit_miss_eviction_mix(gpt2_setup):
+    """The PR 2 guard extended per ISSUE 5: shared-prefix hits, cold
+    misses, and eviction churn are all DATA — page tables and reused
+    lengths are traced, so the program count never moves."""
+    cfg, params = gpt2_setup
+    # pool at the floor (pages_per_slot = 9): the evictor wave MUST churn
+    eng = _engine(cfg, params, num_pages=9)
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    waves = [
+        np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (4,))
+                        .astype(np.int32)]),               # cold prefix
+        np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (9,))
+                        .astype(np.int32)]),               # hit
+        rng.integers(0, cfg.vocab_size, (40,)).astype(np.int32),  # evictor
+        np.concatenate([prefix, rng.integers(0, cfg.vocab_size, (2,))
+                        .astype(np.int32)]),               # re-miss or hit
+    ]
+    for wave, (p, temp) in enumerate(zip(waves, (0.0, 1.0, 0.0, 0.7))):
+        r = eng.submit(p, max_new_tokens=3, temperature=temp)
+        eng.run_until_idle()
+        assert r.status is RequestStatus.FINISHED
+        counts = eng.compile_stats()
+        assert counts == {"admit": 1, "prefill": 1, "decode": 1}, (
+            f"wave {wave} recompiled: {counts}")
+    assert eng.metrics.prefix_hits >= 1
+    assert eng.metrics.page_evictions > 0
+
+
+def test_strict_error_passes_on_paged_programs(gpt2_setup):
+    """Acceptance: EngineConfig(strict="error") audits the paged
+    gather/scatter programs (admit/prefill/decode) clean — page-axis
+    gathers are data movement, not collectives — including on the
+    prefix-hit path."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, strict="error")
+    rng = np.random.default_rng(4)
+    p = rng.integers(0, cfg.vocab_size, (20,)).astype(np.int32)
+    r1 = eng.submit(p, max_new_tokens=4)
+    eng.run_until_idle()
+    r2 = eng.submit(np.concatenate([p, [7, 8]]).astype(np.int32),
+                    max_new_tokens=4)
+    eng.run_until_idle()
+    assert r1.status is RequestStatus.FINISHED
+    assert r2.status is RequestStatus.FINISHED
+    assert eng.metrics.prefix_hits == 1
+    assert float(eng.registry.counter("analysis_findings_total").value) == 0
+
+
+def test_prefix_reuse_vs_no_reuse_same_trace(gpt2_setup):
+    """The serve_bench A/B, deterministically: the same prompt trace
+    through a reuse engine and a prefix_cache=False engine yields
+    token-identical outputs with strictly fewer prefill chunks (and a
+    hit rate > 0) on the reuse side."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(5)
+    pool = [rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+            for _ in range(2)]
+    trace = [np.concatenate(
+        [pool[int(rng.integers(2))],
+         rng.integers(0, cfg.vocab_size, (int(rng.integers(2, 6)),))
+         .astype(np.int32)]) for _ in range(8)]
+
+    results = {}
+    for reuse in (True, False):
+        eng = _engine(cfg, params, prefix_cache=reuse)
+        reqs = [eng.submit(p, max_new_tokens=4) for p in trace]
+        eng.run_until_idle()
+        assert all(r.status is RequestStatus.FINISHED for r in reqs)
+        results[reuse] = ([r.tokens for r in reqs],
+                          eng.metrics.prefill_chunks,
+                          eng.metrics_summary().get("prefix_hit_rate", 0.0))
+    tokens_reuse, chunks_reuse, hit_rate = results[True]
+    tokens_cold, chunks_cold, _ = results[False]
+    assert tokens_reuse == tokens_cold
+    assert hit_rate > 0
+    assert chunks_reuse < chunks_cold, (chunks_reuse, chunks_cold)
+
+
+def test_prometheus_exposition_carries_page_and_prefix_series(gpt2_setup):
+    """The new pool gauges and prefix counters ride the same per-engine
+    registry the exporter serves."""
+    import urllib.request
+
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, metrics_port=0)
+    try:
+        rng = np.random.default_rng(6)
+        p = rng.integers(0, cfg.vocab_size, (12,)).astype(np.int32)
+        eng.submit(p, max_new_tokens=3)
+        eng.run_until_idle()
+        eng.submit(np.concatenate([p, [1]]).astype(np.int32),
+                   max_new_tokens=3)
+        eng.run_until_idle()
+        url = f"http://127.0.0.1:{eng.metrics_server.port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        for series in ("serving_pages_in_use", "serving_pages_free",
+                       "serving_prefix_hits_total",
+                       "serving_prefix_tokens_reused_total",
+                       "serving_page_evictions_total"):
+            assert series in body, f"{series} missing from exposition"
+        assert "serving_prefix_hits_total 1.0" in body
+    finally:
+        eng.close()

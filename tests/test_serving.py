@@ -127,20 +127,30 @@ def test_gqa_family_llama_matches_sequential():
 def test_compiled_program_count_flat_across_request_mix(gpt2_setup):
     """Waves of requests with different prompt lengths, token budgets, and
     temperatures never add a compiled program: the request mix is data,
-    not shape."""
+    not shape. Extended for the paged cache (ISSUE 5): a wave of
+    shared-prefix prompts (prefix-cache HITS — reused lengths and remapped
+    page tables are traced data too) rides the same three programs."""
     cfg, params = gpt2_setup
     eng = _engine(cfg, params, num_slots=2, max_len=48)
     rng = np.random.default_rng(3)
-    for wave, (plen, mnt, temp) in enumerate(
-            [(3, 4, 0.0), (13, 2, 1.0), (7, 6, 0.5), (1, 3, 0.0)]):
-        reqs = [eng.submit(_prompt(rng, plen, cfg.vocab_size),
-                           max_new_tokens=mnt, temperature=temp)
-                for _ in range(3)]
+    shared = _prompt(rng, 18, cfg.vocab_size)
+    waves = [(3, 4, 0.0), (13, 2, 1.0), (7, 6, 0.5), (1, 3, 0.0),
+             ("shared", 3, 0.0), ("shared", 3, 1.0)]
+    for wave, (plen, mnt, temp) in enumerate(waves):
+        if plen == "shared":
+            prompts = [np.concatenate(
+                [shared, _prompt(rng, 2 + i, cfg.vocab_size)])
+                for i in range(3)]
+        else:
+            prompts = [_prompt(rng, plen, cfg.vocab_size) for _ in range(3)]
+        reqs = [eng.submit(p, max_new_tokens=mnt, temperature=temp)
+                for p in prompts]
         eng.run_until_idle()
         assert all(r.status is RequestStatus.FINISHED for r in reqs)
         counts = eng.compile_stats()
         assert counts == {"admit": 1, "prefill": 1, "decode": 1}, (
             f"wave {wave} recompiled: {counts}")
+    assert eng.metrics.prefix_hits >= 2  # the shared waves actually hit
 
 
 # ---------------------------------------------------------------------------
