@@ -19,6 +19,7 @@ SUBCOMMAND_MODULES = [
     "accelerate_tpu.commands.tpu",
     "accelerate_tpu.commands.cloud",
     "accelerate_tpu.commands.lint",
+    "accelerate_tpu.commands.serve",
 ]
 
 

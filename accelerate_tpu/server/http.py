@@ -1,0 +1,460 @@
+"""The HTTP/1.1 front door: routing, SSE streaming, overload, shutdown.
+
+stdlib asyncio streams only — the repo's no-new-dependencies rule covers
+the server too, and an inference front door needs exactly five routes:
+
+    POST /v1/completions         OpenAI completions (+ SSE streaming)
+    POST /v1/chat/completions    OpenAI chat (+ SSE streaming)
+    GET  /v1/models              the one served model
+    GET  /healthz                readiness (503 on drain / fired watchdog)
+    GET  /metrics                Prometheus text from the engine registry
+
+Contracts the tests pin:
+
+- malformed JSON and oversized bodies/prompts return structured 4xx
+  (OpenAI error envelope) without the scheduler ever seeing them;
+- a scheduler shed/reject surfaces as 429 with a Retry-After header (the
+  scheduler's own drain estimate) — overload is an answer, not a hang;
+- a client disconnect mid-SSE-stream cancels the engine request at the
+  next flush, freeing its slot and pages for the requests still paying;
+- `stop()` is a graceful drain: the listener closes first, in-flight
+  requests get `drain_timeout_s` to finish, stragglers are cancelled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import time
+from typing import Awaitable, Callable
+
+from ..telemetry.export import render_prometheus
+from .config import ServerConfig
+from .protocol import (
+    SSE_DONE,
+    ProtocolError,
+    chat_chunk,
+    chat_response,
+    completion_chunk,
+    completion_response,
+    error_body,
+    parse_chat_request,
+    parse_completion_request,
+    sse_event,
+    usage_block,
+)
+from .service import InferenceService, OverloadedError
+
+__all__ = ["HttpFrontDoor"]
+
+_REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
+            404: "Not Found", 405: "Method Not Allowed",
+            408: "Request Timeout", 413: "Payload Too Large",
+            429: "Too Many Requests", 500: "Internal Server Error",
+            503: "Service Unavailable", 504: "Gateway Timeout"}
+
+_MAX_HEADER_BYTES = 32 * 1024
+
+
+class _BadRequest(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class _Choice:
+    """Per-candidate assembly: incremental detokenization plus stop-
+    sequence holdback (the last `max_stop-1` chars stay buffered until
+    the choice finishes, so a stop string split across two decode steps
+    still stops — and is never half-emitted)."""
+
+    def __init__(self, tokenizer, stops: list[str]):
+        self.detok = tokenizer.incremental()
+        self.stops = stops
+        self.holdback = max((len(s) for s in stops), default=1) - 1
+        self.text = ""          # full decoded text (pre-truncation)
+        self.emitted = 0        # chars already sent to the client
+        self.token_ids: list[int] = []
+        self.stopped = False
+
+    def push(self, ids: list[int]) -> str:
+        """Fold new token ids in; returns the text delta now safe to
+        emit ("" while held back)."""
+        self.token_ids.extend(ids)
+        if self.stopped:
+            return ""
+        self.text += self.detok.push(ids)
+        for s in self.stops:
+            at = self.text.find(s)
+            if at != -1:
+                self.text = self.text[:at]
+                self.stopped = True
+                break
+        limit = len(self.text) if self.stopped \
+            else max(self.emitted, len(self.text) - self.holdback)
+        delta = self.text[self.emitted:limit]
+        self.emitted = limit
+        return delta
+
+    def finish(self) -> str:
+        """Flush the detokenizer tail + any held-back text."""
+        if not self.stopped:
+            self.text += self.detok.flush()
+        delta = self.text[self.emitted:]
+        self.emitted = len(self.text)
+        return delta
+
+
+class HttpFrontDoor:
+    """The server object: `await start()`, serve, `await stop()`."""
+
+    def __init__(self, service: InferenceService,
+                 config: ServerConfig | None = None):
+        self.service = service
+        self.config = config or service.config
+        self._server: asyncio.base_events.Server | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self._req_ids = itertools.count(1)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def port(self) -> int | None:
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> "HttpFrontDoor":
+        await self.service.start()
+        self._server = await asyncio.start_server(
+            self._on_connection, self.config.host, self.config.port)
+        return self
+
+    async def stop(self) -> None:
+        """Graceful drain: close the listener, give in-flight requests
+        the drain budget, cancel the rest, then stop the engine."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        self.service.draining = True
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        while self._inflight and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        for task in list(self._inflight):
+            task.cancel()
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
+        await self.service.stop()
+
+    async def serve_forever(self) -> None:
+        async with self._server:
+            await self._server.serve_forever()
+
+    # -- connection handling -------------------------------------------------
+
+    def _on_connection(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._handle(reader, writer))
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _read_request(self, reader) -> tuple[str, str, dict, bytes]:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except asyncio.LimitOverrunError:
+            # the StreamReader buffer limit tripped before our own header
+            # cap could: still a structured 413, not a silent close
+            raise _BadRequest(413, "headers too large")
+        if len(head) > _MAX_HEADER_BYTES:
+            raise _BadRequest(413, "headers too large")
+        lines = head.decode("latin-1").split("\r\n")
+        try:
+            method, target, _version = lines[0].split(" ", 2)
+        except ValueError:
+            raise _BadRequest(400, f"malformed request line {lines[0]!r}")
+        headers: dict[str, str] = {}
+        for line in lines[1:]:
+            if not line:
+                continue
+            name, sep, value = line.partition(":")
+            if not sep:
+                raise _BadRequest(400, f"malformed header {line!r}")
+            headers[name.strip().lower()] = value.strip()
+        length_raw = headers.get("content-length", "0")
+        try:
+            length = int(length_raw)
+        except ValueError:
+            raise _BadRequest(400, f"bad Content-Length {length_raw!r}")
+        if length < 0:
+            raise _BadRequest(400, "negative Content-Length")
+        if length > self.config.max_body_bytes:
+            # refuse WITHOUT buffering: the body is read in chunks and
+            # dropped (never held in memory) so the 413 is delivered
+            # cleanly — closing with the body unread would RST the
+            # connection before the client sees the error envelope
+            left = length
+            while left > 0:
+                chunk = await reader.read(min(left, 1 << 16))
+                if not chunk:
+                    break
+                left -= len(chunk)
+            raise _BadRequest(413, f"body exceeds {self.config.max_body_bytes}"
+                              " bytes")
+        body = await reader.readexactly(length) if length else b""
+        return method, target.split("?")[0], headers, body
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            try:
+                method, path, headers, body = await asyncio.wait_for(
+                    self._read_request(reader), timeout=30.0)
+            except _BadRequest as e:
+                await self._send_json(writer, e.status,
+                                      error_body(str(e)))
+                return
+            except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                    ConnectionError):
+                return  # the client never finished a request
+            await self._route(writer, method, path, headers, body)
+        except ConnectionError:
+            pass  # disconnects are handled at the streaming sites
+        except Exception as e:  # a handler bug must answer 500, not hang
+            try:
+                await self._send_json(
+                    writer, 500,
+                    error_body(f"{type(e).__name__}: {e}", "server_error"))
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, writer, method: str, path: str, headers: dict,
+                     body: bytes) -> None:
+        handler: Callable[..., Awaitable] | None = None
+        if path == "/healthz":
+            handler = self._handle_health
+        elif path == "/metrics":
+            handler = self._handle_metrics
+        elif path == "/v1/models":
+            handler = self._handle_models
+        elif path in ("/v1/completions", "/v1/chat/completions"):
+            if method != "POST":
+                await self._send_json(writer, 405, error_body(
+                    f"{method} not allowed; use POST"))
+                return
+            await self._handle_generate(writer, path, headers, body)
+            return
+        if handler is None:
+            await self._send_json(writer, 404,
+                                  error_body(f"unknown route {path!r}"))
+            return
+        if method != "GET":
+            await self._send_json(writer, 405,
+                                  error_body(f"{method} not allowed"))
+            return
+        await handler(writer)
+
+    # -- response writing ----------------------------------------------------
+
+    async def _send_head(self, writer, status: int, content_type: str,
+                         extra: dict | None = None,
+                         length: int | None = None) -> None:
+        reason = _REASONS.get(status, "Unknown")
+        lines = [f"HTTP/1.1 {status} {reason}",
+                 f"Content-Type: {content_type}",
+                 "Connection: close"]
+        if length is not None:
+            lines.append(f"Content-Length: {length}")
+        for k, v in (extra or {}).items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        await writer.drain()
+
+    async def _send_raw(self, writer, status: int, body: bytes,
+                        content_type: str,
+                        extra: dict | None = None) -> None:
+        await self._send_head(writer, status, content_type, extra,
+                              length=len(body))
+        writer.write(body)
+        await writer.drain()
+
+    async def _send_json(self, writer, status: int, payload: dict,
+                         extra: dict | None = None) -> None:
+        await self._send_raw(writer, status,
+                             json.dumps(payload).encode(),
+                             "application/json", extra)
+
+    # -- plumbing routes -----------------------------------------------------
+
+    async def _handle_health(self, writer) -> None:
+        ok, reason = self.service.health()
+        await self._send_json(writer, 200 if ok else 503,
+                              {"status": "ok" if ok else "unavailable",
+                               "reason": reason})
+
+    async def _handle_metrics(self, writer) -> None:
+        text = render_prometheus(self.service.engine.registry)
+        await self._send_raw(writer, 200, text.encode(),
+                             "text/plain; version=0.0.4; charset=utf-8")
+
+    async def _handle_models(self, writer) -> None:
+        await self._send_json(writer, 200, {
+            "object": "list",
+            "data": [{"id": self.config.model_id, "object": "model",
+                      "created": 0, "owned_by": "accelerate-tpu"}],
+        })
+
+    # -- generation ----------------------------------------------------------
+
+    async def _handle_generate(self, writer, path: str, headers: dict,
+                               body: bytes) -> None:
+        chat = path.endswith("/chat/completions")
+        rid = f"{'chatcmpl' if chat else 'cmpl'}-{next(self._req_ids)}"
+        created = int(time.time())
+        try:
+            try:
+                parsed = json.loads(body)
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise ProtocolError(400, f"invalid JSON body: {e}")
+            max_ctx = self.service.engine.engine_config.max_len
+            params = (parse_chat_request if chat
+                      else parse_completion_request)(
+                parsed, max_ctx, self.config.default_max_tokens)
+            tenant = self.service.resolve_tenant(
+                headers.get("x-tenant"), params.user)
+            reqs = self.service.submit(params, tenant)
+        except OverloadedError as e:
+            await self._send_json(
+                writer, e.status, e.body(),
+                extra=self._retry_after(e.retry_after_s))
+            return
+        except ProtocolError as e:
+            await self._send_json(writer, e.status, e.body())
+            return
+        model = self.config.model_id
+        try:
+            if params.stream:
+                await self._stream_response(writer, rid, model, created,
+                                            params, reqs, chat)
+            else:
+                await self._unary_response(writer, rid, model, created,
+                                           params, reqs, chat)
+        except OverloadedError as e:
+            await self._send_json(writer, e.status, e.body(),
+                                  extra=self._retry_after(e.retry_after_s))
+        except ProtocolError as e:
+            await self._send_json(writer, e.status, e.body())
+        except ConnectionError:
+            # the client went away mid-generation: release the slots and
+            # pages its requests were holding — other tenants are queued
+            self.service.cancel(reqs)
+
+    @staticmethod
+    def _retry_after(retry_after_s: float | None) -> dict:
+        if retry_after_s is None:
+            return {}
+        return {"Retry-After": f"{max(retry_after_s, 0.05):.3f}"}
+
+    def _rank(self, params, reqs):
+        """best_of ranking: the n best candidates by the documented
+        heuristic (longest completion, ties to lower index)."""
+        if params.best_of <= params.n:
+            return reqs
+        order = sorted(range(len(reqs)),
+                       key=lambda i: (-len(reqs[i].tokens), i))
+        return [reqs[i] for i in order[:params.n]]
+
+    async def _unary_response(self, writer, rid, model, created, params,
+                              reqs, chat: bool) -> None:
+        await self.service.wait_all(reqs)
+        chosen = self._rank(params, reqs)
+        tokenizer = self.service.tokenizer
+        choices = []
+        prompt_tokens = chosen[0].prompt_len if chosen else 0
+        completion_tokens = 0
+        for idx, req in enumerate(chosen):
+            choice = _Choice(tokenizer, params.stop)
+            choice.push(list(req.tokens))
+            choice.finish()
+            completion_tokens += len(req.tokens)
+            reason = "stop" if choice.stopped \
+                else self.service.finish_reason(req)
+            text = choice.text
+            if params.echo and not chat:
+                text = tokenizer.decode(list(req.prompt)) + text
+            if chat:
+                choices.append({
+                    "index": idx,
+                    "message": {"role": "assistant", "content": text,
+                                "token_ids": choice.token_ids},
+                    "finish_reason": reason})
+            else:
+                choices.append({
+                    "index": idx, "text": text,
+                    "token_ids": choice.token_ids,
+                    "logprobs": None, "finish_reason": reason})
+        build = chat_response if chat else completion_response
+        await self._send_json(
+            writer, 200,
+            build(rid, model, created, choices,
+                  usage_block(prompt_tokens, completion_tokens)))
+
+    async def _stream_response(self, writer, rid, model, created, params,
+                               reqs, chat: bool) -> None:
+        # hold the 200 until something real exists to stream: a request
+        # shed from the queue BEFORE its first token still gets a clean
+        # 429 + Retry-After (the overload contract must not depend on
+        # whether the client asked to stream)
+        await self.service.await_first(reqs)
+        await self._send_head(writer, 200, "text/event-stream",
+                              {"Cache-Control": "no-cache"})
+        make = chat_chunk if chat else completion_chunk
+        choices = [_Choice(self.service.tokenizer, params.stop)
+                   for _ in reqs]
+        first = [True] * len(reqs)
+        try:
+            async for idx, ids, done in self.service.stream_tokens(reqs):
+                ch = choices[idx]
+                if done:
+                    delta = ch.finish()
+                    reason = "stop" if ch.stopped \
+                        else self.service.finish_reason(reqs[idx])
+                    payload = make(rid, model, created, idx, delta, [],
+                                   reason, **({"first": first[idx]}
+                                              if chat else {}))
+                elif ch.stopped:
+                    continue  # stop string hit earlier; suppress the tail
+                else:
+                    delta = ch.push(ids)
+                    if ch.stopped:
+                        # the answer is complete: retire as FINISHED so
+                        # stream and unary stop-hits count identically
+                        self.service.finish(reqs[idx])
+                    payload = make(rid, model, created, idx, delta, ids,
+                                   None, **({"first": first[idx]}
+                                            if chat else {}))
+                first[idx] = False
+                writer.write(sse_event(payload))
+                # drain() is where a dead client surfaces: the
+                # ConnectionError propagates to _handle_generate, which
+                # cancels every request of this stream
+                await writer.drain()
+            writer.write(SSE_DONE)
+            await writer.drain()
+        except ProtocolError as e:
+            # the SSE head is already on the wire, so a late failure
+            # (engine drive death, mid-wait shed) becomes a terminal SSE
+            # error event — never a second HTTP status line mid-stream
+            self.service.cancel(reqs)
+            writer.write(sse_event(e.body()))
+            writer.write(SSE_DONE)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError) as e:
+            raise ConnectionError(str(e)) from e

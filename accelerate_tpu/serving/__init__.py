@@ -21,7 +21,14 @@ from .cache import (
 )
 from .engine import Engine, EngineConfig
 from .metrics import ServingMetrics
-from .scheduler import Request, RequestStatus, Scheduler, Slot, SlotState
+from .scheduler import (
+    Request,
+    RequestStatus,
+    Scheduler,
+    Slot,
+    SlotState,
+    TenantSpec,
+)
 
 # unambiguous name for the top-level package namespace
 ServingEngine = Engine
@@ -42,4 +49,5 @@ __all__ = [
     "RequestStatus",
     "Slot",
     "SlotState",
+    "TenantSpec",
 ]

@@ -112,6 +112,12 @@ class EngineConfig:
     page_size: int = 16
     num_pages: int | None = None
     prefix_cache: bool = True
+    # multi-tenant scheduling: an iterable/dict of scheduler.TenantSpec
+    # (priority tiers, DRR weights, TTFT SLOs). None = the single
+    # "default" tenant, i.e. plain FIFO — the pre-tenancy behavior.
+    # All of it is host-side policy: the three compiled programs are
+    # identical with or without tenants.
+    tenants: Any = None
     metrics_port: int | None = None
     watchdog_timeout_s: float | None = None
     # strict="warn"|"error" audits each engine program ONCE, at its first
@@ -219,7 +225,9 @@ class Engine:
         )
         self.scheduler = Scheduler(ec.num_slots, ec.max_len,
                                    max_queue=ec.max_queue, clock=clock,
-                                   allocator=self.allocator)
+                                   allocator=self.allocator,
+                                   tenants=ec.tenants,
+                                   prefill_chunk=ec.prefill_chunk)
         # host-side page tables, one row per slot, padded with the trash
         # page: idle/retired lanes gather (and dead-write) only trash
         self._table = np.full(
@@ -335,10 +343,15 @@ class Engine:
         key=None,
         eos_token_id: int | None = None,
         deadline_s: float | None = None,
+        tenant: str = "default",
+        slo_ttft_s: float | None = None,
     ) -> Request:
         """Queue one generation request; returns its handle immediately.
         Overload is reported on the handle (`status` REJECTED with
-        `reject_reason`), never deferred to an OOM."""
+        `reject_reason` and a `retry_after_s` backoff hint), never
+        deferred to an OOM. `tenant` routes the request through that
+        tenant's priority tier / DRR share; `slo_ttft_s` overrides the
+        tenant's TTFT SLO for this request."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -348,6 +361,7 @@ class Engine:
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), key=key,
             eos_token_id=eos_token_id, deadline_s=deadline_s,
+            tenant=tenant, slo_ttft_s=slo_ttft_s,
         )
         # drain first, THEN capacity-check: a slot freed since the last
         # step (or an expired entry still holding a queue position) must
@@ -355,6 +369,10 @@ class Engine:
         # queue bound covers genuinely *waiting* requests only
         self._admit_pending()
         self.scheduler.submit(req)
+        # pressure/displacement victims shed INSIDE submit have no other
+        # path into the metrics — drain them before reporting the newcomer
+        for victim in self.scheduler.drain_shed():
+            self.metrics.observe_request(victim)
         if req.done:
             self.metrics.observe_request(req)
         else:
@@ -365,6 +383,15 @@ class Engine:
 
     def cancel(self, request: Request) -> bool:
         if self.scheduler.cancel(request):
+            self.metrics.observe_request(request)
+            return True
+        return False
+
+    def finish(self, request: Request) -> bool:
+        """Retire a running request as FINISHED before its budget (e.g.
+        a server-side stop sequence matched): counts in the finished/
+        latency metrics, prompt pages cached for reuse."""
+        if self.scheduler.finish_early(request):
             self.metrics.observe_request(request)
             return True
         return False
@@ -409,11 +436,15 @@ class Engine:
         if action is None:
             self.metrics.stopped_at = self._clock()
             return False
+        t0 = self._clock()
         if action[0] == "prefill":
             self._run_prefill_chunk(action[1])
         else:
             self._run_decode(action[1])
         self.metrics.stopped_at = self._clock()
+        # the EMA behind the scheduler's SLO / Retry-After estimates —
+        # host-side bookkeeping only, nothing traced
+        self.scheduler.note_step_time(self.metrics.stopped_at - t0)
         self.metrics.observe_step(self.scheduler.live_slots,
                                   self.engine_config.num_slots,
                                   self.scheduler.queue_depth)
@@ -425,10 +456,13 @@ class Engine:
             pass
 
     def _admit_pending(self) -> None:
-        """Shed expired queued requests, then admit from the queue into
-        free slots."""
+        """Shed expired/doomed queued requests, then admit from the
+        queue into free slots. Observation goes through the scheduler's
+        shed log — the one path that also covers victims shed inside
+        submit() (queue-pressure and tier-displacement sheds)."""
         now = self._clock()
-        for req in self.scheduler.shed_expired(now):
+        self.scheduler.shed_expired(now)
+        for req in self.scheduler.drain_shed():
             self.metrics.observe_request(req)
         for slot, req in self.scheduler.admissions(now):
             self._run_admit(slot, req)
@@ -572,6 +606,10 @@ class Engine:
         # decode_steps restarts from 0, so the log guard must too — a stale
         # value would swallow the first post-reset log point
         self._last_logged = 0
+        # a warmup pass's compile-heavy steps would otherwise keep
+        # inflating the scheduler's step-time EMA (and with it every SLO
+        # floor / Retry-After estimate) long into steady state
+        self.scheduler.step_time_ema = 0.0
 
     def metrics_summary(self) -> dict[str, float]:
         """Flat serving metrics (TTFT/per-token percentiles, occupancy,

@@ -66,6 +66,17 @@ class ServingMetrics:
         self.started_at: float | None = None
         self.stopped_at: float | None = None
 
+    # -- per-tenant labeled series -------------------------------------------
+    # created lazily at first observation, so single-tenant engines keep
+    # exactly the series they always had; the registry's get-or-create
+    # makes repeat lookups cheap and exporter-visible automatically
+
+    def _tenant_hist(self, name: str, tenant: str) -> StreamingHistogram:
+        return self.registry.histogram(name, tenant=tenant)
+
+    def _tenant_counter(self, name: str, tenant: str):
+        return self.registry.counter(name, tenant=tenant)
+
     # counters read back as ints for the summary / engine bookkeeping
     @property
     def finished(self) -> int:
@@ -149,24 +160,50 @@ class ServingMetrics:
                 self.tokens_out / (self.stopped_at - self.started_at))
 
     def observe_request(self, req: Request) -> None:
-        """Fold one terminal request into the aggregates."""
+        """Fold one terminal request into the aggregates — both the
+        engine-wide series and the `{tenant=...}`-labeled copies the
+        per-tier SLO dashboards (and serve_bench --tenants) read."""
+        tenant = getattr(req, "tenant", "default")
         if req.status.value == "finished":
             self._c_finished.inc()
+            self._tenant_counter("serving_requests_finished_total",
+                                 tenant).inc()
             self._c_tokens.inc(len(req.tokens))
             if req.ttft_s is not None:
                 self.ttft_s.record(req.ttft_s)
+                self._tenant_hist("serving_ttft_seconds",
+                                  tenant).record(req.ttft_s)
             if req.admitted_at is not None:
                 self.queue_wait_s.record(req.admitted_at - req.submitted_at)
             # per-token latency: gaps between consecutive decode tokens
             # (TTFT is its own metric; the first gap is excluded)
+            tpot_t = self._tenant_hist("serving_per_token_seconds", tenant)
             for g in np.diff(req.token_times):
                 self.tpot_s.record(float(g))
+                tpot_t.record(float(g))
         elif req.status.value == "cancelled":
             self._c_cancelled.inc()
         elif req.status.value == "rejected":
             self._c_rejected.inc()
+            self._tenant_counter("serving_requests_rejected_total",
+                                 tenant).inc()
         elif req.status.value == "expired":
             self._c_expired.inc()
+            self._tenant_counter("serving_requests_expired_total",
+                                 tenant).inc()
+        # SLO attainment: every terminal request with an SLO gets a
+        # verdict — finished-in-time counts as met; late, shed, and
+        # rejected count as missed. A client cancel BEFORE first token is
+        # excluded (the client walked away; no serving verdict exists).
+        # The attainment a tier reports is met/total from these series.
+        met = req.slo_met
+        if (req.status.value == "cancelled"
+                and req.first_token_at is None):
+            met = None
+        if met is not None:
+            self._tenant_counter("serving_slo_total", tenant).inc()
+            if met:
+                self._tenant_counter("serving_slo_met_total", tenant).inc()
 
     def summary(self) -> dict[str, float]:
         out: dict[str, float] = {
@@ -200,4 +237,29 @@ class ServingMetrics:
                 and self.stopped_at > self.started_at):
             out["tokens_per_sec"] = self.tokens_out / (
                 self.stopped_at - self.started_at)
+        return out
+
+    def tenant_summary(self) -> dict[str, dict[str, float]]:
+        """Per-tenant view built from the labeled series: TTFT/per-token
+        percentiles, terminal counts, and SLO attainment (met/total).
+        Keys are tenant names; only tenants that produced observations
+        appear."""
+        out: dict[str, dict[str, float]] = {}
+        for kind, name, labels, metric in self.registry.items():
+            tenant = dict(labels).get("tenant")
+            if tenant is None:
+                continue
+            row = out.setdefault(tenant, {})
+            if kind == "histogram" and metric.count:
+                base = {"serving_ttft_seconds": "ttft",
+                        "serving_per_token_seconds": "per_token"}.get(name)
+                if base:
+                    row.update(_percentiles(metric, base))
+            elif kind == "counter":
+                short = name.replace("serving_", "").replace("_total", "")
+                row[short] = float(metric.value)
+        for row in out.values():
+            total = row.get("slo", 0.0)
+            if total:
+                row["slo_attainment"] = row.get("slo_met", 0.0) / total
         return out

@@ -109,6 +109,12 @@ class StallWatchdog:
             self._last_tick = self.clock()
             self._fired = False
 
+    @property
+    def stalled(self) -> bool:
+        """True while a stall report has fired and no tick has re-armed —
+        the readiness signal health endpoints degrade on."""
+        return self._fired
+
     def check(self, now: float | None = None) -> dict | None:
         """Fire if the silence exceeded `timeout_s` and we haven't fired
         for this silence yet. Returns the stall report when it fires,

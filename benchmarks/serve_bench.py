@@ -1,4 +1,4 @@
-"""Offered-load serving benchmark for the continuous-batching engine.
+"""Offered-load serving benchmark: engine-direct and through the HTTP door.
 
 Open-loop harness: request arrivals are a seeded Poisson process (the
 offered load), prompts/token budgets draw from seeded ranges, and the
@@ -24,10 +24,23 @@ unique suffix) — the workload the paged KV cache's radix-tree prefix
 reuse is built for; `--no-prefix-cache` is the A/B baseline on the same
 trace.
 
+`--tenants` switches to the MULTI-TENANT HTTP harness (`run_http_load`):
+the real `accelerate_tpu.server` front door is stood up in-process on an
+ephemeral port and per-tenant client fleets drive it over actual HTTP —
+open-loop (Poisson or bursty arrivals at each tenant's `rate`),
+closed-loop (`concurrency` workers per tenant in submit-wait-repeat),
+or `--trace FILE` replay of a recorded arrival schedule. Per-tier
+TTFT/per-token percentiles and SLO attainment come from the server's
+OWN Prometheus /metrics route (the same series a production scrape
+would read), next to client-observed TTFT and 429/shed counts::
+
+  --tenants 'gold:priority=0,weight=4,slo=0.3,rate=10;bronze:rate=40'
+
 `python benchmarks/serve_bench.py --help` for knobs; the defaults are a
-CPU-safe tiny-llama smoke. `run_offered_load` is importable — the tier-1
-bench-contract test drives a miniature load through it in-process, and
-bench.py's serving row reuses it for the one-line JSON contract.
+CPU-safe tiny-llama smoke. `run_offered_load`/`run_http_load` are
+importable — the tier-1 bench-contract tests drive miniature loads
+through them in-process, and bench.py's serving/server rows reuse them
+for the one-line JSON contract.
 """
 
 from __future__ import annotations
@@ -41,7 +54,8 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       max_len: int = 128, prefill_chunk: int = 16,
                       max_queue: int = 64, seed: int = 0,
                       metrics_port: int | None = None,
-                      page_size: int = 16, prefix_cache: bool = True):
+                      page_size: int = 16, prefix_cache: bool = True,
+                      tenants=None):
     """A small engine on the named family (tiny config, fresh params).
     `metrics_port` turns on the engine's Prometheus endpoint (0 binds an
     ephemeral port, reported on `engine.metrics_server.port`);
@@ -67,7 +81,7 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       prefill_chunk=prefill_chunk, max_queue=max_queue,
                       cache_dtype=jnp.bfloat16, seed=seed,
                       page_size=page_size, prefix_cache=prefix_cache,
-                      metrics_port=metrics_port)
+                      metrics_port=metrics_port, tenants=tenants)
     return Engine(family, cfg, params, ec), cfg
 
 
@@ -148,7 +162,338 @@ def run_offered_load(
     return out
 
 
+# ---------------------------------------------------------------------------
+# multi-tenant HTTP harness
+# ---------------------------------------------------------------------------
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text exposition -> {(name, (('k','v'),...)): value}.
+    Minimal on purpose (counters/gauges/summary quantiles as flat
+    samples) — exactly what the attainment report needs."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        metric, _, raw = line.rpartition(" ")
+        name, _, inner = metric.partition("{")
+        labels = ()
+        if inner:
+            pairs = []
+            for part in inner.rstrip("}").split(","):
+                k, _, v = part.partition("=")
+                pairs.append((k.strip(), v.strip().strip('"')))
+            labels = tuple(sorted(pairs))
+        try:
+            out[(name, labels)] = float(raw)
+        except ValueError:
+            continue
+    return out
+
+
+def _prom_tenant(series: dict, name: str, tenant: str,
+                 quantile: str | None = None) -> float | None:
+    want = {("tenant", tenant)}
+    if quantile is not None:
+        want.add(("quantile", quantile))
+    for (n, labels), v in series.items():
+        if n == name and want <= set(labels):
+            return v
+    return None
+
+
+def parse_tenant_load_arg(arg: str):
+    """The harness grammar: TenantSpec fields + per-tenant load fields
+    (`rate` arrivals/s for open loop, `concurrency` workers for closed
+    loop). Returns (specs, {tenant: {"rate":…, "concurrency":…}})."""
+    from accelerate_tpu.server.config import parse_tenants_arg
+
+    return parse_tenants_arg(
+        arg, extra_keys={"rate": float, "concurrency": int})
+
+
+def load_trace(path: str) -> list[dict]:
+    """Arrival-trace replay: JSONL of {"t": offset_s, "tenant": name,
+    "prompt_len": N | "prompt": [ids], "max_new_tokens": M} sorted by t.
+    Recorded once, replayed identically against any scheduler build —
+    the apples-to-apples input for policy A/Bs."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return sorted(rows, key=lambda r: float(r.get("t", 0.0)))
+
+
+def _arrival_offsets(mode: str, rate_hz: float, n: int, rng) -> list[float]:
+    """Open-loop arrival schedule: seeded Poisson, or bursty (the same
+    mean rate delivered as geometric bursts — the overload shape that
+    separates an SLO-aware queue from a FIFO)."""
+    if mode == "poisson":
+        return list(rng.exponential(1.0 / rate_hz, size=n).cumsum())
+    if mode == "burst":
+        out, t, i = [], 0.0, 0
+        while i < n:
+            size = min(int(rng.geometric(0.25)), n - i)
+            out.extend([t] * size)
+            i += size
+            t += size / rate_hz  # mean rate preserved
+        return out
+    raise ValueError(f"unknown arrival mode {mode!r} (poisson|burst)")
+
+
+def run_http_load(
+    engine,
+    vocab_size: int,
+    tenant_specs,
+    tenant_load: dict,
+    num_requests: int = 24,
+    mode: str = "open",
+    arrival: str = "poisson",
+    prompt_len: tuple[int, int] = (4, 24),
+    max_new_tokens: tuple[int, int] = (4, 16),
+    temperature: float = 0.0,
+    seed: int = 0,
+    trace: list[dict] | None = None,
+    model_id: str = "serve-bench",
+) -> dict:
+    """Stand up the real HTTP front door over `engine` (ephemeral port)
+    and drive it with per-tenant client fleets; returns the flat summary
+    with one `tenants.<name>.*` block per tenant, percentiles and SLO
+    attainment sourced from the server's Prometheus /metrics route.
+
+    `mode="open"`: each tenant fires `rate` arrivals/s (`arrival` =
+    poisson|burst) until its share of `num_requests` is sent — queueing
+    delay lands in TTFT, exactly like production. `mode="closed"`:
+    `concurrency` workers per tenant in submit-wait-repeat — the
+    saturation throughput view. `trace` overrides both with a recorded
+    schedule."""
+    import asyncio
+
+    import numpy as np
+
+    from accelerate_tpu.server.config import ServerConfig
+    from accelerate_tpu.server.http import HttpFrontDoor
+    from accelerate_tpu.server.service import InferenceService
+    from accelerate_tpu.server.tokenizer import get_tokenizer
+
+    rng = np.random.default_rng(seed)
+    tenant_names = [t.name for t in tenant_specs] or ["default"]
+
+    # compile the three programs OUTSIDE the measured window, then drop
+    # the warmup samples (and the compile-poisoned step-time EMA the SLO
+    # estimates would otherwise inherit)
+    warm = engine.submit(np.arange(1, 5, dtype=np.int32) % vocab_size,
+                         max_new_tokens=2)
+    engine.run_until_idle()
+    assert warm.status.value == "finished", warm.status
+    engine.reset_metrics()
+
+    def make_prompt_ids():
+        n = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        return rng.integers(0, vocab_size, (n,)).astype(int).tolist()
+
+    def budget():
+        return int(rng.integers(max_new_tokens[0], max_new_tokens[1] + 1))
+
+    cfg = ServerConfig(port=0, model_id=model_id, tokenizer="numeric",
+                       tenants=tuple(tenant_specs))
+    service = InferenceService(engine, get_tokenizer("numeric", vocab_size),
+                               cfg)
+    door = HttpFrontDoor(service, cfg)
+    # client-side books, per tenant
+    obs = {t: {"sent": 0, "ok": 0, "shed_429": 0, "shed_stream": 0,
+               "errors": 0, "client_ttft_s": [], "tokens": 0}
+           for t in tenant_names}
+
+    def _book(tenant: str) -> dict:
+        # trace rows may name tenants outside --tenants (incl. the
+        # implicit "default"); give them books instead of a KeyError
+        return obs.setdefault(
+            tenant, {"sent": 0, "ok": 0, "shed_429": 0, "shed_stream": 0,
+                     "errors": 0, "client_ttft_s": [], "tokens": 0})
+
+    async def one_request(port: int, tenant: str, body: dict) -> None:
+        book = _book(tenant)
+        book["sent"] += 1
+        t0 = time.perf_counter()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            payload = json.dumps(body).encode()
+            writer.write(
+                b"POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
+                + f"X-Tenant: {tenant}\r\n".encode()
+                + f"Content-Length: {len(payload)}\r\n\r\n".encode()
+                + payload)
+            await writer.drain()
+            head = await reader.readuntil(b"\r\n\r\n")
+            status = int(head.split(b" ")[1])
+            if status == 429:
+                book["shed_429"] += 1
+                writer.close()
+                return
+            if status != 200:
+                book["errors"] += 1
+                writer.close()
+                return
+            # SSE: first data frame carrying tokens = client TTFT
+            first_at = None
+            ntok = 0
+            finish = None
+            while True:
+                frame = await reader.readuntil(b"\n\n")
+                if frame.startswith(b"data: [DONE]"):
+                    break
+                row = json.loads(frame[len(b"data: "):])
+                choice = row["choices"][0]
+                ids = (choice.get("token_ids")
+                       or choice.get("delta", {}).get("token_ids") or [])
+                ntok += len(ids)
+                if ids and first_at is None:
+                    first_at = time.perf_counter()
+                finish = choice.get("finish_reason") or finish
+            if first_at is not None:
+                book["client_ttft_s"].append(first_at - t0)
+            book["tokens"] += ntok
+            if finish == "overloaded":
+                # admitted, then shed mid-wait: the stream closed with an
+                # overload verdict instead of tokens
+                book["shed_stream"] += 1
+            else:
+                book["ok"] += 1
+            writer.close()
+        except (ConnectionError, asyncio.IncompleteReadError, OSError):
+            book["errors"] += 1
+
+    def body_for(tenant: str, prompt=None, max_toks=None) -> dict:
+        return {"prompt": prompt or make_prompt_ids(),
+                "max_tokens": max_toks or budget(),
+                "temperature": temperature, "stream": True}
+
+    async def open_loop(port: int) -> None:
+        tasks = []
+        if trace is not None:
+            start = time.perf_counter()
+            for row in trace:
+                due = start + float(row.get("t", 0.0))
+                await asyncio.sleep(max(0.0, due - time.perf_counter()))
+                tenant = row.get("tenant", "default")
+                prompt = row.get("prompt") or (
+                    rng.integers(0, vocab_size,
+                                 (int(row.get("prompt_len", 8)),))
+                    .astype(int).tolist())
+                tasks.append(asyncio.ensure_future(one_request(
+                    port, tenant,
+                    body_for(tenant, prompt, row.get("max_new_tokens")))))
+        else:
+            share = max(1, num_requests // max(1, len(tenant_names)))
+
+            async def fleet(tenant: str) -> None:
+                rate = tenant_load.get(tenant, {}).get("rate", 20.0)
+                # zlib, not hash(): str hashing is salted per process and
+                # would unseed the arrival schedule between runs
+                import zlib
+
+                offs = _arrival_offsets(
+                    arrival, rate, share,
+                    np.random.default_rng(
+                        seed + zlib.adler32(tenant.encode()) % 10000))
+                start = time.perf_counter()
+                for off in offs:
+                    await asyncio.sleep(
+                        max(0.0, start + off - time.perf_counter()))
+                    tasks.append(asyncio.ensure_future(
+                        one_request(port, tenant, body_for(tenant))))
+
+            await asyncio.gather(*(fleet(t) for t in tenant_names))
+        if tasks:
+            await asyncio.gather(*tasks)
+
+    async def closed_loop(port: int) -> None:
+        share = max(1, num_requests // max(1, len(tenant_names)))
+
+        async def worker(tenant: str, n: int) -> None:
+            for _ in range(n):
+                await one_request(port, tenant, body_for(tenant))
+
+        jobs = []
+        for t in tenant_names:
+            conc = max(1, tenant_load.get(t, {}).get("concurrency", 2))
+            per = max(1, share // conc)
+            jobs.extend(worker(t, per) for _ in range(conc))
+        await asyncio.gather(*jobs)
+
+    async def run() -> dict:
+        await door.start()
+        port = door.port
+        t0 = time.perf_counter()
+        if trace is not None or mode == "open":
+            await open_loop(port)
+        else:
+            await closed_loop(port)
+        # let in-flight engine work settle before the scrape
+        while engine.scheduler.has_work():
+            await asyncio.sleep(0.01)
+        wall = time.perf_counter() - t0
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.1\r\nHost: bench\r\n"
+                     b"Content-Length: 0\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        prom = parse_prometheus(
+            raw.partition(b"\r\n\r\n")[2].decode())
+        await door.stop()
+        return {"wall_s": wall, "prom": prom}
+
+    res = asyncio.run(run())
+    prom = res.pop("prom")
+    out = engine.metrics_summary()
+    out["wall_s"] = round(res["wall_s"], 3)
+    out["mode"] = mode if trace is None else "trace"
+    for t, book in sorted(obs.items()):
+        row: dict = {
+            "sent": book["sent"], "ok": book["ok"],
+            "shed_429": book["shed_429"],
+            "shed_stream": book["shed_stream"], "errors": book["errors"],
+        }
+        if book["client_ttft_s"]:
+            arr = np.asarray(book["client_ttft_s"])
+            row["client_ttft_p50_ms"] = float(np.percentile(arr, 50)) * 1e3
+            row["client_ttft_p99_ms"] = float(np.percentile(arr, 99)) * 1e3
+        # the Prometheus-sourced view: the same series a scrape reads
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            v = _prom_tenant(prom, "serving_ttft_seconds", t, str(q))
+            if v is not None and v == v:
+                row[f"ttft_{label}_ms"] = v * 1e3
+        slo_total = _prom_tenant(prom, "serving_slo_total", t)
+        slo_met = _prom_tenant(prom, "serving_slo_met_total", t)
+        if slo_total:
+            row["slo_total"] = slo_total
+            row["slo_attainment"] = (slo_met or 0.0) / slo_total
+        for name, key in (("serving_requests_finished_total", "finished"),
+                          ("serving_requests_expired_total", "expired")):
+            v = _prom_tenant(prom, name, t)
+            if v is not None:
+                row[key] = v
+        for k, v in row.items():
+            out[f"tenants.{t}.{k}"] = round(v, 4) if isinstance(v, float) \
+                else v
+    return out
+
+
 def main() -> None:
+    # script invocation puts benchmarks/ (not the repo root) on sys.path;
+    # the lazy accelerate_tpu imports below need the root
+    import os
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--family", default="llama", choices=("llama", "gpt2"))
     p.add_argument("--num-requests", type=int, default=16)
@@ -175,7 +520,44 @@ def main() -> None:
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics while the load runs "
                         "(0 = ephemeral port, printed to stderr)")
+    p.add_argument("--tenants", default=None,
+                   help="multi-tenant HTTP harness: semicolon-separated "
+                        "specs, e.g. 'gold:priority=0,weight=4,slo=0.3,"
+                        "rate=10;bronze:rate=40' (rate = open-loop "
+                        "arrivals/s, concurrency = closed-loop workers)")
+    p.add_argument("--mode", default="open", choices=("open", "closed"),
+                   help="HTTP harness loop shape (open = offered load, "
+                        "closed = saturation)")
+    p.add_argument("--arrival", default="poisson",
+                   choices=("poisson", "burst"),
+                   help="open-loop arrival process")
+    p.add_argument("--trace", default=None, metavar="FILE",
+                   help="replay a recorded JSONL arrival trace through "
+                        "the HTTP harness instead of generating arrivals")
     args = p.parse_args()
+
+    if args.tenants or args.trace:
+        specs, loads = parse_tenant_load_arg(args.tenants or "")
+        engine, cfg = build_tiny_engine(
+            args.family, num_slots=args.slots, max_len=args.max_len,
+            prefill_chunk=args.prefill_chunk, seed=args.seed,
+            page_size=args.page_size,
+            prefix_cache=not args.no_prefix_cache, tenants=specs)
+        summary = run_http_load(
+            engine, cfg.vocab_size, specs, loads,
+            num_requests=args.num_requests, mode=args.mode,
+            arrival=args.arrival, prompt_len=tuple(args.prompt_len),
+            max_new_tokens=tuple(args.max_new_tokens),
+            temperature=args.temperature, seed=args.seed,
+            trace=load_trace(args.trace) if args.trace else None)
+        print(json.dumps({
+            "metric": "serving_tokens_per_sec",
+            "value": round(summary.get("tokens_per_sec", 0.0), 2),
+            "unit": "tokens/s",
+            "extra": {k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in summary.items()},
+        }))
+        return
 
     # a shared-prefix workload must fit prefix + suffix + budget in a
     # slot; grow max_len rather than silently rejecting every request

@@ -28,6 +28,11 @@ assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 # pipeline / serving programs). Point jax's persistent compilation cache at
 # a stable per-checkout dir so repeat runs deserialize instead of
 # recompiling; jax's own >=1s-compile-time threshold keeps the cache small.
+# (Do NOT drop the threshold to 0 here: caching the suite's hundreds of
+# sub-second programs was tried for the ISSUE 7 headroom satellite and
+# deserializing them segfaulted jaxlib on this line — reads are not gated
+# by the threshold, so a cache dir polluted with small entries crashes
+# every later run until wiped.)
 # ACCELERATE_TPU_COMPILATION_CACHE=off disables (the helper honors it).
 from accelerate_tpu.utils.constants import ENV_COMPILATION_CACHE  # noqa: E402
 from accelerate_tpu.utils.environment import configure_compilation_cache  # noqa: E402

@@ -301,6 +301,19 @@ class TestSelfLint:
         )
         assert elapsed < 10.0, f"self-lint took {elapsed:.1f}s; gate must stay cheap"
 
+    def test_self_lint_gate_covers_the_server_package(self):
+        """ISSUE 7: the gate's tree walk must include the HTTP front door
+        (accelerate_tpu/server/) — if the walker ever grew an exclusion
+        that swallowed it, new server hazards would ship unlinted."""
+        from accelerate_tpu.analysis.runner import iter_python_files
+
+        files = iter_python_files(os.path.join(REPO, "accelerate_tpu"))
+        server_files = [f for f in files
+                       if os.sep + "server" + os.sep in f]
+        assert any(f.endswith("http.py") for f in server_files), \
+            "accelerate_tpu/server must be inside the self-lint tree"
+        assert any(f.endswith("service.py") for f in server_files)
+
     def test_examples_are_clean(self):
         """False-positive guard: examples/ is idiomatic user code — the
         linter flagging any of it means a rule is too aggressive."""

@@ -149,7 +149,7 @@ def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
               if e.get("BENCH_PHASE") != "train"]
     assert len(train) == 1, "TPU child must not be spawned under a cpu pin"
     assert train[0]["BENCH_TPU_SKIPPED"] == "1"
-    assert phases == ["serving", "serving_prefix"]
+    assert phases == ["serving", "serving_prefix", "server"]
     assert all(e["JAX_PLATFORMS"] == "cpu" for e in calls)
     line = json.loads(capsys.readouterr().out.strip())
     assert "skipped" in line and "error" not in line
@@ -184,6 +184,7 @@ def test_hung_phase_is_isolated_to_its_row(monkeypatch, capsys):
     assert "error" not in line             # ... unpoisoned
     assert "hung" in line["extra"]["serving"]["error"]
     assert "hung" in line["extra"]["serving_prefix"]["error"]
+    assert "hung" in line["extra"]["server"]["error"]
 
 
 def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
@@ -217,5 +218,153 @@ def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
     bench.main()
     line = json.loads(capsys.readouterr().out.strip())
     assert line["value"] == 123.0
-    for row in ("serving", "serving_prefix"):
+    for row in ("serving", "serving_prefix", "server"):
         assert "no tpu visible" in line["extra"][row]["error"]
+
+
+def test_transient_tpu_failure_is_retried_with_backoff(monkeypatch, capsys):
+    """ISSUE 7 satellite: a flapping tunnel (down since r03) must not
+    cost the TPU row on the first transient drop — failed train attempts
+    retry with backoff, and a later success emits the real headline."""
+    bench = _load_bench()
+    attempts = []
+    sleeps = []
+
+    class GoodOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 321.0, "vs_baseline": 1.2, "unit": "tokens/s/chip",
+            "extra": {"mfu": 0.5}}) + "\n"
+
+    class FlapOut:
+        returncode = 3  # "no tpu visible" — the flap signature
+        stderr = ""
+        stdout = ""
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PHASE") != "train":
+            return GoodOut()  # phase rows: irrelevant here
+        attempts.append(1)
+        return FlapOut() if len(attempts) < 3 else GoodOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    monkeypatch.setattr(bench, "_TPU_RETRIES", 2)
+    monkeypatch.setattr(bench, "_TPU_RETRY_BACKOFF_S", 5.0)
+    monkeypatch.setenv("BENCH_SERVING", "0")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert len(attempts) == 3, "two flaps then success"
+    assert sleeps == [5.0, 10.0], "exponential backoff between attempts"
+    assert line["value"] == 321.0 and "error" not in line
+
+
+def test_exhausted_retries_fall_back_to_cpu_with_attempt_count(monkeypatch,
+                                                               capsys):
+    bench = _load_bench()
+
+    class FlapOut:
+        returncode = 3
+        stderr = ""
+        stdout = ""
+
+    class CpuOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": None, "vs_baseline": None, "unit": "tokens/s/chip",
+            "error": "placeholder",
+            "extra": {"cpu_smoke_tokens_per_sec": 1.0}}) + "\n"
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        return CpuOut() if env.get("JAX_PLATFORMS") == "cpu" else FlapOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_TPU_RETRIES", 1)
+    monkeypatch.setenv("BENCH_SERVING", "0")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] is None
+
+
+def test_tunnel_probe_retries_before_declaring_down(monkeypatch, capsys):
+    """The probe itself retries a flap instead of failing on the spot,
+    and still emits one parseable JSON line when truly down."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tunnel_probe", os.path.join(ROOT, "benchmarks", "tunnel_probe.py"))
+    tp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tp)
+    calls = []
+
+    def flaky_probe():
+        calls.append(1)
+        if len(calls) < 2:
+            raise ConnectionError("tunnel flapped")
+        return {"metric": "host_device_link", "value": 100.0,
+                "unit": "MB/s@256MB", "extra": {}}
+
+    monkeypatch.setattr(tp, "_probe", flaky_probe)
+    monkeypatch.setattr(tp.time, "sleep", lambda s: None)
+    tp.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 100.0 and line["extra"]["attempts"] == 2
+
+    calls.clear()
+
+    def dead_probe():
+        calls.append(1)
+        raise ConnectionError("gone")
+
+    monkeypatch.setattr(tp, "_probe", dead_probe)
+    monkeypatch.setenv("TUNNEL_PROBE_RETRIES", "2")
+    tp.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] is None and "3 attempts" in line["error"]
+    assert len(calls) == 3
+
+
+def test_serve_dry_run_smoke_in_process():
+    """ISSUE 7 satellite (the PR 4 __main__-guard lesson): the CLI
+    entrypoint `accelerate-tpu serve --dry-run` must build the full
+    config in-process, print one JSON line, and exit 0 — so a broken
+    entrypoint can never ship silently."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["serve", "--dry-run", "--family", "gpt2",
+                   "--tenants", "gold:priority=0,weight=4,slo=0.25;"
+                   "bronze:priority=1"])
+    assert rc == 0
+    payload = json.loads(buf.getvalue().strip())
+    assert payload["dry_run"] is True
+    assert "/v1/completions" in payload["routes"]
+    assert "gold" in payload["tenants"]
+    # a bad tenant spec must fail loudly, not serve a typo
+    assert main(["serve", "--dry-run", "--tenants", "x:weight=0"]) == 2
+    assert main(["serve", "--dry-run", "--tenants", "x:bogus=1"]) == 2
+
+
+def test_bench_server_row_shape():
+    """bench.py's extra.server row: the two-tenant HTTP phase reports
+    per-tier Prometheus-sourced numbers and the flat compile count."""
+    bench = _load_bench()
+    row = bench._server_row(num_requests=6)
+    assert row["compiles_decode"] == 1.0
+    assert row["tenants.gold.sent"] == 3
+    assert row["tenants.bronze.sent"] == 3
+    assert "tenants.gold.slo_attainment" in row
+    assert row["tokens_per_sec"] > 0
