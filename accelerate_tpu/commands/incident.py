@@ -1,0 +1,183 @@
+"""`accelerate-tpu incident` — list and inspect incident bundles.
+
+The stall watchdog (and the server's drive loop on death) writes one
+self-contained bundle directory per incident under
+`ACCELERATE_TPU_INCIDENT_DIR` (or the component's `incident_dir` knob):
+manifest, full report, all-thread stacks, flight-recorder chrome trace,
+metrics snapshot, device memory stats, and the serving engine's
+scheduler/slot/page dumps. This command is the forensics entry point —
+a recycled host's bundles answer "what was it doing" without a live
+debugger (the pod-scale requirement in ROADMAP item 1).
+
+    accelerate-tpu incident list  [--dir D] [--format json]
+    accelerate-tpu incident show BUNDLE [--dir D] [--format json]
+
+`show` accepts a bundle directory path, a bundle name under --dir, or an
+index from `list` (0 = newest). Exit codes: 0 ok, 1 nothing to show,
+2 bad arguments / missing bundle.
+
+jax-free on purpose: forensics must work on a box whose accelerator
+backend is exactly what died.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "incident",
+        help="list/inspect stall & crash incident bundles",
+        description=(
+            "Inspect the self-contained incident bundles the stall "
+            "watchdog and the serve drive loop write (see "
+            "docs/server.md#incident-bundles)."
+        ),
+    )
+    sub = parser.add_subparsers(dest="incident_cmd")
+    common = dict(
+        default=None, metavar="DIR",
+        help="bundle directory root (default: ACCELERATE_TPU_INCIDENT_DIR)")
+    lp = sub.add_parser("list", help="summarize every bundle, newest first")
+    lp.add_argument("--dir", **common)
+    lp.add_argument("--format", choices=("text", "json"), default="text")
+    sp = sub.add_parser("show", help="render one bundle")
+    sp.add_argument("bundle",
+                    help="bundle path, name under --dir, or list index "
+                         "(0 = newest)")
+    sp.add_argument("--dir", **common)
+    sp.add_argument("--format", choices=("text", "json"), default="text")
+    parser.set_defaults(func=run_incident)
+
+
+def _resolve_dir(arg_dir: str | None) -> str | None:
+    from ..telemetry.watchdog import resolve_incident_dir
+
+    return resolve_incident_dir(arg_dir)
+
+
+def _age(created_at: float | None) -> str:
+    if not created_at:
+        return "?"
+    dt = max(0.0, time.time() - created_at)
+    if dt < 120:
+        return f"{dt:.0f}s ago"
+    if dt < 7200:
+        return f"{dt / 60:.0f}m ago"
+    return f"{dt / 3600:.1f}h ago"
+
+
+def run_incident(args: argparse.Namespace) -> int:
+    if getattr(args, "incident_cmd", None) is None:
+        print("incident: specify 'list' or 'show' "
+              "(accelerate-tpu incident --help)", file=sys.stderr)
+        return 2
+    base = _resolve_dir(args.dir)
+    if base is None:
+        print("incident: no bundle directory — pass --dir or set "
+              "ACCELERATE_TPU_INCIDENT_DIR", file=sys.stderr)
+        return 2
+    if args.incident_cmd == "list":
+        return _run_list(base, args.format)
+    return _run_show(base, args.bundle, args.format)
+
+
+def _run_list(base: str, fmt: str) -> int:
+    from ..telemetry.watchdog import list_incident_bundles
+
+    bundles = list_incident_bundles(base)
+    if fmt == "json":
+        print(json.dumps(bundles, indent=2, default=str))
+        return 0 if bundles else 1
+    if not bundles:
+        print(f"no incident bundles under {base}")
+        return 1
+    for i, m in enumerate(bundles):
+        silence = m.get("silence_s")
+        what = (f"silence {silence:.1f}s" if isinstance(silence, (int, float))
+                else (m.get("error") or m.get("kind", "?")))
+        print(f"[{i}] {os.path.basename(m['path'])}  "
+              f"{_age(m.get('created_at'))}  kind={m.get('kind', '?')}  "
+              f"{what}  files={len(m.get('files', []))}")
+    return 0
+
+
+def _resolve_bundle(base: str, ref: str) -> str | None:
+    from ..telemetry.watchdog import list_incident_bundles
+
+    if os.path.isdir(ref) and os.path.isfile(
+            os.path.join(ref, "manifest.json")):
+        return ref
+    named = os.path.join(base, ref)
+    if os.path.isdir(named) and os.path.isfile(
+            os.path.join(named, "manifest.json")):
+        return named
+    if ref.isdigit():
+        bundles = list_incident_bundles(base)
+        idx = int(ref)
+        if idx < len(bundles):
+            return bundles[idx]["path"]
+    return None
+
+
+def _run_show(base: str, ref: str, fmt: str) -> int:
+    from ..telemetry.watchdog import load_incident_bundle
+
+    path = _resolve_bundle(base, ref)
+    if path is None:
+        print(f"incident: no bundle {ref!r} under {base} "
+              "(try `accelerate-tpu incident list`)", file=sys.stderr)
+        return 2
+    bundle = load_incident_bundle(path)
+    if fmt == "json":
+        print(json.dumps(bundle, indent=2, default=str))
+        return 0
+    m = bundle["manifest"]
+    files = bundle["files"]
+    print(f"bundle   {path}")
+    print(f"kind     {m.get('kind', '?')}")
+    print(f"created  {m.get('created_at_utc', '?')} UTC "
+          f"({_age(m.get('created_at'))})")
+    if m.get("silence_s") is not None:
+        print(f"silence  {m['silence_s']:.1f}s")
+    report = files.get("report.json") or {}
+    if report.get("error"):
+        print(f"error    {report['error']}")
+    stacks = report.get("stacks") or {}
+    if stacks:
+        print(f"threads  {len(stacks)}: {', '.join(sorted(stacks))}")
+    tail = report.get("flight_recorder") or []
+    if tail:
+        print(f"flight recorder (last {min(len(tail), 8)} of "
+              f"{len(tail)} spans):")
+        for e in tail[-8:]:
+            print(f"  {e.get('name')} dur={e.get('dur_ns', 0) / 1e6:.3f}ms"
+                  f" trace={e.get('trace_id')}")
+    metrics = files.get("metrics.json") or {}
+    counters = metrics.get("counters") or {}
+    if counters:
+        print("counters:")
+        for k in sorted(counters)[:12]:
+            print(f"  {k} = {counters[k]:g}")
+        if len(counters) > 12:
+            print(f"  ... {len(counters) - 12} more (see metrics.json)")
+    for extra in ("scheduler.json", "requests.json", "pages.json"):
+        if extra in files:
+            print(f"{extra[:-5]}: see {os.path.join(path, extra)}")
+    print(f"files    {', '.join(m.get('files', []))}")
+    if m.get("write_errors"):
+        print(f"warnings {m['write_errors']}")
+    return 0
+
+
+if __name__ == "__main__":
+    # `python -m accelerate_tpu.commands.incident ...` must behave like
+    # `accelerate-tpu incident ...` (the lint `__main__`-guard lesson)
+    from .accelerate_cli import main
+
+    sys.exit(main(["incident", *sys.argv[1:]]))

@@ -66,6 +66,22 @@ def register_subcommand(subparsers) -> None:
         help="arm the engine stall watchdog; /healthz degrades to 503 "
              "while it has fired")
     parser.add_argument(
+        "--incident-dir", default=None, metavar="DIR",
+        help="write a self-contained incident bundle (metrics, trace, "
+             "stacks, scheduler dump) here when the watchdog fires or "
+             "the drive loop dies; inspect with `accelerate-tpu "
+             "incident` (default: ACCELERATE_TPU_INCIDENT_DIR)")
+    parser.add_argument(
+        "--debug-endpoints", action="store_true",
+        help="enable the read-only /debug/{requests,slots,pages,"
+             "scheduler} introspection routes (off by default: they "
+             "expose workload shape)")
+    parser.add_argument(
+        "--trace", action="store_true",
+        help="enable host-span request tracing (equivalent to "
+             "ACCELERATE_TPU_TRACE=1); every request's x-request-id "
+             "then resolves to linked spans in the flight recorder")
+    parser.add_argument(
         "--strict", default=None, choices=("warn", "error"),
         help="audit the engine programs through accelerate_tpu.analysis")
     parser.add_argument(
@@ -88,6 +104,7 @@ def _configs(args):
         else "default",
         default_max_tokens=args.default_max_tokens,
         drain_timeout_s=args.drain_timeout_s,
+        debug_endpoints=args.debug_endpoints,
     )
     engine_kwargs = dict(
         num_slots=args.slots, max_len=args.max_len,
@@ -95,6 +112,7 @@ def _configs(args):
         page_size=args.page_size, prefix_cache=not args.no_prefix_cache,
         seed=args.seed, tenants=tenants,
         watchdog_timeout_s=args.watchdog_timeout_s, strict=args.strict,
+        incident_dir=args.incident_dir,
     )
     return server_cfg, engine_kwargs
 
@@ -131,7 +149,10 @@ def run_serve(args: argparse.Namespace) -> int:
             "engine": {k: v for k, v in engine_kwargs.items()
                        if k != "tenants"},
             "routes": ["/v1/completions", "/v1/chat/completions",
-                       "/v1/models", "/healthz", "/metrics"],
+                       "/v1/models", "/healthz", "/metrics"]
+            + (["/debug/requests", "/debug/slots", "/debug/pages",
+                "/debug/scheduler"] if args.debug_endpoints else []),
+            "trace": bool(args.trace),
         }))
         return 0
     return _serve_blocking(args, server_cfg, engine_kwargs)
@@ -139,6 +160,11 @@ def run_serve(args: argparse.Namespace) -> int:
 
 def _serve_blocking(args, server_cfg, engine_kwargs) -> int:
     import asyncio
+
+    if args.trace:
+        from ..telemetry.trace import configure_tracing
+
+        configure_tracing(enabled=True)
 
     import jax
     import jax.numpy as jnp

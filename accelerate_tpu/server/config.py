@@ -36,6 +36,12 @@ class ServerConfig:
     max_body_bytes: int = 2 * 1024 * 1024
     drain_timeout_s: float = 30.0
     request_timeout_s: float = 300.0
+    # read-only live-introspection routes (/debug/requests, /debug/slots,
+    # /debug/pages, /debug/scheduler). Off by default: they expose
+    # workload shape (tenants, queue depths, prompt lengths) and belong
+    # behind the same trust boundary as /metrics, which an operator must
+    # opt into explicitly.
+    debug_endpoints: bool = False
 
     def __post_init__(self):
         if self.unknown_tenants not in ("default", "reject"):

@@ -1,20 +1,34 @@
 """The HTTP/1.1 front door: routing, SSE streaming, overload, shutdown.
 
 stdlib asyncio streams only — the repo's no-new-dependencies rule covers
-the server too, and an inference front door needs exactly five routes:
+the server too, and an inference front door needs exactly these routes:
 
     POST /v1/completions         OpenAI completions (+ SSE streaming)
     POST /v1/chat/completions    OpenAI chat (+ SSE streaming)
     GET  /v1/models              the one served model
     GET  /healthz                readiness (503 on drain / fired watchdog)
     GET  /metrics                Prometheus text from the engine registry
+                                 (OpenMetrics + trace-id exemplars when
+                                 the scraper Accepts it)
+    GET  /debug/{requests,slots,pages,scheduler}
+                                 read-only live introspection, gated by
+                                 ServerConfig(debug_endpoints=True)
+
+Request tracing: every generate request gets a trace id — minted fresh,
+or joined from a valid inbound W3C `traceparent` header — returned as
+`x-request-id` on EVERY response to that request (200, 4xx, 429, SSE
+head), so a client report always names the exact trace to pull. Whether
+spans record is the engine's per-tenant head-sampling decision; the id
+exists regardless.
 
 Contracts the tests pin:
 
 - malformed JSON and oversized bodies/prompts return structured 4xx
   (OpenAI error envelope) without the scheduler ever seeing them;
 - a scheduler shed/reject surfaces as 429 with a Retry-After header (the
-  scheduler's own drain estimate) — overload is an answer, not a hang;
+  scheduler's own drain estimate) and a machine-readable
+  `error.shed_reason` — overload is an answer, not a hang;
+- a malformed `traceparent` is ignored (fresh id minted), never an error;
 - a client disconnect mid-SSE-stream cancels the engine request at the
   next flush, freeing its slot and pages for the requests still paying;
 - `stop()` is a graceful drain: the listener closes first, in-flight
@@ -29,7 +43,8 @@ import json
 import time
 from typing import Awaitable, Callable
 
-from ..telemetry.export import render_prometheus
+from ..telemetry.export import negotiate_exposition
+from ..telemetry.trace import new_trace_id, parse_traceparent
 from .config import ServerConfig
 from .protocol import (
     SSE_DONE,
@@ -244,6 +259,11 @@ class HttpFrontDoor:
             handler = self._handle_metrics
         elif path == "/v1/models":
             handler = self._handle_models
+        elif path.startswith("/debug/") and self.config.debug_endpoints:
+            # gating happens HERE, before method dispatch: disabled debug
+            # routes must be indistinguishable from unknown paths (a 405
+            # on POST /debug/... would fingerprint the namespace)
+            handler = self._handle_debug
         elif path in ("/v1/completions", "/v1/chat/completions"):
             if method != "POST":
                 await self._send_json(writer, 405, error_body(
@@ -255,11 +275,14 @@ class HttpFrontDoor:
             await self._send_json(writer, 404,
                                   error_body(f"unknown route {path!r}"))
             return
-        if method != "GET":
+        if method not in ("GET", "HEAD"):
             await self._send_json(writer, 405,
                                   error_body(f"{method} not allowed"))
             return
-        await handler(writer)
+        # HEAD mirrors GET minus the body (same status/headers/length):
+        # health probes HEAD /metrics and /healthz before trusting them,
+        # and this route must behave like the standalone exporter's
+        await handler(writer, path, headers, method == "HEAD")
 
     # -- response writing ----------------------------------------------------
 
@@ -279,37 +302,64 @@ class HttpFrontDoor:
 
     async def _send_raw(self, writer, status: int, body: bytes,
                         content_type: str,
-                        extra: dict | None = None) -> None:
+                        extra: dict | None = None,
+                        head_only: bool = False) -> None:
         await self._send_head(writer, status, content_type, extra,
                               length=len(body))
-        writer.write(body)
-        await writer.drain()
+        if not head_only:
+            writer.write(body)
+            await writer.drain()
 
     async def _send_json(self, writer, status: int, payload: dict,
-                         extra: dict | None = None) -> None:
+                         extra: dict | None = None,
+                         head_only: bool = False) -> None:
         await self._send_raw(writer, status,
                              json.dumps(payload).encode(),
-                             "application/json", extra)
+                             "application/json", extra,
+                             head_only=head_only)
 
     # -- plumbing routes -----------------------------------------------------
 
-    async def _handle_health(self, writer) -> None:
+    async def _handle_health(self, writer, path, headers,
+                             head_only=False) -> None:
         ok, reason = self.service.health()
         await self._send_json(writer, 200 if ok else 503,
                               {"status": "ok" if ok else "unavailable",
-                               "reason": reason})
+                               "reason": reason}, head_only=head_only)
 
-    async def _handle_metrics(self, writer) -> None:
-        text = render_prometheus(self.service.engine.registry)
-        await self._send_raw(writer, 200, text.encode(),
-                             "text/plain; version=0.0.4; charset=utf-8")
+    async def _handle_metrics(self, writer, path, headers,
+                              head_only=False) -> None:
+        # the SAME negotiation as the standalone exporter: an OpenMetrics
+        # Accept gets bucket histograms with trace-id exemplars on the
+        # latency series, everyone else format 0.0.4
+        text, ctype = negotiate_exposition(headers.get("accept"),
+                                           self.service.engine.registry)
+        await self._send_raw(writer, 200, text.encode(), ctype,
+                             head_only=head_only)
 
-    async def _handle_models(self, writer) -> None:
+    async def _handle_models(self, writer, path, headers,
+                             head_only=False) -> None:
         await self._send_json(writer, 200, {
             "object": "list",
             "data": [{"id": self.config.model_id, "object": "model",
                       "created": 0, "owned_by": "accelerate-tpu"}],
-        })
+        }, head_only=head_only)
+
+    async def _handle_debug(self, writer, path, headers,
+                            head_only=False) -> None:
+        """Read-only introspection. Gated OFF by default in `_route`
+        (when disabled, /debug/* — any method — 404s exactly like
+        unknown paths: the namespace's existence is not advertised to
+        an unauthorized prober)."""
+        section = path[len("/debug/"):]
+        state = self.service.debug_state(section)
+        if state is None:
+            await self._send_json(writer, 404,
+                                  error_body(f"unknown route {path!r}"))
+            return
+        await self._send_json(writer, 200, {section: state}
+                              if isinstance(state, list) else state,
+                              head_only=head_only)
 
     # -- generation ----------------------------------------------------------
 
@@ -318,6 +368,14 @@ class HttpFrontDoor:
         chat = path.endswith("/chat/completions")
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{next(self._req_ids)}"
         created = int(time.time())
+        # trace context: honor a VALID inbound W3C traceparent (the
+        # request joins the caller's distributed trace), mint fresh on
+        # anything else — malformed headers are ignored, never an error.
+        # The id exists for every generate request, sampled or not, and
+        # rides EVERY response as x-request-id.
+        parsed_tp = parse_traceparent(headers.get("traceparent"))
+        trace_id, trace_parent = parsed_tp or (new_trace_id(), 0)
+        rid_hdr = {"x-request-id": trace_id}
         try:
             try:
                 parsed = json.loads(body)
@@ -329,38 +387,55 @@ class HttpFrontDoor:
                 parsed, max_ctx, self.config.default_max_tokens)
             tenant = self.service.resolve_tenant(
                 headers.get("x-tenant"), params.user)
-            reqs = self.service.submit(params, tenant)
+            reqs = self.service.submit(params, tenant, trace_id=trace_id,
+                                       trace_parent=trace_parent)
         except OverloadedError as e:
             await self._send_json(
-                writer, e.status, e.body(),
-                extra=self._retry_after(e.retry_after_s))
+                writer, e.status, self._with_request_id(e.body(), trace_id),
+                extra=self._retry_after(e.retry_after_s, rid_hdr))
             return
         except ProtocolError as e:
-            await self._send_json(writer, e.status, e.body())
+            await self._send_json(writer, e.status,
+                                  self._with_request_id(e.body(), trace_id),
+                                  extra=rid_hdr)
             return
         model = self.config.model_id
         try:
             if params.stream:
                 await self._stream_response(writer, rid, model, created,
-                                            params, reqs, chat)
+                                            params, reqs, chat, rid_hdr)
             else:
                 await self._unary_response(writer, rid, model, created,
-                                           params, reqs, chat)
+                                           params, reqs, chat, rid_hdr)
         except OverloadedError as e:
-            await self._send_json(writer, e.status, e.body(),
-                                  extra=self._retry_after(e.retry_after_s))
+            await self._send_json(
+                writer, e.status, self._with_request_id(e.body(), trace_id),
+                extra=self._retry_after(e.retry_after_s, rid_hdr))
         except ProtocolError as e:
-            await self._send_json(writer, e.status, e.body())
+            await self._send_json(writer, e.status,
+                                  self._with_request_id(e.body(), trace_id),
+                                  extra=rid_hdr)
         except ConnectionError:
             # the client went away mid-generation: release the slots and
             # pages its requests were holding — other tenants are queued
             self.service.cancel(reqs)
 
     @staticmethod
-    def _retry_after(retry_after_s: float | None) -> dict:
-        if retry_after_s is None:
-            return {}
-        return {"Retry-After": f"{max(retry_after_s, 0.05):.3f}"}
+    def _with_request_id(body: dict, trace_id: str) -> dict:
+        """The trace id INSIDE the error envelope too: SSE error events
+        and proxied responses often lose response headers, and a 429
+        must stay attributable to its trace either way."""
+        if "error" in body:
+            body["error"]["request_id"] = trace_id
+        return body
+
+    @staticmethod
+    def _retry_after(retry_after_s: float | None,
+                     base: dict | None = None) -> dict:
+        out = dict(base or {})
+        if retry_after_s is not None:
+            out["Retry-After"] = f"{max(retry_after_s, 0.05):.3f}"
+        return out
 
     def _rank(self, params, reqs):
         """best_of ranking: the n best candidates by the documented
@@ -372,7 +447,8 @@ class HttpFrontDoor:
         return [reqs[i] for i in order[:params.n]]
 
     async def _unary_response(self, writer, rid, model, created, params,
-                              reqs, chat: bool) -> None:
+                              reqs, chat: bool,
+                              rid_hdr: dict | None = None) -> None:
         await self.service.wait_all(reqs)
         chosen = self._rank(params, reqs)
         tokenizer = self.service.tokenizer
@@ -404,17 +480,20 @@ class HttpFrontDoor:
         await self._send_json(
             writer, 200,
             build(rid, model, created, choices,
-                  usage_block(prompt_tokens, completion_tokens)))
+                  usage_block(prompt_tokens, completion_tokens)),
+            extra=rid_hdr)
 
     async def _stream_response(self, writer, rid, model, created, params,
-                               reqs, chat: bool) -> None:
+                               reqs, chat: bool,
+                               rid_hdr: dict | None = None) -> None:
         # hold the 200 until something real exists to stream: a request
         # shed from the queue BEFORE its first token still gets a clean
         # 429 + Retry-After (the overload contract must not depend on
         # whether the client asked to stream)
         await self.service.await_first(reqs)
         await self._send_head(writer, 200, "text/event-stream",
-                              {"Cache-Control": "no-cache"})
+                              {"Cache-Control": "no-cache",
+                               **(rid_hdr or {})})
         make = chat_chunk if chat else completion_chunk
         choices = [_Choice(self.service.tokenizer, params.stop)
                    for _ in reqs]
@@ -453,7 +532,11 @@ class HttpFrontDoor:
             # (engine drive death, mid-wait shed) becomes a terminal SSE
             # error event — never a second HTTP status line mid-stream
             self.service.cancel(reqs)
-            writer.write(sse_event(e.body()))
+            body = e.body()
+            if rid_hdr:
+                body = self._with_request_id(body,
+                                             rid_hdr["x-request-id"])
+            writer.write(sse_event(body))
             writer.write(SSE_DONE)
             await writer.drain()
         except (ConnectionResetError, BrokenPipeError) as e:

@@ -41,12 +41,24 @@ __all__ = ["InferenceService", "OverloadedError"]
 
 
 class OverloadedError(ProtocolError):
-    """429 + Retry-After: the scheduler shed or refused the request."""
+    """429 + Retry-After: the scheduler shed or refused the request.
+    `shed_code` is the scheduler's machine-readable reason (certain_miss,
+    pressure_victim, displaced_by_tier, queue_full, ...) — it rides the
+    envelope as `error.shed_reason` so a client or load balancer can
+    react to WHY it was shed, not just that it was."""
 
-    def __init__(self, message: str, retry_after_s: float | None):
+    def __init__(self, message: str, retry_after_s: float | None,
+                 shed_code: str | None = None):
         super().__init__(429, message, etype="overloaded_error",
                          code="rate_limit_exceeded")
         self.retry_after_s = retry_after_s
+        self.shed_code = shed_code
+
+    def body(self) -> dict:
+        out = super().body()
+        if self.shed_code is not None:
+            out["error"]["shed_reason"] = self.shed_code
+        return out
 
 
 class InferenceService:
@@ -114,6 +126,25 @@ class InferenceService:
                            f"stall(s), last silence > {wd.timeout_s}s)")
         return True, "ok"
 
+    def debug_state(self, section: str) -> dict | list | None:
+        """Introspection snapshot for one /debug/<section> route; None
+        for an unknown section (the HTTP layer 404s). Service-level
+        health rides along on `requests` so one fetch answers 'is the
+        loop alive AND what is it holding'."""
+        if section == "requests":
+            out = self.engine.debug_requests()
+            ok, reason = self.health()
+            out["service"] = {"healthy": ok, "reason": reason,
+                              "draining": self.draining}
+            return out
+        if section == "slots":
+            return self.engine.debug_slots()
+        if section == "pages":
+            return self.engine.debug_pages()
+        if section == "scheduler":
+            return self.engine.debug_scheduler()
+        return None
+
     # -- the drive loop ------------------------------------------------------
 
     async def _drive(self) -> None:
@@ -148,8 +179,11 @@ class InferenceService:
         except BaseException as e:
             # a dead drive loop must FAIL every request, not hang it:
             # record the error (watchers re-raise it as a 500), refuse
-            # new work, cancel everything in flight, wake all waiters
+            # new work, cancel everything in flight, wake all waiters —
+            # and leave an incident bundle behind (the drive loop dying
+            # IS the incident the stall watchdog exists for, just loud)
             self._drive_error = e
+            self._write_incident(e)
             self.draining = True
             for req in list(self.engine.scheduler.queue):
                 self.engine.cancel(req)
@@ -157,6 +191,33 @@ class InferenceService:
                 self.engine.cancel(req)
             self._notify_progress()
             raise
+
+    def _write_incident(self, exc: BaseException) -> None:
+        """Best-effort drive-death bundle: same format as the watchdog's
+        stall bundles, kind 'drive-loop', with the exception traceback
+        and the engine's scheduler/slot/page dumps frozen at death."""
+        try:
+            from ..telemetry.watchdog import (
+                build_exception_report,
+                resolve_incident_dir,
+                write_incident_bundle,
+            )
+
+            incident_dir = resolve_incident_dir(
+                getattr(self.engine.engine_config, "incident_dir", None))
+            if incident_dir is None:
+                return
+            report = build_exception_report(exc, name="drive-loop")
+            path = write_incident_bundle(
+                incident_dir, report, registry=self.engine.registry,
+                dumps=self.engine.incident_dumps(), name="drive-loop")
+            from ..logging import get_logger
+
+            get_logger(__name__).error(
+                f"engine drive loop died ({type(exc).__name__}); incident "
+                f"bundle written: {path} (accelerate-tpu incident show)")
+        except Exception:
+            pass  # forensics must never mask the original failure
 
     def _notify_progress(self) -> None:
         waiters, self._progress_waiters = self._progress_waiters, []
@@ -209,12 +270,15 @@ class InferenceService:
         except ValueError as e:
             raise ProtocolError(400, str(e))
 
-    def submit(self, params, tenant: str) -> list[Request]:
+    def submit(self, params, tenant: str, trace_id=None,
+               trace_parent=0) -> list[Request]:
         """Validate capacity, then fan out `max(n, best_of)` engine
         requests. Oversized prompts 4xx HERE — the scheduler never sees
         them. Overload (scheduler REJECTED) raises OverloadedError with
-        the scheduler's Retry-After estimate; partial fan-outs roll back
-        so a shed request never leaks half its siblings."""
+        the scheduler's Retry-After estimate and shed code; partial
+        fan-outs roll back so a shed request never leaks half its
+        siblings. All candidates of one HTTP request share one trace —
+        `trace_id` is the id the front door returns as `x-request-id`."""
         if self.draining:
             raise ProtocolError(503, "server is draining",
                                 etype="overloaded_error", code="draining")
@@ -226,6 +290,13 @@ class InferenceService:
                 f"({params.max_tokens}) exceeds the model context "
                 f"({max_len})", code="context_length_exceeded")
         prompt = np.asarray(ids, np.int32)
+        # ONE head-sampling decision for the whole fan-out: n/best_of
+        # siblings share the trace, so they must sample together — at a
+        # fractional rate, per-candidate draws would leave a random
+        # subset of a request's spans missing (half a trace is noise)
+        from ..telemetry.trace import head_sample
+
+        sampled = head_sample(tenant)
         reqs: list[Request] = []
         for i in range(params.fan_out):
             key = None
@@ -237,12 +308,15 @@ class InferenceService:
                 prompt, max_new_tokens=params.max_tokens,
                 temperature=params.temperature, key=key,
                 eos_token_id=self.tokenizer.eos_token_id, tenant=tenant,
+                trace_id=trace_id, trace_parent=trace_parent,
+                trace_sampled=sampled,
             )
             if req.status is RequestStatus.REJECTED:
                 for sib in reqs:
                     self.engine.cancel(sib)
                 raise OverloadedError(
-                    f"request shed: {req.reject_reason}", req.retry_after_s)
+                    f"request shed: {req.reject_reason}", req.retry_after_s,
+                    shed_code=req.shed_code)
             reqs.append(req)
         if self._wake is not None:
             self._wake.set()
@@ -297,7 +371,8 @@ class InferenceService:
         if shed is not None:
             self.cancel(reqs)
             raise OverloadedError(f"request shed: {shed.reject_reason}",
-                                  shed.retry_after_s)
+                                  shed.retry_after_s,
+                                  shed_code=shed.shed_code)
 
     async def await_first(self, reqs: list[Request],
                           timeout_s: float | None = None) -> None:
@@ -328,7 +403,8 @@ class InferenceService:
         if shed is not None:
             self.cancel(reqs)
             raise OverloadedError(f"request shed: {shed.reject_reason}",
-                                  shed.retry_after_s)
+                                  shed.retry_after_s,
+                                  shed_code=shed.shed_code)
 
     async def stream_tokens(
             self, reqs: list[Request],

@@ -62,7 +62,14 @@ from ..models.decode import sample_token
 from ..profiler import StepTimer
 from ..telemetry.export import start_metrics_server
 from ..telemetry.registry import MetricsRegistry
-from ..telemetry.trace import span
+from ..telemetry.trace import (
+    head_sample,
+    new_trace_id,
+    next_span_id,
+    record_span,
+    span,
+    tracing_enabled,
+)
 from ..telemetry.watchdog import StallWatchdog, resolve_stall_timeout
 from .cache import (
     PagedAllocator,
@@ -120,6 +127,13 @@ class EngineConfig:
     tenants: Any = None
     metrics_port: int | None = None
     watchdog_timeout_s: float | None = None
+    # incident bundles: when the stall watchdog fires (or the server's
+    # drive loop dies), a self-contained bundle directory — metrics
+    # snapshot, flight-recorder chrome trace, scheduler/allocator dumps,
+    # all-thread stacks, device memory stats — lands here for
+    # `accelerate-tpu incident list/show`. None defers to
+    # ACCELERATE_TPU_INCIDENT_DIR; unset = log-only stall reports.
+    incident_dir: str | None = None
     # strict="warn"|"error" audits each engine program ONCE, at its first
     # use: a mesh-placement check on the argument arrays (params leaked
     # onto a multi-device mesh -> ATP101, caught at the placement, since
@@ -240,7 +254,9 @@ class Engine:
         wd_timeout = resolve_stall_timeout(ec.watchdog_timeout_s)
         if wd_timeout is not None:
             self.watchdog = StallWatchdog(
-                wd_timeout, name="serving-engine").start()
+                wd_timeout, name="serving-engine",
+                incident_dir=ec.incident_dir, registry=self.registry,
+                dumps=self.incident_dumps).start()
 
         self._tokens = jnp.zeros((ec.num_slots,), jnp.int32)
         self._slot_keys = jax.random.key_data(
@@ -345,13 +361,26 @@ class Engine:
         deadline_s: float | None = None,
         tenant: str = "default",
         slo_ttft_s: float | None = None,
+        trace_id=None,
+        trace_parent=0,
+        trace_sampled: bool | None = None,
     ) -> Request:
         """Queue one generation request; returns its handle immediately.
         Overload is reported on the handle (`status` REJECTED with
-        `reject_reason` and a `retry_after_s` backoff hint), never
-        deferred to an OOM. `tenant` routes the request through that
-        tenant's priority tier / DRR share; `slo_ttft_s` overrides the
-        tenant's TTFT SLO for this request."""
+        `reject_reason`, a machine-readable `shed_code`, and a
+        `retry_after_s` backoff hint), never deferred to an OOM.
+        `tenant` routes the request through that tenant's priority tier /
+        DRR share; `slo_ttft_s` overrides the tenant's TTFT SLO for this
+        request. `trace_id`/`trace_parent` join the request to an
+        externally minted trace (the HTTP layer's, or an inbound W3C
+        traceparent); with tracing enabled and no id supplied the engine
+        mints one, so direct engine callers get request ids too.
+        Whether SPANS record is the per-tenant head-sampling decision —
+        made here unless the caller passes `trace_sampled` (the server
+        decides ONCE per HTTP request so n/best_of siblings sample
+        together; a half-sampled fan-out is noise). An unsampled request
+        keeps its id (request-id plumbing must not depend on the
+        sampling rate)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -363,6 +392,22 @@ class Engine:
             eos_token_id=eos_token_id, deadline_s=deadline_s,
             tenant=tenant, slo_ttft_s=slo_ttft_s,
         )
+        req.trace_id = trace_id
+        req.trace_parent = trace_parent
+        if trace_sampled is None:
+            req.trace_sampled = head_sample(tenant)
+        else:
+            req.trace_sampled = bool(trace_sampled) and tracing_enabled()
+        # the id is minted whenever tracing is on — sampled or not: the
+        # request id in /debug views and metric exemplars must not depend
+        # on the sampling rate (only SPAN recording does)
+        if req.trace_id is None and tracing_enabled():
+            req.trace_id = new_trace_id()
+        if req.trace_sampled:
+            # pre-allocate the root span id: children (queue wait, admit,
+            # prefill chunks) parent onto it before the root itself is
+            # recorded at the request's terminal state
+            req.span_id = next_span_id()
         # drain first, THEN capacity-check: a slot freed since the last
         # step (or an expired entry still holding a queue position) must
         # make room before this request is judged against max_queue — the
@@ -372,9 +417,9 @@ class Engine:
         # pressure/displacement victims shed INSIDE submit have no other
         # path into the metrics — drain them before reporting the newcomer
         for victim in self.scheduler.drain_shed():
-            self.metrics.observe_request(victim)
+            self._finalize_request(victim)
         if req.done:
-            self.metrics.observe_request(req)
+            self._finalize_request(req)
         else:
             # eager admission: a free slot absorbs the request now, so
             # TTFT doesn't wait for the next step() call
@@ -383,7 +428,7 @@ class Engine:
 
     def cancel(self, request: Request) -> bool:
         if self.scheduler.cancel(request):
-            self.metrics.observe_request(request)
+            self._finalize_request(request)
             return True
         return False
 
@@ -392,7 +437,7 @@ class Engine:
         a server-side stop sequence matched): counts in the finished/
         latency metrics, prompt pages cached for reuse."""
         if self.scheduler.finish_early(request):
-            self.metrics.observe_request(request)
+            self._finalize_request(request)
             return True
         return False
 
@@ -463,7 +508,7 @@ class Engine:
         now = self._clock()
         self.scheduler.shed_expired(now)
         for req in self.scheduler.drain_shed():
-            self.metrics.observe_request(req)
+            self._finalize_request(req)
         for slot, req in self.scheduler.admissions(now):
             self._run_admit(slot, req)
 
@@ -541,11 +586,18 @@ class Engine:
         self.metrics.note_admission(req.prompt_len, alloc.reused_len)
         self.metrics.set_page_gauges(self.allocator.pages_in_use,
                                      self.allocator.pages_free)
+        if req.trace_sampled:
+            # the queue-wait span is only known in retrospect: it closes
+            # the moment admission happens
+            record_span("serving.queue_wait", req.submitted_at,
+                        req.admitted_at, trace=req.trace_id,
+                        parent=req.span_id, tenant=req.tenant)
         args = (self.cache, self._slot_keys, self._temps,
                 jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
                 jnp.int32(alloc.reused_len))
         self._strict_audit("admit", self._admit_p, args)
-        with span("serving.admit"):
+        with self._request_span("serving.admit", req, slot=slot.index,
+                                reused_len=alloc.reused_len):
             self.cache, self._slot_keys, self._temps = self._admit_p(*args)
 
     def _run_prefill_chunk(self, slot: Slot) -> None:
@@ -559,7 +611,9 @@ class Engine:
                 self._temps, jnp.int32(slot.index),
                 self._table[slot.index], ids, jnp.int32(real))
         self._strict_audit("prefill", self._prefill_p, args)
-        with span("serving.prefill"), self.timer.dispatch():
+        with self._request_span("serving.prefill", req, slot=slot.index,
+                                chunk_start=start, chunk_tokens=real), \
+                self.timer.dispatch():
             self.cache, self._tokens = self._prefill_p(*args)
         self.metrics.note_prefill_chunk()
         if self.scheduler.note_prefill_chunk(slot, real):
@@ -569,7 +623,7 @@ class Engine:
             # not the whole [S] token vector (self-lint ATP003 class).
             tok = int(self._tokens[slot.index])
             if self.scheduler.note_token(slot, tok):
-                self.metrics.observe_request(req)
+                self._finalize_request(req)
 
     def _run_decode(self, slots: list[Slot]) -> None:
         live = np.zeros((self.engine_config.num_slots,), bool)
@@ -578,7 +632,13 @@ class Engine:
         args = (self.params, self.cache, self._tokens, self._slot_keys,
                 self._temps, live, self._table)
         self._strict_audit("decode", self._decode_p, args)
-        with span("serving.decode"), self.timer.dispatch():
+        # one decode step serves EVERY live slot, so the step span belongs
+        # to no single request: span LINKS carry each sampled request's
+        # trace id instead (bounded by num_slots)
+        links = [s.request.trace_id for s in slots
+                 if s.request is not None and s.request.trace_sampled]
+        with span("serving.decode", links=links or None), \
+                self.timer.dispatch():
             self.cache, self._tokens = self._decode_p(*args)
         toks = np.asarray(self._tokens)  # the per-step host read
         self.timer.tick(block_on=None)
@@ -586,7 +646,159 @@ class Engine:
         for s in slots:
             req = s.request
             if self.scheduler.note_token(s, int(toks[s.index])):
-                self.metrics.observe_request(req)
+                self._finalize_request(req)
+
+    # -- request tracing -----------------------------------------------------
+
+    @staticmethod
+    def _request_span(name: str, req: Request, **attrs):
+        """A live span joined to the request's trace when it is sampled,
+        the plain engine-wide span otherwise (engine-level spans predate
+        request tracing and must keep recording for unsampled traffic)."""
+        if req.trace_sampled:
+            return span(name, trace=req.trace_id, parent=req.span_id,
+                        **attrs)
+        return span(name, **attrs)
+
+    def _trace_terminal(self, req: Request) -> None:
+        """Close the request's retrospective spans at its terminal state.
+        EVERY terminal path lands here — finished, cancelled, rejected,
+        shed — so a shed request's trace still closes, carrying the
+        machine-readable shed reason."""
+        if not req.trace_sampled:
+            return
+        end = req.finished_at
+        if end is None:
+            end = self._clock()
+        if req.first_token_at is not None and end > req.first_token_at:
+            # decode lifetime: first token -> terminal (prefill chunks
+            # are their own child spans; this is the streaming tail)
+            record_span("serving.decode_lifetime", req.first_token_at, end,
+                        trace=req.trace_id, parent=req.span_id,
+                        tokens=len(req.tokens))
+        attrs: dict[str, Any] = {
+            "request_id": req.request_id,
+            "tenant": req.tenant,
+            "status": req.status.value,
+            "prompt_len": req.prompt_len,
+            "tokens": len(req.tokens),
+        }
+        if req.ttft_s is not None:
+            attrs["ttft_s"] = req.ttft_s
+        if req.reject_reason is not None:
+            attrs["reason"] = req.reject_reason
+        if req.shed_code is not None:
+            attrs["shed_code"] = req.shed_code
+        record_span("serving.request", req.submitted_at, end,
+                    trace=req.trace_id, parent=req.trace_parent,
+                    span_id=req.span_id, **attrs)
+
+    def _finalize_request(self, req: Request) -> None:
+        """The one terminal path: close the request's trace, then fold it
+        into the metrics (TTFT/per-token exemplars carry the trace id)."""
+        self._trace_terminal(req)
+        self.metrics.observe_request(req)
+
+    # -- live introspection (the /debug endpoints read these) ----------------
+
+    @staticmethod
+    def _request_info(req: Request, now: float) -> dict:
+        info = {
+            "request_id": req.request_id,
+            "trace_id": req.trace_id,
+            "tenant": req.tenant,
+            "status": req.status.value,
+            "prompt_len": req.prompt_len,
+            "max_new_tokens": req.max_new_tokens,
+            "tokens": len(req.tokens),
+            "age_s": round(now - req.submitted_at, 6),
+        }
+        if req.ttft_s is not None:
+            info["ttft_s"] = round(req.ttft_s, 6)
+        if req.slo_ttft_s is not None:
+            info["slo_ttft_s"] = req.slo_ttft_s
+        if req.deadline_s is not None:
+            info["deadline_s"] = req.deadline_s
+        return info
+
+    def debug_requests(self) -> dict:
+        """In-flight request state, queued and running, each entry
+        carrying its trace id — the live half of 'where did the time
+        go'. Read-only and JSON-safe."""
+        now = self._clock()
+        return {
+            "queued": [self._request_info(r, now)
+                       for r in self.scheduler.queue],
+            "running": [self._request_info(s.request, now)
+                        for s in self.scheduler.slots
+                        if s.request is not None],
+        }
+
+    def debug_slots(self) -> list[dict]:
+        """Slot occupancy: state, owning request/trace, prefill progress,
+        and how many pool pages each slot maps."""
+        out = []
+        for s in self.scheduler.slots:
+            entry: dict[str, Any] = {"index": s.index,
+                                     "state": s.state.value}
+            if s.request is not None:
+                entry.update({
+                    "request_id": s.request.request_id,
+                    "trace_id": s.request.trace_id,
+                    "tenant": s.request.tenant,
+                    "prompt_done": s.prompt_done,
+                    "prompt_len": s.request.prompt_len,
+                    "tokens": len(s.request.tokens),
+                })
+                if s.alloc is not None:
+                    entry["pages"] = len(s.alloc.pages)
+                    entry["reused_len"] = s.alloc.reused_len
+            out.append(entry)
+        return out
+
+    def debug_pages(self) -> dict:
+        """Page-pool and radix-tree state: capacity, occupancy, and the
+        prefix-reuse counters (host-side totals, exact)."""
+        alloc = self.allocator
+        return {
+            "page_size": alloc.page_size,
+            "num_pages": self.cache.num_pages,
+            "pages_in_use": alloc.pages_in_use,
+            "pages_free": alloc.pages_free,
+            "prefix_cache": alloc.prefix_cache,
+            "cached_pages": alloc.index.cached_pages,
+            "mapped_pages": alloc.index.mapped_pages,
+            "prefix_lookups": alloc.lookups,
+            "prefix_hits": alloc.hits,
+            "tokens_reused": alloc.tokens_reused,
+            "evictions": alloc.evictions,
+        }
+
+    def debug_scheduler(self) -> dict:
+        """The scheduler's policy state (per-tenant queues, DRR deficits,
+        SLO EMA, shed counters)."""
+        return self.scheduler.debug_state()
+
+    def incident_dumps(self) -> dict:
+        """Everything an incident bundle should freeze about this engine:
+        the same snapshots the /debug endpoints serve, plus compile
+        counts (a recompile storm is itself a finding). Per-section
+        best-effort: the watchdog thread calls this while the engine may
+        still be mutating (a slow stall is not a dead one), and one
+        section's failure must not cost the others."""
+        out: dict[str, Any] = {}
+        for name, build in (
+            ("requests", self.debug_requests),
+            ("slots", self.debug_slots),
+            ("pages", self.debug_pages),
+            ("scheduler", self.debug_scheduler),
+            ("compile_stats", self.compile_stats),
+        ):
+            try:
+                out[name] = build()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     # -- metrics -------------------------------------------------------------
 

@@ -164,23 +164,28 @@ class ServingMetrics:
         engine-wide series and the `{tenant=...}`-labeled copies the
         per-tier SLO dashboards (and serve_bench --tenants) read."""
         tenant = getattr(req, "tenant", "default")
+        # OpenMetrics exemplar: every latency sample carries its request's
+        # trace id, so a bad p99 bucket on the scrape links straight to
+        # the one trace that landed in it (ISSUE 8)
+        ex = getattr(req, "trace_id", None)
+        ex = str(ex) if ex is not None else None
         if req.status.value == "finished":
             self._c_finished.inc()
             self._tenant_counter("serving_requests_finished_total",
                                  tenant).inc()
             self._c_tokens.inc(len(req.tokens))
             if req.ttft_s is not None:
-                self.ttft_s.record(req.ttft_s)
+                self.ttft_s.record(req.ttft_s, exemplar=ex)
                 self._tenant_hist("serving_ttft_seconds",
-                                  tenant).record(req.ttft_s)
+                                  tenant).record(req.ttft_s, exemplar=ex)
             if req.admitted_at is not None:
                 self.queue_wait_s.record(req.admitted_at - req.submitted_at)
             # per-token latency: gaps between consecutive decode tokens
             # (TTFT is its own metric; the first gap is excluded)
             tpot_t = self._tenant_hist("serving_per_token_seconds", tenant)
             for g in np.diff(req.token_times):
-                self.tpot_s.record(float(g))
-                tpot_t.record(float(g))
+                self.tpot_s.record(float(g), exemplar=ex)
+                tpot_t.record(float(g), exemplar=ex)
         elif req.status.value == "cancelled":
             self._c_cancelled.inc()
         elif req.status.value == "rejected":

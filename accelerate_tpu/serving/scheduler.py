@@ -73,6 +73,15 @@ class TenantSpec:
 
 DEFAULT_TENANT = "default"
 
+# the machine-readable shed/reject vocabulary (Request.shed_code):
+SHED_TOO_LONG = "too_long"                  # prompt+budget exceeds max_len
+SHED_QUEUE_FULL = "queue_full"              # global queue bound hit
+SHED_TENANT_QUEUE_FULL = "tenant_queue_full"  # per-tenant cap hit
+SHED_DEADLINE = "deadline"                  # caller's queue-wait deadline
+SHED_CERTAIN_MISS = "certain_miss"          # TTFT SLO unreachable even now
+SHED_PRESSURE_VICTIM = "pressure_victim"    # worst-slack victim under pressure
+SHED_DISPLACED = "displaced_by_tier"        # bumped by a higher-tier arrival
+
 
 class SlotState(enum.Enum):
     IDLE = "idle"
@@ -105,7 +114,20 @@ class Request:
 
     status: RequestStatus = RequestStatus.QUEUED
     reject_reason: str | None = None
+    # machine-readable companion to reject_reason — one of the SHED_*
+    # codes below. The HTTP layer puts THIS in the 429 envelope; the
+    # prose reason is for humans reading logs
+    shed_code: str | None = None
     retry_after_s: float | None = None   # backoff hint on REJECTED/EXPIRED
+    # request tracing (telemetry.trace): trace_id is the id the server
+    # returns as x-request-id; trace_sampled gates span recording (head
+    # sampling — an unsampled request still keeps its id); span_id is the
+    # pre-allocated root span children parent onto; trace_parent is the
+    # inbound traceparent's span id (0 = we are the root)
+    trace_id: Any = None
+    trace_parent: Any = 0
+    trace_sampled: bool = False
+    span_id: int = 0
     tokens: list[int] = field(default_factory=list)
     submitted_at: float = 0.0
     admitted_at: float | None = None
@@ -290,6 +312,7 @@ class Scheduler:
                 f"({request.max_new_tokens}) exceeds slot max_len"
                 f"({self.max_len})"
             )
+            request.shed_code = SHED_TOO_LONG
             self.rejected_too_long += 1
             return request
         tenant_q = self._queues[request.tenant]
@@ -305,6 +328,8 @@ class Scheduler:
                 f"tenant queue full (max_queue={spec.max_queue})"
                 if over_tenant
                 else f"queue full (max_queue={self.max_queue})")
+            request.shed_code = (SHED_TENANT_QUEUE_FULL if over_tenant
+                                 else SHED_QUEUE_FULL)
             request.retry_after_s = self.retry_after_estimate()
             self.rejected_full += 1
             return request
@@ -364,11 +389,12 @@ class Scheduler:
 
     # -- shedding ------------------------------------------------------------
 
-    def _shed(self, req: Request, reason: str, now: float,
+    def _shed(self, req: Request, reason: str, now: float, code: str,
               slo_miss: bool = False) -> None:
         self._queues[req.tenant].remove(req)
         req.status = RequestStatus.EXPIRED
         req.reject_reason = reason
+        req.shed_code = code
         req.retry_after_s = self.retry_after_estimate()
         req.finished_at = now
         self.expired += 1
@@ -395,7 +421,7 @@ class Scheduler:
                 if (r.deadline_s is not None
                         and now - r.submitted_at > r.deadline_s):
                     shed.append((r, f"deadline_s={r.deadline_s} lapsed in "
-                                 "queue", False))
+                                 "queue", SHED_DEADLINE, False))
                     continue
                 slo = self.effective_slo(r)
                 if slo is None or self.step_time_ema == 0.0:
@@ -404,10 +430,11 @@ class Scheduler:
                          + self._prefill_cost(r) * self.step_time_ema)
                 if floor > slo:
                     shed.append((r, f"certain TTFT SLO miss (slo={slo}s, "
-                                 f"floor={floor:.3f}s)", True))
-        for r, reason, slo_miss in shed:
-            self._shed(r, reason, now, slo_miss=slo_miss)
-        return [r for r, _, _ in shed]
+                                 f"floor={floor:.3f}s)", SHED_CERTAIN_MISS,
+                                 True))
+        for r, reason, code, slo_miss in shed:
+            self._shed(r, reason, now, code, slo_miss=slo_miss)
+        return [r for r, _, _, _ in shed]
 
     def _shed_predicted_miss(self, newcomer: Request) -> bool:
         """Queue-pressure victim selection: shed the queued request most
@@ -442,7 +469,8 @@ class Scheduler:
         if worst is None:
             return False
         self._shed(worst, "shed under pressure: predicted TTFT "
-                   f"{worst_slack:+.3f}s past SLO", now, slo_miss=True)
+                   f"{worst_slack:+.3f}s past SLO", now,
+                   SHED_PRESSURE_VICTIM, slo_miss=True)
         return True
 
     def _displace_lower_tier(self, newcomer: Request) -> bool:
@@ -467,7 +495,7 @@ class Scheduler:
         if worst is None:
             return False
         self._shed(worst, f"displaced by a tier-{my_tier} arrival under "
-                   "queue pressure", self.clock(),
+                   "queue pressure", self.clock(), SHED_DISPLACED,
                    slo_miss=self.effective_slo(worst) is not None)
         return True
 
@@ -667,3 +695,33 @@ class Scheduler:
 
     def running(self) -> Iterable[Request]:
         return [s.request for s in self.slots if s.request is not None]
+
+    def debug_state(self) -> dict:
+        """JSON-safe policy-state snapshot for `/debug/scheduler` and
+        incident bundles: per-tenant queue depths + DRR deficits, tier
+        membership, the step-time EMA every SLO estimate is denominated
+        in, and the shed counters. Read-only; numbers only."""
+        tenants = {}
+        for name, spec in self.tenants.items():
+            tenants[name] = {
+                "priority": spec.priority,
+                "weight": spec.weight,
+                "ttft_slo_s": spec.ttft_slo_s,
+                "max_queue": spec.max_queue,
+                "queue_depth": len(self._queues.get(name, ())),
+                "drr_deficit": self._deficit.get(name, 0.0),
+            }
+        return {
+            "queue_depth": self.queue_depth,
+            "max_queue": self.max_queue,
+            "live_slots": self.live_slots,
+            "num_slots": len(self.slots),
+            "step_time_ema_s": self.step_time_ema,
+            "drr_quantum": self.drr_quantum,
+            "rejected_full": self.rejected_full,
+            "rejected_too_long": self.rejected_too_long,
+            "expired": self.expired,
+            "expired_slo": self.expired_slo,
+            "tiers": {str(p): list(ring) for p, ring in self._rr.items()},
+            "tenants": tenants,
+        }

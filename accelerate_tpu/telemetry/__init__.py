@@ -38,7 +38,13 @@ from .trace import (
     configure_tracing,
     export_chrome_trace,
     flight_recorder,
+    format_traceparent,
+    head_sample,
+    new_trace_id,
+    parse_traceparent,
+    record_span,
     span,
+    trace_events,
     tracing_enabled,
 )
 from .export import (
@@ -53,10 +59,16 @@ from .export import (
 )
 from .aggregate import aggregate_flat, aggregate_snapshot
 from .watchdog import (
+    INCIDENT_DIR_ENV,
     STALL_TIMEOUT_ENV,
     StallError,
     StallWatchdog,
+    build_exception_report,
+    list_incident_bundles,
+    load_incident_bundle,
+    resolve_incident_dir,
     resolve_stall_timeout,
+    write_incident_bundle,
 )
 
 __all__ = [
@@ -67,9 +79,15 @@ __all__ = [
     "flatten_snapshot",
     "get_registry",
     "span",
+    "record_span",
     "configure_tracing",
     "tracing_enabled",
+    "head_sample",
+    "new_trace_id",
+    "parse_traceparent",
+    "format_traceparent",
     "flight_recorder",
+    "trace_events",
     "clear_flight_recorder",
     "export_chrome_trace",
     "MetricsServer",
@@ -86,6 +104,12 @@ __all__ = [
     "StallError",
     "resolve_stall_timeout",
     "STALL_TIMEOUT_ENV",
+    "INCIDENT_DIR_ENV",
+    "resolve_incident_dir",
+    "write_incident_bundle",
+    "build_exception_report",
+    "list_incident_bundles",
+    "load_incident_bundle",
 ]
 
 if os.environ.get("ACCELERATE_TPU_TRACE", "").strip() in ("1", "true", "on"):

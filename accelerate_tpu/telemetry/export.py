@@ -31,6 +31,9 @@ __all__ = [
     "resolve_metrics_port",
     "snapshot_for_tracking",
     "write_snapshot",
+    "PROMETHEUS_CONTENT_TYPE",
+    "OPENMETRICS_CONTENT_TYPE",
+    "negotiate_exposition",
 ]
 
 METRICS_PORT_ENV = "ACCELERATE_TPU_METRICS_PORT"
@@ -64,8 +67,26 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
-def render_prometheus(registry: MetricsRegistry | None = None) -> str:
-    """Text exposition (version 0.0.4) of every series in the registry."""
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def render_prometheus(registry: MetricsRegistry | None = None,
+                      openmetrics: bool = False) -> str:
+    """Text exposition of every series in the registry.
+
+    Default: Prometheus text format 0.0.4 (histogram sketches rendered
+    as `summary` quantile series + `_sum`/`_count`). `openmetrics=True`
+    switches to the OpenMetrics flavor: sketches that carry exemplars
+    (TTFT, per-token latency — see `StreamingHistogram.record(...,
+    exemplar=)`) render as real `histogram` families with cumulative
+    `_bucket{le=...}` lines, each bucket's newest exemplar attached as
+    `# {trace_id="..."} value ts` — a bad p99 bucket links straight to
+    the trace that landed in it — and the document ends with `# EOF`.
+    Exemplar-less series render identically in both modes, so scrape
+    configs can negotiate per request (Accept header) without the two
+    views disagreeing on values."""
     registry = registry or get_registry()
     lines: list[str] = []
     seen_types: set[str] = set()
@@ -78,12 +99,43 @@ def render_prometheus(registry: MetricsRegistry | None = None) -> str:
     for kind, name, labels, metric in registry.items():
         pname = _sanitize(name)
         if kind == "counter":
-            type_line(pname, "counter")
+            # OpenMetrics 1.0: a counter FAMILY is named without the
+            # _total suffix while its sample keeps it — a strict OM
+            # parser (Prometheus with exemplar scraping on) rejects the
+            # whole scrape otherwise. The 0.0.4 flavor keeps the
+            # long-standing family==sample naming.
+            family = (pname[:-len("_total")]
+                      if openmetrics and pname.endswith("_total")
+                      else pname)
+            type_line(family, "counter")
             lines.append(f"{pname}{_fmt_labels(labels)} {_fmt_value(metric.value)}")
         elif kind == "gauge":
             type_line(pname, "gauge")
             lines.append(f"{pname}{_fmt_labels(labels)} {_fmt_value(metric.value)}")
-        else:  # histogram -> summary (quantiles come from the sketch)
+        else:
+            exemplars = metric.exemplars() if openmetrics else {}
+            if exemplars:  # histogram with bucket exemplars
+                type_line(pname, "histogram")
+                by_bound = {
+                    round(metric.bucket_upper_bound(idx), 12): ex
+                    for idx, ex in exemplars.items()}
+                for bound, cum in metric.bucket_counts():
+                    le = f'le="{_fmt_value(bound)}"'
+                    line = f"{pname}_bucket{_fmt_labels(labels, le)} {cum}"
+                    ex = by_bound.get(round(bound, 12))
+                    if ex is not None:
+                        val, label, ts = ex
+                        line += (f' # {{trace_id="{_escape(label)}"}} '
+                                 f"{_fmt_value(val)} {ts:.3f}")
+                    lines.append(line)
+                inf_le = 'le="+Inf"'
+                lines.append(
+                    f"{pname}_bucket{_fmt_labels(labels, inf_le)}"
+                    f" {metric.count}")
+                lines.append(f"{pname}_sum{_fmt_labels(labels)} {_fmt_value(metric.sum)}")
+                lines.append(f"{pname}_count{_fmt_labels(labels)} {_fmt_value(metric.count)}")
+                continue
+            # histogram -> summary (quantiles come from the sketch)
             type_line(pname, "summary")
             for q in _QUANTILES:
                 val = metric.quantile(q) if metric.count else float("nan")
@@ -93,24 +145,49 @@ def render_prometheus(registry: MetricsRegistry | None = None) -> str:
                 )
             lines.append(f"{pname}_sum{_fmt_labels(labels)} {_fmt_value(metric.sum)}")
             lines.append(f"{pname}_count{_fmt_labels(labels)} {_fmt_value(metric.count)}")
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def negotiate_exposition(accept: str | None,
+                         registry: MetricsRegistry | None = None,
+                         ) -> tuple[str, str]:
+    """(body, content_type) for one scrape, negotiated from the Accept
+    header: an OpenMetrics-capable scraper (Prometheus sends this Accept
+    when exemplar scraping is on) gets the exemplar-carrying flavor,
+    everyone else the 0.0.4 text format. The ONE negotiation shared by
+    the standalone exporter and the serving front door's /metrics route
+    — they must never diverge."""
+    om = "application/openmetrics-text" in (accept or "")
+    body = render_prometheus(registry, openmetrics=om)
+    return body, (OPENMETRICS_CONTENT_TYPE if om
+                  else PROMETHEUS_CONTENT_TYPE)
 
 
 class _Handler(BaseHTTPRequestHandler):
     registry: MetricsRegistry | None = None  # set per server subclass
 
-    def do_GET(self):  # noqa: N802 (stdlib API)
+    def _respond(self, include_body: bool) -> None:
         if self.path.split("?")[0] not in ("/metrics", "/"):
             self.send_response(404)
             self.end_headers()
             return
-        body = render_prometheus(self.registry).encode()
+        text, ctype = negotiate_exposition(self.headers.get("Accept"),
+                                           self.registry)
+        body = text.encode()
         self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if include_body:
+            self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib API)
+        self._respond(include_body=True)
+
+    def do_HEAD(self):  # noqa: N802 — health probes HEAD before scraping
+        self._respond(include_body=False)
 
     def log_message(self, *args):  # scrapes are not log lines
         pass

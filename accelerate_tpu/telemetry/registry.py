@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Any, Iterator
 
 __all__ = [
@@ -115,11 +116,19 @@ class StreamingHistogram:
 
     Mergeable (`merge`) and serializable (`to_dict`/`from_dict`) so
     per-host sketches can be combined into a global distribution.
+
+    Exemplars (ISSUE 8): `record(value, exemplar="<trace-id>")` keeps the
+    most recent exemplar PER LOG BUCKET (bounded by `_MAX_EXEMPLARS`,
+    highest buckets kept — the tail is where an exemplar earns its keep:
+    a bad p99 bucket links straight to the trace that landed in it). The
+    OpenMetrics exposition renders them on `_bucket` lines.
     """
+
+    _MAX_EXEMPLARS = 64
 
     __slots__ = ("name", "labels", "relative_accuracy", "max_buckets",
                  "_gamma_ln", "_buckets", "_zero_count", "_count", "_sum",
-                 "_min", "_max", "_lock")
+                 "_min", "_max", "_lock", "_exemplars")
 
     def __init__(self, name: str = "", labels: tuple = (),
                  relative_accuracy: float = 0.01, max_buckets: int = 2048):
@@ -138,10 +147,11 @@ class StreamingHistogram:
         self._min = math.inf
         self._max = -math.inf
         self._lock = threading.Lock()
+        self._exemplars: dict[int, tuple[float, str, float]] = {}
 
     # -- recording -----------------------------------------------------------
 
-    def record(self, value: float) -> None:
+    def record(self, value: float, exemplar: str | None = None) -> None:
         value = float(value)
         with self._lock:
             self._count += 1
@@ -158,6 +168,11 @@ class StreamingHistogram:
                 return
             idx = math.ceil(math.log(value) / self._gamma_ln)
             self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            if exemplar is not None:
+                self._exemplars[idx] = (value, str(exemplar), time.time())
+                if len(self._exemplars) > self._MAX_EXEMPLARS:
+                    # keep the TAIL: low buckets are the boring fast ones
+                    del self._exemplars[min(self._exemplars)]
             if len(self._buckets) > self.max_buckets:
                 self._collapse_lowest()
 
@@ -165,6 +180,9 @@ class StreamingHistogram:
         keys = sorted(self._buckets)
         lo, nxt = keys[0], keys[1]
         self._buckets[nxt] += self._buckets.pop(lo)
+        # an exemplar must stay <= its bucket's upper bound: a collapsed
+        # bucket's exemplar would violate that in the wider bucket — drop
+        self._exemplars.pop(lo, None)
 
     # -- stats ---------------------------------------------------------------
 
@@ -215,6 +233,33 @@ class StreamingHistogram:
                     return min(max(self._bucket_value(idx), self._min),
                                self._max)
             return self._max
+
+    def bucket_upper_bound(self, idx: int) -> float:
+        """Upper bound (`le`) of log bucket `idx` — gamma^idx."""
+        return math.exp(idx * self._gamma_ln)
+
+    def bucket_counts(self) -> list[tuple[float, int]]:
+        """Cumulative (upper_bound, count) pairs, Prometheus-histogram
+        shaped: ascending `le`, counts cumulative, zero/negative samples
+        folded into a leading `le=0` bucket. The +Inf bucket is implied
+        (== count)."""
+        with self._lock:
+            buckets = sorted(self._buckets.items())
+            zero = self._zero_count
+        out: list[tuple[float, int]] = []
+        seen = zero
+        if zero:
+            out.append((0.0, zero))
+        for idx, n in buckets:
+            seen += n
+            out.append((self.bucket_upper_bound(idx), seen))
+        return out
+
+    def exemplars(self) -> dict[int, tuple[float, str, float]]:
+        """bucket idx -> (value, exemplar label, unix ts), newest per
+        bucket."""
+        with self._lock:
+            return dict(self._exemplars)
 
     def summary(self, quantiles: tuple[float, ...] = (0.5, 0.9, 0.99)) -> dict:
         out = {"count": float(self._count), "sum": self._sum}
@@ -275,6 +320,7 @@ class StreamingHistogram:
     def reset(self) -> None:
         with self._lock:
             self._buckets.clear()
+            self._exemplars.clear()
             self._zero_count = 0
             self._count = 0
             self._sum = 0.0

@@ -22,6 +22,7 @@ tests drive it deterministically.
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
@@ -29,12 +30,15 @@ import time
 import traceback
 from typing import Any, Callable
 
-from .trace import flight_recorder
+from .trace import export_chrome_trace, flight_recorder
 
 __all__ = ["StallWatchdog", "StallError", "resolve_stall_timeout",
-           "STALL_TIMEOUT_ENV"]
+           "STALL_TIMEOUT_ENV", "INCIDENT_DIR_ENV", "resolve_incident_dir",
+           "write_incident_bundle", "build_exception_report",
+           "list_incident_bundles", "load_incident_bundle"]
 
 STALL_TIMEOUT_ENV = "ACCELERATE_TPU_STALL_TIMEOUT_S"
+INCIDENT_DIR_ENV = "ACCELERATE_TPU_INCIDENT_DIR"
 
 
 class StallError(RuntimeError):
@@ -51,6 +55,16 @@ def resolve_stall_timeout(explicit: float | None = None) -> float | None:
     return float(raw)
 
 
+def resolve_incident_dir(explicit: str | None = None) -> str | None:
+    """Where incident bundles land: explicit kwarg wins, else
+    `ACCELERATE_TPU_INCIDENT_DIR`; None means bundles are off (the stall
+    report still goes to the log — a bundle is the on-disk superset)."""
+    if explicit is not None:
+        return str(explicit)
+    raw = os.environ.get(INCIDENT_DIR_ENV, "").strip()
+    return raw or None
+
+
 def _all_thread_stacks() -> dict[str, list[str]]:
     names = {t.ident: t.name for t in threading.enumerate()}
     stacks: dict[str, list[str]] = {}
@@ -58,6 +72,162 @@ def _all_thread_stacks() -> dict[str, list[str]]:
         label = f"{names.get(ident, 'unknown')}-{ident}"
         stacks[label] = traceback.format_stack(frame)
     return stacks
+
+
+# -- incident bundles --------------------------------------------------------
+#
+# A stall report in the log answers "what was the process doing"; a pod-scale
+# deployment needs the same answer from RECORDED state after the host was
+# recycled (ROADMAP item 1: a misbehaving host must be debuggable without a
+# live debugger). The bundle is one self-contained directory per incident:
+#
+#     incident-<utc-stamp>-<name>/
+#       manifest.json        what/when/why + the file list (read this first)
+#       report.json          the full machine-readable report
+#       stacks.txt           every thread's Python stack, human-formatted
+#       trace.json           flight-recorder chrome://tracing export
+#       metrics.json         registry snapshot (when a registry was wired)
+#       metrics.prom         the same, Prometheus text exposition
+#       device_memory.json   per-device HBM stats (best effort)
+#       <extra>.json         caller dumps (scheduler state, slot table, ...)
+#
+# `accelerate-tpu incident list/show` renders these.
+
+BUNDLE_VERSION = 1
+
+
+def write_incident_bundle(base_dir: str, report: dict, *,
+                          registry=None, dumps: dict[str, Any] | None = None,
+                          name: str = "stall") -> str:
+    """Write one self-contained incident bundle directory under
+    `base_dir`; returns its path. Everything is best-effort per file — a
+    bundle with a missing metrics snapshot still carries the stacks."""
+    safe = "".join(c if c.isalnum() or c in "-_" else "-" for c in name)
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.gmtime())
+    path = os.path.join(base_dir, f"incident-{stamp}-{safe}")
+    n = 1
+    while os.path.exists(path):  # same-second incidents get a suffix
+        n += 1
+        path = os.path.join(base_dir, f"incident-{stamp}-{safe}-{n}")
+    os.makedirs(path)
+    files: list[str] = []
+
+    def _write(fname: str, text: str) -> None:
+        with open(os.path.join(path, fname), "w") as f:
+            f.write(text)
+        files.append(fname)
+
+    def _write_json(fname: str, obj: Any) -> None:
+        _write(fname, json.dumps(obj, indent=2, default=str))
+
+    def _best_effort(fn: Callable[[], None]) -> None:
+        try:
+            fn()
+        except Exception as e:
+            errors.append(f"{type(e).__name__}: {e}")
+
+    errors: list[str] = []
+    _best_effort(lambda: _write_json("report.json", report))
+    stacks = report.get("stacks") or {}
+    if stacks:
+        text = "\n".join(
+            f"--- thread {label} ---\n" + "".join(stack).rstrip()
+            for label, stack in stacks.items())
+        _best_effort(lambda: _write("stacks.txt", text + "\n"))
+    _best_effort(lambda: _write_json("trace.json", export_chrome_trace()))
+    if registry is not None:
+        _best_effort(lambda: _write_json(
+            "metrics.json", registry.snapshot(include_sketch=True)))
+
+        def _prom():
+            from .export import render_prometheus
+
+            _write("metrics.prom", render_prometheus(registry))
+
+        _best_effort(_prom)
+    if "device_memory_stats" in report:
+        _best_effort(lambda: _write_json(
+            "device_memory.json", report["device_memory_stats"]))
+    for key, obj in (dumps or {}).items():
+        safe_key = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in str(key))
+        _best_effort(lambda k=safe_key, o=obj: _write_json(f"{k}.json", o))
+    manifest = {
+        "version": BUNDLE_VERSION,
+        "kind": safe,
+        "created_at": time.time(),
+        "created_at_utc": stamp,
+        "silence_s": report.get("silence_s"),
+        "error": report.get("error"),
+        "files": files,
+    }
+    if errors:
+        manifest["write_errors"] = errors
+    _write_json("manifest.json", manifest)
+    return path
+
+
+def build_exception_report(exc: BaseException, name: str = "crash") -> dict:
+    """A stall-report-shaped dict for a DIED loop (vs a silent one): the
+    exception + its traceback next to the same thread stacks / flight
+    recorder / HBM stats the watchdog captures, so one bundle format
+    covers both failure modes."""
+    report: dict[str, Any] = {
+        "watchdog": name,
+        "error": f"{type(exc).__name__}: {exc}",
+        "traceback": traceback.format_exception(type(exc), exc,
+                                                exc.__traceback__),
+        "stacks": _all_thread_stacks(),
+        "flight_recorder": flight_recorder(64),
+    }
+    try:
+        from ..profiler import device_memory_stats
+
+        report["device_memory_stats"] = device_memory_stats()
+    except Exception as e:
+        report["device_memory_stats"] = {"error": f"{type(e).__name__}: {e}"}
+    return report
+
+
+def list_incident_bundles(base_dir: str) -> list[dict]:
+    """Manifest summaries of every bundle under `base_dir`, newest first.
+    Each entry carries `path` plus the manifest fields; unreadable
+    bundles appear with an `error` so forensics never silently skips."""
+    out: list[dict] = []
+    if not os.path.isdir(base_dir):
+        return out
+    for entry in sorted(os.listdir(base_dir)):
+        if not entry.startswith("incident-"):
+            continue
+        path = os.path.join(base_dir, entry)
+        if not os.path.isdir(path):
+            continue
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                manifest = json.load(f)
+        except Exception as e:
+            manifest = {"error": f"unreadable manifest: {e}"}
+        manifest["path"] = path
+        out.append(manifest)
+    out.sort(key=lambda m: m.get("created_at", 0), reverse=True)
+    return out
+
+
+def load_incident_bundle(path: str) -> dict:
+    """Load a bundle directory into {manifest, report, files}; JSON files
+    parsed, text files raw."""
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    contents: dict[str, Any] = {}
+    for fname in manifest.get("files", []):
+        fpath = os.path.join(path, fname)
+        try:
+            with open(fpath) as f:
+                contents[fname] = (json.load(f) if fname.endswith(".json")
+                                   else f.read())
+        except Exception as e:
+            contents[fname] = {"error": f"{type(e).__name__}: {e}"}
+    return {"path": path, "manifest": manifest, "files": contents}
 
 
 class StallWatchdog:
@@ -76,6 +246,9 @@ class StallWatchdog:
         flight_recorder_tail: int = 64,
         logger=None,
         name: str = "accelerate-tpu",
+        incident_dir: str | None = None,
+        registry=None,
+        dumps: Callable[[], dict] | None = None,
     ):
         if timeout_s <= 0:
             raise ValueError("timeout_s must be positive")
@@ -89,6 +262,13 @@ class StallWatchdog:
         )
         self.flight_recorder_tail = flight_recorder_tail
         self.name = name
+        # incident bundles: explicit dir wins, else the env var; None = off.
+        # `registry` adds a metrics snapshot to the bundle, `dumps` is a
+        # zero-arg callable returning extra {name: obj} dumps (the serving
+        # engine passes its scheduler/slot/page introspection here).
+        self.incident_dir = resolve_incident_dir(incident_dir)
+        self.registry = registry
+        self.dumps = dumps
         if logger is None:
             from ..logging import get_logger
 
@@ -127,6 +307,25 @@ class StallWatchdog:
             self._fired = True
             self.stall_count += 1
         report = self.build_report(silence)
+        if self.incident_dir is not None:
+            # resolve the caller dumps SEPARATELY from the bundle write:
+            # dumps() walks live engine state that may be mutating under a
+            # slow-but-not-dead stall, and its failure must cost the dump
+            # files only — never the stacks/trace/metrics of the bundle
+            dumps = None
+            if self.dumps is not None:
+                try:
+                    dumps = self.dumps()
+                except Exception as e:
+                    dumps = {"dumps_error":
+                             {"error": f"{type(e).__name__}: {e}"}}
+            try:
+                report["bundle_path"] = write_incident_bundle(
+                    self.incident_dir, report, registry=self.registry,
+                    dumps=dumps, name=self.name)
+            except Exception as e:
+                # the bundle is best-effort; the log report must land
+                report["bundle_error"] = f"{type(e).__name__}: {e}"
         self._emit(report)
         if self.raise_on_stall:
             raise StallError(
@@ -176,6 +375,11 @@ class StallWatchdog:
                     f"  {e['name']} dur={e['dur_ns'] / 1e6:.3f}ms "
                     f"trace={e['trace_id']} span={e['span_id']}"
                 )
+        if "bundle_path" in report:
+            lines.append(f"incident bundle written: {report['bundle_path']} "
+                         "(accelerate-tpu incident show)")
+        elif "bundle_error" in report:
+            lines.append(f"incident bundle FAILED: {report['bundle_error']}")
         try:
             self.logger.error("\n".join(lines))
         except Exception:

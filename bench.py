@@ -1,11 +1,22 @@
 """Benchmark: flagship Llama train step, tokens/sec/chip + MFU.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"schema_version": 2, "metric": ..., "value": N, "unit": ...,
+   "vs_baseline": N}
 On a degraded run (dead tunnel, or operator-forced CPU) value and
 vs_baseline are null — a toy CPU reading in the real metric's unit is
 noise; the smoke number lives under extra.cpu_smoke_tokens_per_sec, with
 the cause under "error" (outage) or "skipped" (deliberate cpu pin).
+
+Schema v2 row contract (what BENCH_*.json trajectory tooling may rely
+on; the r03-r05 tunnel-down rounds emitted extra rows with neither
+metric nor unit, which is the blind spot this closes): the top-level
+line AND every phase row under extra.{serving,serving_prefix,server}
+carries a non-null "metric" and "unit", plus exactly ONE non-null of
+"value" / "error" / "skipped" ("skipped" marks a deliberate operator
+pin, not an outage — it is the third leg so tooling that retries on
+"error" never retries a pin). Phase rows wrap their stats dict under
+"value"; a failed phase carries the failure under "error" instead.
 
 The reference publishes no training-throughput numbers (BASELINE.md); the
 target from BASELINE.json is >=40% MFU on the causal-LM training loop, so
@@ -48,6 +59,46 @@ _PHASE_TIMEOUT = int(os.environ.get("BENCH_PHASE_TIMEOUT", "300"))
 # before the run is declared degraded and falls back to CPU.
 _TPU_RETRIES = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 _TPU_RETRY_BACKOFF_S = float(os.environ.get("BENCH_TPU_RETRY_BACKOFF_S", "5"))
+
+# bumped whenever the one-line JSON contract changes shape; v2 = the
+# per-row metric/unit + exactly-one-of-value/error/skipped guarantee
+_SCHEMA_VERSION = 2
+
+_PHASE_METRICS = {
+    "serving": ("serving_offered_load", "summary"),
+    "serving_prefix": ("serving_prefix_reuse", "summary"),
+    "server": ("server_http_load", "summary"),
+}
+
+
+def _normalize_row(row: dict, metric: str, unit: str) -> dict:
+    """Enforce the schema-v2 row contract in ONE place: non-null
+    metric/unit, and exactly one non-null of value/error/skipped (a row
+    that produced none of them is itself an error — silence must parse
+    as failure, not as success with no number)."""
+    if row.get("metric") is None:
+        row["metric"] = metric
+    if row.get("unit") is None:
+        row["unit"] = unit
+    populated = [k for k in ("error", "skipped", "value")
+                 if row.get(k) is not None]
+    if not populated:
+        row["error"] = "degraded run: no value produced"
+    else:
+        # precedence error > skipped > value: a value produced alongside
+        # a failure (or a pin) is suspect and must not parse as a result
+        for k in populated[1:]:
+            row[k] = None
+    return row
+
+
+def _phase_row(phase: str, payload: dict) -> dict:
+    """Wrap one phase child's output as a schema-v2 row: the stats dict
+    rides under "value", a failure under "error"."""
+    metric, unit = _PHASE_METRICS.get(phase, (f"bench_{phase}", "summary"))
+    if payload.get("error") is not None:
+        return _normalize_row({"error": payload["error"]}, metric, unit)
+    return _normalize_row({"value": payload}, metric, unit)
 
 
 def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
@@ -342,13 +393,18 @@ def _run_phase(phase: str, cpu: bool) -> dict:
 
 
 def _emit(payload: dict, cpu: bool) -> None:
-    """Attach the serving phase rows (each its own timed child) and print
-    the one contract line."""
+    """Attach the serving phase rows (each its own timed child), enforce
+    the schema-v2 row contract on every row, and print the one contract
+    line."""
     if os.environ.get("BENCH_SERVING", "1") == "1":
         extra = payload.setdefault("extra", {})
-        extra["serving"] = _run_phase("serving", cpu)
-        extra["serving_prefix"] = _run_phase("serving_prefix", cpu)
-        extra["server"] = _run_phase("server", cpu)
+        extra["serving"] = _phase_row("serving", _run_phase("serving", cpu))
+        extra["serving_prefix"] = _phase_row(
+            "serving_prefix", _run_phase("serving_prefix", cpu))
+        extra["server"] = _phase_row("server", _run_phase("server", cpu))
+    _normalize_row(payload, "llama_train_tokens_per_sec_per_chip",
+                   "tokens/s/chip")
+    payload["schema_version"] = _SCHEMA_VERSION
     print(json.dumps(payload))
 
 
@@ -417,6 +473,7 @@ if __name__ == "__main__":
         main()
     except Exception as e:  # absolute last resort — still one parseable line
         print(json.dumps({
+            "schema_version": _SCHEMA_VERSION,
             "metric": "llama_train_tokens_per_sec_per_chip",
             "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
             "error": f"{type(e).__name__}: {str(e)[:300]}",

@@ -368,3 +368,152 @@ def test_bench_server_row_shape():
     assert row["tenants.bronze.sent"] == 3
     assert "tenants.gold.slo_attainment" in row
     assert row["tokens_per_sec"] > 0
+
+
+def test_schema_v2_row_normalizer():
+    """ISSUE 8 satellite: every row carries non-null metric/unit plus
+    exactly one non-null of value/error/skipped — including rows that
+    arrive with none (the r03-r05 blind spot) or several."""
+    bench = _load_bench()
+    row = bench._normalize_row({}, "m", "u")
+    assert row["metric"] == "m" and row["unit"] == "u"
+    assert row["error"]  # nothing produced parses as failure
+    row = bench._normalize_row({"metric": None, "unit": None,
+                                "value": 1.0}, "m", "u")
+    assert row["metric"] == "m" and row["unit"] == "u"
+    assert row["value"] == 1.0 and row.get("error") is None
+    # error wins over a suspect value
+    row = bench._normalize_row({"value": 2.0, "error": "boom"}, "m", "u")
+    assert row["error"] == "boom" and row["value"] is None
+    # a skipped (operator pin) row stays skipped, not error
+    row = bench._normalize_row({"skipped": "pin", "value": None}, "m", "u")
+    assert row["skipped"] == "pin" and "error" not in row
+
+
+def _assert_schema_v2(line: dict):
+    assert line["schema_version"] == 2
+    rows = [line] + [line["extra"][k]
+                     for k in ("serving", "serving_prefix", "server")
+                     if k in line.get("extra", {})]
+    for row in rows:
+        assert row.get("metric"), row
+        assert row.get("unit"), row
+        populated = [k for k in ("value", "error", "skipped")
+                     if row.get(k) is not None]
+        assert len(populated) == 1, (populated, row)
+
+
+def test_emitted_line_meets_schema_v2(monkeypatch, capsys):
+    """Both the success and the all-phases-hung shapes satisfy the v2
+    row contract end to end (stubbed children, real _emit path)."""
+    bench = _load_bench()
+
+    class TrainOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 123.0, "vs_baseline": 1.0, "unit": "tokens/s/chip",
+            "extra": {"mfu": 0.5}}) + "\n"
+
+    class PhaseOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({"tokens_per_sec": 9.0}) + "\n"
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        return TrainOut() if env.get("BENCH_PHASE") == "train" \
+            else PhaseOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    monkeypatch.setenv("BENCH_SERVING", "1")
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    _assert_schema_v2(line)
+    assert line["extra"]["serving"]["value"]["tokens_per_sec"] == 9.0
+    assert line["extra"]["serving"]["metric"] == "serving_offered_load"
+
+    def hung_run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PHASE") != "train":
+            raise bench.subprocess.TimeoutExpired(cmd, timeout)
+        return TrainOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", hung_run)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    _assert_schema_v2(line)
+    assert "hung" in line["extra"]["server"]["error"]
+
+
+def test_debug_requests_and_incident_bundle_in_process(tmp_path):
+    """ISSUE 8 satellite: in-process smoke through the REAL stack — hit
+    /debug/requests on the live HTTP door, then force a watchdog stall
+    whose incident bundle (with the engine's dumps) lands in a tmpdir
+    and renders through the incident CLI."""
+    import asyncio
+    import importlib.util
+
+    from accelerate_tpu.commands.accelerate_cli import main as cli_main
+    from accelerate_tpu.server.config import ServerConfig
+    from accelerate_tpu.server.http import HttpFrontDoor
+    from accelerate_tpu.server.service import InferenceService
+    from accelerate_tpu.server.tokenizer import get_tokenizer
+    from accelerate_tpu.telemetry.watchdog import StallWatchdog
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(ROOT, "benchmarks", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    engine, cfg = sb.build_tiny_engine("gpt2", num_slots=2, max_len=32,
+                                       prefill_chunk=8)
+    service = InferenceService(
+        engine, get_tokenizer("auto", cfg.vocab_size),
+        ServerConfig(port=0, debug_endpoints=True))
+    door = HttpFrontDoor(service)
+
+    async def scenario():
+        await door.start()
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", door.port)
+            writer.write(b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+                         b"Content-Length: 47\r\n\r\n"
+                         b'{"prompt": [1,2,3], "max_tokens": 2, "n": 1 }  ')
+            await writer.drain()
+            resp = await reader.read()
+            writer.close()
+            assert b" 200 " in resp.split(b"\r\n", 1)[0], resp[:200]
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", door.port)
+            writer.write(b"GET /debug/requests HTTP/1.1\r\nHost: t\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+            resp = await reader.read()
+            writer.close()
+            head, _, body = resp.partition(b"\r\n\r\n")
+            assert b" 200 " in head
+            dbg = json.loads(body)
+            assert dbg["queued"] == [] and dbg["running"] == []
+            assert dbg["service"]["healthy"] is True
+        finally:
+            await door.stop()
+
+    asyncio.run(asyncio.wait_for(scenario(), 120))
+
+    # force a stall: fake clock, bundle into the tmpdir
+    now = [0.0]
+    wd = StallWatchdog(5.0, clock=lambda: now[0],
+                       incident_dir=str(tmp_path),
+                       registry=engine.registry,
+                       dumps=engine.incident_dumps)
+    now[0] = 9.0
+    report = wd.check()
+    assert report is not None and "bundle_path" in report
+    bundle = report["bundle_path"]
+    names = set(os.listdir(bundle))
+    assert {"manifest.json", "report.json", "stacks.txt", "trace.json",
+            "metrics.json", "scheduler.json"} <= names
+    assert cli_main(["incident", "show", os.path.basename(bundle),
+                     "--dir", str(tmp_path)]) == 0

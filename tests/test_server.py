@@ -917,3 +917,302 @@ class TestOverloadAcceptance:
         assert s["mode"] == "trace"
         assert s["tenants.default.sent"] == 4
         assert s["tenants.default.ok"] == 4
+
+
+# ---------------------------------------------------------------------------
+# request tracing through the front door + live introspection (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def _header(head: bytes, name: bytes) -> bytes | None:
+    for line in head.split(b"\r\n"):
+        key, _, value = line.partition(b":")
+        if key.strip().lower() == name:
+            return value.strip()
+    return None
+
+
+class TestRequestTracingHttp:
+    @pytest.fixture(autouse=True)
+    def _tracing_reset(self):
+        from accelerate_tpu.telemetry import (
+            clear_flight_recorder,
+            configure_tracing,
+        )
+
+        configure_tracing(enabled=False, sample_rates={},
+                          default_sample_rate=1.0)
+        clear_flight_recorder()
+        yield
+        configure_tracing(enabled=False, sample_rates={},
+                          default_sample_rate=1.0)
+        clear_flight_recorder()
+
+    def test_x_request_id_on_success_and_errors(self, gpt2_setup):
+        """Every generate response — 200, 4xx — carries x-request-id, and
+        error envelopes repeat it in-band."""
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, head, _ = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2, 3], "max_tokens": 2, "temperature": 0})
+            assert st == 200
+            rid = _header(head, b"x-request-id")
+            assert rid is not None and len(rid) == 32
+            int(rid, 16)  # 32 lowercase hex chars
+            st, head, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [1], "max_tokens": 100000})
+            assert st == 400
+            rid = _header(head, b"x-request-id")
+            assert rid is not None
+            env = json.loads(body)["error"]
+            assert env["request_id"] == rid.decode()
+
+        _run(door, scenario)
+
+    def test_inbound_traceparent_is_honored(self, gpt2_setup):
+        door, engine, cfg = _stack(gpt2_setup)
+        tid = "ab" * 16
+
+        async def scenario(port):
+            st, head, _ = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2], "max_tokens": 2, "temperature": 0},
+                headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            assert st == 200
+            assert _header(head, b"x-request-id") == tid.encode()
+
+        _run(door, scenario)
+
+    def test_malformed_traceparent_mints_fresh_id(self, gpt2_setup):
+        """Satellite: garbage traceparent is ignored — fresh valid id,
+        never an error, never propagation of the garbage."""
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            for bad in ("garbage", "00-xyz-abc-01",
+                        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01"):
+                st, head, _ = await _call(
+                    port, "/v1/completions",
+                    {"prompt": [1, 2], "max_tokens": 2, "temperature": 0},
+                    headers={"traceparent": bad})
+                assert st == 200
+                rid = _header(head, b"x-request-id")
+                assert rid is not None and len(rid) == 32
+                assert rid.decode() not in bad
+                int(rid, 16)
+
+        _run(door, scenario)
+
+    def test_shed_429_carries_trace_id_and_shed_reason(self, gpt2_setup):
+        """Acceptance: a shed request's 429 envelope names its trace AND
+        the machine-readable reason, plus the Retry-After header."""
+        door, engine, cfg = _stack(gpt2_setup, num_slots=1, max_queue=1,
+                                   max_len=4096)
+        blocker = engine.submit(np.asarray([1, 2, 3], np.int32),
+                                max_new_tokens=4000)
+        queued = engine.submit(np.asarray([4, 5], np.int32),
+                               max_new_tokens=4)
+
+        async def scenario(port):
+            st, head, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [6, 7], "max_tokens": 2})
+            assert st == 429, body
+            rid = _header(head, b"x-request-id")
+            assert rid is not None
+            assert _header(head, b"retry-after") is not None
+            env = json.loads(body)["error"]
+            assert env["request_id"] == rid.decode()
+            assert env["shed_reason"] == "queue_full"
+            engine.cancel(blocker)
+            engine.cancel(queued)
+
+        _run(door, scenario)
+
+    def test_http_request_yields_linked_trace(self, gpt2_setup):
+        """Acceptance: one HTTP request -> one trace whose chrome export
+        has queue-wait/admit/prefill/decode spans sharing the
+        x-request-id."""
+        from accelerate_tpu.telemetry import (
+            configure_tracing,
+            export_chrome_trace,
+            trace_events,
+        )
+
+        configure_tracing(enabled=True, annotate=False)
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, head, _ = await _call(
+                port, "/v1/completions",
+                {"prompt": list(range(1, 12)), "max_tokens": 3,
+                 "temperature": 0})
+            assert st == 200
+            return _header(head, b"x-request-id").decode()
+
+        rid = _run(door, scenario)
+        names = [e["name"] for e in trace_events(rid)]
+        assert "serving.queue_wait" in names
+        assert "serving.admit" in names
+        assert "serving.prefill" in names
+        assert "serving.decode_lifetime" in names
+        assert "serving.request" in names
+        doc = export_chrome_trace(trace_id=rid)
+        assert all(e["args"]["trace_id"] == rid
+                   for e in doc["traceEvents"])
+        assert engine.compile_stats() == {"admit": 1, "prefill": 1,
+                                          "decode": 1}
+
+    def test_sampling_zero_still_returns_x_request_id(self, gpt2_setup):
+        """Satellite: rate 0 -> zero spans recorded, but the client still
+        gets its request id."""
+        from accelerate_tpu.telemetry import (
+            configure_tracing,
+            trace_events,
+        )
+
+        configure_tracing(enabled=True, annotate=False,
+                          default_sample_rate=0.0)
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, head, _ = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2], "max_tokens": 2, "temperature": 0})
+            assert st == 200
+            return _header(head, b"x-request-id").decode()
+
+        rid = _run(door, scenario)
+        assert len(rid) == 32
+        assert trace_events(rid) == []
+
+    def test_metrics_route_negotiates_openmetrics_exemplars(self,
+                                                            gpt2_setup):
+        from accelerate_tpu.telemetry import configure_tracing
+
+        configure_tracing(enabled=True, annotate=False)
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, head, _ = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2, 3], "max_tokens": 2, "temperature": 0})
+            assert st == 200
+            st, head, body = await _call(port, "/metrics")
+            assert st == 200
+            assert _header(head, b"content-type").startswith(
+                b"text/plain; version=0.0.4")
+            assert b"trace_id" not in body
+            st, head, body = await _call(
+                port, "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            assert st == 200
+            assert _header(head, b"content-type").startswith(
+                b"application/openmetrics-text")
+            assert b'serving_ttft_seconds_bucket' in body
+            assert b"trace_id=" in body
+            assert body.rstrip().endswith(b"# EOF")
+            # HEAD mirrors GET minus the body on the plumbing routes —
+            # same probe config must work here and on the standalone
+            # exporter (review regression)
+            raw = await _raw(port, b"HEAD /metrics HTTP/1.1\r\nHost: t\r\n"
+                             b"Content-Length: 0\r\n\r\n")
+            head, _, body = raw.partition(b"\r\n\r\n")
+            assert b" 200 " in head and body == b""
+            assert int(_header(head, b"content-length")) > 0
+
+        _run(door, scenario)
+
+
+class TestDebugEndpoints:
+    def test_gated_off_by_default(self, gpt2_setup):
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            for section in ("requests", "slots", "pages", "scheduler"):
+                st, _, _ = await _call(port, f"/debug/{section}")
+                assert st == 404
+            # review regression: a non-GET must ALSO 404 when gated off —
+            # a 405 would fingerprint the /debug namespace to a prober
+            st, _, _ = await _call(port, "/debug/requests", body={})
+            assert st == 404
+
+        _run(door, scenario)
+
+    def test_fan_out_samples_once_per_http_request(self, gpt2_setup,
+                                                   monkeypatch):
+        """Review regression: n/best_of siblings share one trace, so the
+        head-sampling decision is made ONCE in the service — a
+        per-candidate draw at a fractional rate would record a random
+        subset of the request's spans."""
+        from accelerate_tpu.server.tokenizer import get_tokenizer
+        from accelerate_tpu.server.service import InferenceService
+        from accelerate_tpu.server.protocol import parse_completion_request
+        from accelerate_tpu.telemetry import trace as trace_mod
+
+        engine, cfg = _make_engine(gpt2_setup, num_slots=4)
+        service = InferenceService(
+            engine, get_tokenizer("auto", cfg.vocab_size),
+            ServerConfig(port=0))
+        trace_mod.configure_tracing(enabled=True, annotate=False)
+        try:
+            draws = []
+            flip = [True, False, True, False]
+
+            def fake_sample(tenant="default"):
+                draws.append(tenant)
+                return flip[len(draws) - 1]
+
+            monkeypatch.setattr(trace_mod, "head_sample", fake_sample)
+            params = parse_completion_request(
+                {"prompt": [1, 2], "max_tokens": 2, "n": 3,
+                 "temperature": 0.5, "seed": 7}, 64)
+            reqs = service.submit(params, "default", trace_id="ab" * 16)
+            assert len(draws) == 1, "one decision per HTTP request"
+            assert [r.trace_sampled for r in reqs] == [True] * 3
+            assert all(r.trace_id == "ab" * 16 for r in reqs)
+            for r in reqs:
+                engine.cancel(r)
+        finally:
+            trace_mod.configure_tracing(enabled=False)
+            engine.close()
+
+    def test_debug_views_over_http(self, gpt2_setup):
+        door, engine, cfg = _stack(
+            gpt2_setup, num_slots=1, max_len=4096,
+            server_cfg=ServerConfig(port=0, debug_endpoints=True))
+        running = engine.submit(np.asarray([1, 2, 3], np.int32),
+                                max_new_tokens=4000)
+        queued = engine.submit(np.asarray([4, 5], np.int32),
+                               max_new_tokens=4)
+
+        async def scenario(port):
+            st, _, body = await _call(port, "/debug/requests")
+            assert st == 200
+            dbg = json.loads(body)
+            assert [r["request_id"] for r in dbg["running"]] == [
+                running.request_id]
+            assert [r["request_id"] for r in dbg["queued"]] == [
+                queued.request_id]
+            assert dbg["service"]["healthy"] is True
+            st, _, body = await _call(port, "/debug/slots")
+            assert st == 200
+            slots = json.loads(body)["slots"]
+            assert slots[0]["request_id"] == running.request_id
+            st, _, body = await _call(port, "/debug/pages")
+            assert st == 200
+            assert json.loads(body)["pages_in_use"] > 0
+            st, _, body = await _call(port, "/debug/scheduler")
+            assert st == 200
+            sched = json.loads(body)
+            assert sched["queue_depth"] == 1
+            assert "default" in sched["tenants"]
+            st, _, _ = await _call(port, "/debug/nonsense")
+            assert st == 404
+            engine.cancel(running)
+            engine.cancel(queued)
+
+        _run(door, scenario)

@@ -513,6 +513,379 @@ class _SilentLogger:
 
 
 # ---------------------------------------------------------------------------
+# request-scoped trace context (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_parse_traceparent_valid(self):
+        from accelerate_tpu.telemetry import parse_traceparent
+
+        tid, pid = "ab" * 16, "cd" * 8
+        assert parse_traceparent(f"00-{tid}-{pid}-01") == (tid, pid)
+        # case-insensitive per spec, normalized to lowercase
+        assert parse_traceparent(f"00-{tid.upper()}-{pid}-01") == (tid, pid)
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-short-0011223344556677-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",   # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero parent
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # reserved version
+        "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",  # non-hex
+        "00-" + "ab" * 16 + "-" + "cd" * 8,          # missing flags
+    ])
+    def test_parse_traceparent_malformed_is_none(self, bad):
+        """Satellite contract: anything malformed -> None, so the caller
+        mints a fresh id instead of erroring or propagating garbage."""
+        from accelerate_tpu.telemetry import parse_traceparent
+
+        assert parse_traceparent(bad) is None
+
+    def test_new_trace_id_shape(self):
+        from accelerate_tpu.telemetry import new_trace_id
+
+        ids = {new_trace_id() for _ in range(32)}
+        assert len(ids) == 32
+        assert all(len(t) == 32 and int(t, 16) >= 0 for t in ids)
+
+    def test_explicit_context_and_record_span_share_a_trace(self):
+        """The request-tracing shape: a pre-allocated root id, live child
+        spans joined via trace=/parent=, retrospective spans via
+        record_span — all indexed under one trace id."""
+        from accelerate_tpu.telemetry import (
+            new_trace_id,
+            record_span,
+            trace_events,
+        )
+        from accelerate_tpu.telemetry.trace import next_span_id
+
+        configure_tracing(enabled=True, annotate=False)
+        tid = new_trace_id()
+        root = next_span_id()
+        with span("admit", trace=tid, parent=root, slot=1):
+            pass
+        record_span("queue_wait", 1.0, 2.0, trace=tid, parent=root)
+        record_span("request", 0.5, 4.0, trace=tid, span_id=root,
+                    status="finished")
+        events = trace_events(tid)
+        assert [e["name"] for e in events] == ["request", "queue_wait",
+                                               "admit"]  # by start time
+        assert all(e["trace_id"] == tid for e in events)
+        children = [e for e in events if e["name"] != "request"]
+        assert all(e["parent_id"] == root for e in children)
+        root_ev = next(e for e in events if e["name"] == "request")
+        assert root_ev["span_id"] == root
+        assert root_ev["attrs"]["status"] == "finished"
+        # the filtered chrome export carries exactly this trace
+        doc = export_chrome_trace(trace_id=tid)
+        assert len(doc["traceEvents"]) == 3
+
+    def test_span_links(self):
+        """A span serving many requests at once (one batched decode step)
+        links their traces without belonging to any one of them."""
+        configure_tracing(enabled=True, annotate=False)
+        with span("decode_step", links=["t-a", "t-b"]):
+            pass
+        ev = flight_recorder()[-1]
+        assert ev["links"] == ["t-a", "t-b"]
+        doc = export_chrome_trace()
+        assert doc["traceEvents"][-1]["args"]["links"] == ["t-a", "t-b"]
+
+    def test_ring_eviction_prunes_trace_index(self):
+        from accelerate_tpu.telemetry import record_span, trace_events
+
+        configure_tracing(enabled=True, annotate=False, ring_size=4)
+        try:
+            for i in range(10):
+                record_span("x", 0.0, 1.0, trace=f"t{i}")
+            assert len(flight_recorder()) == 4
+            assert trace_events("t0") == []          # evicted AND pruned
+            assert len(trace_events("t9")) == 1
+        finally:
+            configure_tracing(enabled=False, ring_size=4096)
+
+    def test_record_span_disabled_is_free(self):
+        from accelerate_tpu.telemetry import record_span, trace_events
+
+        assert record_span("x", 0.0, 1.0, trace="t") == 0
+        assert flight_recorder() == [] and trace_events("t") == []
+
+    def test_head_sampling_rates(self):
+        from accelerate_tpu.telemetry import head_sample
+
+        # disabled tracing: never sampled, whatever the rates say
+        configure_tracing(enabled=False, sample_rates={"gold": 1.0})
+        assert head_sample("gold") is False
+        configure_tracing(enabled=True,
+                          sample_rates={"gold": 1.0, "bronze": 0.0},
+                          default_sample_rate=1.0)
+        try:
+            assert all(head_sample("gold") for _ in range(50))
+            assert not any(head_sample("bronze") for _ in range(50))
+            assert all(head_sample("unlisted") for _ in range(50))
+            configure_tracing(default_sample_rate=0.0)
+            assert not any(head_sample("unlisted") for _ in range(50))
+        finally:
+            configure_tracing(enabled=False, sample_rates={},
+                              default_sample_rate=1.0)
+
+
+# ---------------------------------------------------------------------------
+# exporter: content negotiation, HEAD, exemplars (ISSUE 8 satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestExportNegotiation:
+    def _server(self):
+        r = MetricsRegistry()
+        r.counter("up_total").inc()
+        h = r.histogram("serving_ttft_seconds")
+        h.record(0.05, exemplar="ee" * 16)
+        return MetricsServer(registry=r, port=0, host="127.0.0.1").start(), r
+
+    def test_content_type_and_head_support(self):
+        """Satellite: proper `text/plain; version=0.0.4` Content-Type and
+        HEAD answered with headers only."""
+        server, _ = self._server()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            resp = urllib.request.urlopen(url, timeout=5)
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            body = resp.read()
+            assert b"up_total" in body
+            head_req = urllib.request.Request(url, method="HEAD")
+            head = urllib.request.urlopen(head_req, timeout=5)
+            assert head.status == 200
+            assert head.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            assert int(head.headers["Content-Length"]) == len(body)
+            assert head.read() == b""
+        finally:
+            server.stop()
+
+    def test_two_concurrent_scrapes(self):
+        """Satellite regression: two scrapers hitting the ThreadingHTTP
+        endpoint at once both get complete, parseable expositions."""
+        server, _ = self._server()
+        results: list[bytes] = []
+        errors: list[Exception] = []
+
+        def scrape():
+            try:
+                url = f"http://127.0.0.1:{server.port}/metrics"
+                results.append(urllib.request.urlopen(url, timeout=10).read())
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=scrape) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert not errors
+            assert len(results) == 2
+            for body in results:
+                series = _parse_exposition(body.decode())
+                assert float(series["up_total"]) == 1.0
+        finally:
+            server.stop()
+
+    def test_openmetrics_negotiation_carries_exemplars(self):
+        """An OpenMetrics Accept switches the exemplar-carrying series to
+        bucket histograms with `# {trace_id=...}` exemplars and an EOF
+        terminator; the default scrape is unchanged 0.0.4."""
+        server, _ = self._server()
+        try:
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            req = urllib.request.Request(
+                url, headers={"Accept": "application/openmetrics-text"})
+            resp = urllib.request.urlopen(req, timeout=5)
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            body = resp.read().decode()
+            assert "# TYPE serving_ttft_seconds histogram" in body
+            assert 'serving_ttft_seconds_bucket{le="+Inf"} 1' in body
+            assert f'trace_id="{"ee" * 16}"' in body
+            assert body.rstrip().endswith("# EOF")
+            # OpenMetrics 1.0: counter FAMILY without _total, sample
+            # with it — a strict OM parser rejects the scrape otherwise
+            assert "# TYPE up counter" in body
+            assert "# TYPE up_total counter" not in body
+            assert "up_total 1.0" in body
+            plain = urllib.request.urlopen(url, timeout=5).read().decode()
+            assert "trace_id" not in plain and "# EOF" not in plain
+            assert "# TYPE serving_ttft_seconds summary" in plain
+            assert "# TYPE up_total counter" in plain  # 0.0.4 unchanged
+        finally:
+            server.stop()
+
+    def test_exemplar_bounded_and_reset(self):
+        h = StreamingHistogram()
+        for i in range(1, 200):
+            h.record(float(i), exemplar=f"t{i}")
+        assert len(h.exemplars()) <= h._MAX_EXEMPLARS
+        # the tail is kept: the largest value's bucket still has one
+        assert any(v[1] == "t199" for v in h.exemplars().values())
+        h.reset()
+        assert h.exemplars() == {} and h.count == 0
+
+
+# ---------------------------------------------------------------------------
+# incident bundles (ISSUE 8 tentpole c)
+# ---------------------------------------------------------------------------
+
+
+class TestIncidentBundles:
+    def _fire(self, tmp_path, dumps=None, registry=None):
+        now = [0.0]
+        wd = StallWatchdog(5.0, clock=lambda: now[0], logger=_SilentLogger(),
+                           incident_dir=str(tmp_path), registry=registry,
+                           dumps=dumps, name="unit")
+        now[0] = 6.0
+        return wd.check()
+
+    def test_stall_writes_complete_bundle(self, tmp_path):
+        configure_tracing(enabled=True, annotate=False)
+        with span("last-act"):
+            pass
+        r = MetricsRegistry()
+        r.counter("serving_tokens_out_total").inc(7)
+        report = self._fire(tmp_path, registry=r,
+                            dumps=lambda: {"scheduler": {"queue_depth": 2}})
+        assert "bundle_path" in report
+        path = report["bundle_path"]
+        files = sorted(os.listdir(path))
+        for fname in ("manifest.json", "report.json", "stacks.txt",
+                      "trace.json", "metrics.json", "metrics.prom",
+                      "scheduler.json"):
+            assert fname in files, files
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["version"] >= 1
+        assert manifest["silence_s"] == pytest.approx(6.0)
+        assert set(manifest["files"]) == set(files) - {"manifest.json"}
+        trace_doc = json.load(open(os.path.join(path, "trace.json")))
+        assert any(e["name"] == "last-act" for e in trace_doc["traceEvents"])
+        sched = json.load(open(os.path.join(path, "scheduler.json")))
+        assert sched == {"queue_depth": 2}
+        prom = open(os.path.join(path, "metrics.prom")).read()
+        assert "serving_tokens_out_total 7.0" in prom
+        assert "incident" in os.path.basename(path)
+
+    def test_no_incident_dir_means_no_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("ACCELERATE_TPU_INCIDENT_DIR", raising=False)
+        now = [0.0]
+        wd = StallWatchdog(5.0, clock=lambda: now[0], logger=_SilentLogger())
+        now[0] = 6.0
+        report = wd.check()
+        assert "bundle_path" not in report
+        assert os.listdir(tmp_path) == []
+
+    def test_env_var_arms_bundles(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ACCELERATE_TPU_INCIDENT_DIR", str(tmp_path))
+        now = [0.0]
+        wd = StallWatchdog(5.0, clock=lambda: now[0], logger=_SilentLogger())
+        assert wd.incident_dir == str(tmp_path)
+        now[0] = 6.0
+        report = wd.check()
+        assert report["bundle_path"].startswith(str(tmp_path))
+
+    def test_dumps_failure_costs_only_the_dump_files(self, tmp_path):
+        """Review regression: dumps() walks live engine state and may
+        throw mid-stall — the bundle (stacks/trace/metrics) must still
+        land, with the failure recorded in a dumps_error file."""
+        r = MetricsRegistry()
+        r.counter("alive_total").inc()
+
+        def exploding_dumps():
+            raise RuntimeError("deque mutated during iteration")
+
+        report = self._fire(tmp_path, registry=r, dumps=exploding_dumps)
+        assert "bundle_path" in report, report.get("bundle_error")
+        files = set(os.listdir(report["bundle_path"]))
+        assert {"manifest.json", "report.json", "stacks.txt",
+                "trace.json", "metrics.json", "dumps_error.json"} <= files
+        err = json.load(open(os.path.join(report["bundle_path"],
+                                          "dumps_error.json")))
+        assert "deque mutated" in err["error"]
+
+    def test_bundle_failure_does_not_mask_the_report(self, tmp_path):
+        """Forensics must never break the stall report: an unwritable
+        bundle dir degrades to bundle_error, the report still lands."""
+        bad = tmp_path / "file-not-dir"
+        bad.write_text("x")
+        now = [0.0]
+        wd = StallWatchdog(5.0, clock=lambda: now[0], logger=_SilentLogger(),
+                           incident_dir=str(bad))
+        now[0] = 6.0
+        report = wd.check()
+        assert report is not None and "bundle_error" in report
+
+    def test_exception_report_shape(self, tmp_path):
+        from accelerate_tpu.telemetry import (
+            build_exception_report,
+            write_incident_bundle,
+        )
+
+        try:
+            raise RuntimeError("drive loop died")
+        except RuntimeError as e:
+            report = build_exception_report(e, name="drive-loop")
+        assert "drive loop died" in report["error"]
+        assert any("drive loop died" in ln for ln in report["traceback"])
+        assert report["stacks"]
+        path = write_incident_bundle(str(tmp_path), report,
+                                     name="drive-loop")
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["kind"] == "drive-loop"
+        assert "drive loop died" in manifest["error"]
+
+    def test_same_second_bundles_get_distinct_dirs(self, tmp_path):
+        from accelerate_tpu.telemetry import write_incident_bundle
+
+        p1 = write_incident_bundle(str(tmp_path), {"stacks": {}}, name="x")
+        p2 = write_incident_bundle(str(tmp_path), {"stacks": {}}, name="x")
+        assert p1 != p2 and os.path.isdir(p1) and os.path.isdir(p2)
+
+    def test_incident_cli_list_and_show(self, tmp_path, capsys):
+        """`accelerate-tpu incident` renders bundles: list newest-first
+        with indices, show by index/name/path, sane exit codes."""
+        from accelerate_tpu.commands.accelerate_cli import main
+        from accelerate_tpu.telemetry import write_incident_bundle
+
+        assert main(["incident", "list", "--dir", str(tmp_path)]) == 1
+        capsys.readouterr()
+        report = {"silence_s": 7.5, "stacks": {"MainThread-1": ["  fake\n"]},
+                  "flight_recorder": [
+                      {"name": "serving.decode", "dur_ns": 1000,
+                       "trace_id": "ab" * 16, "span_id": 1, "parent_id": 0}]}
+        path = write_incident_bundle(str(tmp_path), report, name="stall")
+        assert main(["incident", "list", "--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "[0]" in out and "stall" in out and "7.5s" in out
+        for ref in ("0", os.path.basename(path), path):
+            assert main(["incident", "show", ref,
+                         "--dir", str(tmp_path)]) == 0
+            out = capsys.readouterr().out
+            assert "silence  7.5s" in out
+            assert "serving.decode" in out
+        assert main(["incident", "show", "nope",
+                     "--dir", str(tmp_path)]) == 2
+        rc = main(["incident", "list", "--dir", str(tmp_path),
+                   "--format", "json"])
+        assert rc == 0
+        listed = json.loads(capsys.readouterr().out)
+        assert listed[0]["path"] == path
+
+    def test_incident_cli_requires_a_dir(self, monkeypatch, capsys):
+        from accelerate_tpu.commands.accelerate_cli import main
+
+        monkeypatch.delenv("ACCELERATE_TPU_INCIDENT_DIR", raising=False)
+        assert main(["incident", "list"]) == 2
+
+
+# ---------------------------------------------------------------------------
 # overhead guards (CI satellite): observability off must stay ~free
 # ---------------------------------------------------------------------------
 
