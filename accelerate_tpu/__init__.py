@@ -60,6 +60,8 @@ _LAZY = {
     "SlotKVCache": ".serving",
     "PagedKVCache": ".serving",
     "PrefixIndex": ".serving",
+    "PodEngine": ".serving.pod",
+    "PodConfig": ".serving.pod",
     "MetricsRegistry": ".telemetry",
     "StreamingHistogram": ".telemetry",
     "get_registry": ".telemetry",

@@ -23,6 +23,7 @@ __all__ = [
     "contract_for",
     "shard_map_contracts",
     "serving_program_contracts",
+    "pod_program_contracts",
 ]
 
 
@@ -98,15 +99,20 @@ def contract_for(name: str, flavor: str | None = None) -> CollectiveContract:
 
 
 def serving_program_contracts() -> dict[str, CollectiveContract]:
-    """Default contracts for the serving engine's three programs: a
-    single-host engine's admit/prefill/decode must carry NO collectives —
-    one appearing means a sharding leak (params accidentally mesh-placed)
-    or an explicit psum snuck into a model forward. The paged-KV cache's
+    """Default contracts for a SINGLE-DEVICE serving engine's three
+    programs: admit/prefill/decode must carry NO collectives — one
+    appearing means a sharding leak (params accidentally mesh-placed) or
+    an explicit psum snuck into a model forward. The paged-KV cache's
     page-table gathers/scatters (serving/cache.py) are plain data
     movement — `gather`/`scatter` HLO, deliberately NOT in
     CANONICAL_COLLECTIVES — so the exhaustive no-collectives clause
-    covers the paged programs unchanged. Engines deliberately serving
-    sharded models pass their own contracts via
+    covers the paged programs unchanged.
+
+    "No collectives" is the single-device promise only: a mesh-sharded
+    engine (`EngineConfig(mesh=...)`, serving/pod) MUST communicate, and
+    its strict audit defaults to `pod_program_contracts()` below —
+    which pins the tensor-parallel collectives instead of forbidding
+    them. Engines with bespoke sharding pass their own contracts via
     `EngineConfig(contracts=...)`."""
     return {
         name: CollectiveContract(
@@ -114,4 +120,51 @@ def serving_program_contracts() -> dict[str, CollectiveContract]:
             exhaustive=True,
         )
         for name in ("admit", "prefill", "decode")
+    }
+
+
+def pod_program_contracts(
+    num_layers: int | None = None,
+) -> dict[str, CollectiveContract]:
+    """Contracts for a tensor-parallel (mesh-sharded) serving engine's
+    programs (`serving/pod` layer 1, audited against the COMPILED HLO —
+    GSPMD inserts these collectives after lowering).
+
+    - `prefill`/`decode` run the sharded family forward: every layer's
+      row-parallel projections (attention out, MLP down) must reduce
+      partial sums across the model axis, so the programs REQUIRE a
+      reduction (all-reduce, or the reduce-scatter spelling some
+      partitioners pick) and, when `num_layers` is known, at least one
+      all-reduce per layer. The partitioner is free to add
+      all-gathers/collective-permutes for resharding (their count varies
+      with mesh width and XLA version — structural clauses, not pins),
+      but an all-to-all would mean head/sequence re-scattering the
+      serving layout never asks for: forbidden.
+    - `admit` touches only per-slot scalars (lengths/keys/temps) that
+      replicate: still NO collectives, exhaustively — a collective here
+      means the slot state accidentally sharded.
+    - `extract`/`install` (the page-shipping programs,
+      serving/pod/transfer.py) gather/scatter pool pages: chip-local
+      when the pool is head-sharded, at most resharding movement when it
+      is not; an all-to-all or reduction would mean page *contents* are
+      being recombined across chips, which the shipment design never
+      does: forbidden."""
+    moving = dict(
+        require=(("all-reduce", "reduce-scatter"),),
+        forbid=("all-to-all",),
+    )
+    if num_layers:
+        moving["at_least"] = {"all-reduce": int(num_layers)}
+    return {
+        "admit": CollectiveContract(
+            name="serving.pod.admit", forbid=CANONICAL_COLLECTIVES,
+            exhaustive=True),
+        "prefill": CollectiveContract(name="serving.pod.prefill", **moving),
+        "decode": CollectiveContract(name="serving.pod.decode", **moving),
+        "extract": CollectiveContract(
+            name="serving.pod.extract",
+            forbid=("all-to-all", "all-reduce", "reduce-scatter")),
+        "install": CollectiveContract(
+            name="serving.pod.install",
+            forbid=("all-to-all", "all-reduce", "reduce-scatter")),
     }

@@ -37,7 +37,8 @@ class ServerConfig:
     drain_timeout_s: float = 30.0
     request_timeout_s: float = 300.0
     # read-only live-introspection routes (/debug/requests, /debug/slots,
-    # /debug/pages, /debug/scheduler). Off by default: they expose
+    # /debug/pages, /debug/scheduler, and /debug/pod on a pod-backed
+    # engine). Off by default: they expose
     # workload shape (tenants, queue depths, prompt lengths) and belong
     # behind the same trust boundary as /metrics, which an operator must
     # opt into explicitly.

@@ -13,6 +13,10 @@ the server too, and an inference front door needs exactly these routes:
     GET  /debug/{requests,slots,pages,scheduler}
                                  read-only live introspection, gated by
                                  ServerConfig(debug_endpoints=True)
+    GET  /debug/pod              role/router state when the engine is a
+                                 serving.pod.PodEngine (404 on a single
+                                 engine, and — like every /debug route —
+                                 for every method when the gate is off)
 
 Request tracing: every generate request gets a trace id — minted fresh,
 or joined from a valid inbound W3C `traceparent` header — returned as

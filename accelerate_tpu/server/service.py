@@ -143,6 +143,12 @@ class InferenceService:
             return self.engine.debug_pages()
         if section == "scheduler":
             return self.engine.debug_scheduler()
+        if section == "pod":
+            # only a pod-backed engine (serving.pod.PodEngine) has role/
+            # router state; on a single engine the route 404s like any
+            # unknown section
+            build = getattr(self.engine, "debug_pod", None)
+            return build() if build is not None else None
         return None
 
     # -- the drive loop ------------------------------------------------------

@@ -9,6 +9,12 @@ radix tree), a `Scheduler` that admits/sheds/retires requests and
 interleaves chunked prefill with batched decode, and per-request streaming
 with TTFT/per-token metrics. `SlotKVCache` is the simpler contiguous
 slot-dense layout the pool generalizes. See docs/serving.md.
+
+The `serving.pod` subpackage scales this past one chip: `sharded_engine`
+runs one engine tensor-parallel over a mesh (SPMD), `PodEngine` splits
+prefill from decode across worker groups shipping KV pages (MPMD) —
+both behind this same API. Imported lazily here so single-device
+serving never pays for it.
 """
 
 from .cache import (
@@ -33,6 +39,24 @@ from .scheduler import (
 # unambiguous name for the top-level package namespace
 ServingEngine = Engine
 
+_POD_EXPORTS = {
+    "PodConfig", "PodEngine", "PodRouter", "KVPageShipment",
+    "PageTransport", "sharded_engine", "tensor_mesh",
+}
+
+
+def __getattr__(name):
+    # pod layer resolved lazily: `from accelerate_tpu.serving import
+    # PodEngine` works, but plain single-device serving never imports
+    # the sharding/transfer machinery
+    if name in _POD_EXPORTS:
+        from . import pod
+
+        return getattr(pod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "Engine",
     "ServingEngine",
@@ -50,4 +74,12 @@ __all__ = [
     "Slot",
     "SlotState",
     "TenantSpec",
+    # pod layer (lazy — see __getattr__)
+    "PodConfig",
+    "PodEngine",
+    "PodRouter",
+    "KVPageShipment",
+    "PageTransport",
+    "sharded_engine",
+    "tensor_mesh",
 ]

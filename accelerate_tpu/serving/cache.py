@@ -639,6 +639,19 @@ class PagedAllocator:
             pages=[n.page for n in nodes] + private,
         )
 
+    def rollback(self, alloc: PageAllocation) -> None:
+        """Undo an `allocate()` whose slot attachment never happened (the
+        pod router's adopt race): shared nodes drop their refcount,
+        private pages return to the free list, nothing is cached. The
+        inverse of allocate lives HERE so the [node pages | private]
+        layout of PageAllocation.pages stays a single-module invariant."""
+        self.index.release(alloc.nodes)
+        self.pool.release(alloc.pages[len(alloc.nodes):])
+        self.lookups -= 1
+        if alloc.nodes:
+            self.hits -= 1
+            self.tokens_reused -= alloc.reused_len
+
     def release(self, slot, finished: bool) -> None:
         """Return a retiring slot's pages: shared nodes drop a refcount
         (other sharers keep decoding untouched); on a normal finish the

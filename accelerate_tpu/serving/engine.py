@@ -86,6 +86,60 @@ from .scheduler import Request, Scheduler, Slot, SlotState
 __all__ = ["Engine", "EngineConfig"]
 
 
+def prepare_request_tracing(req: Request, trace_id, trace_parent,
+                            trace_sampled) -> None:
+    """Install the request's trace identity at submit time — shared by
+    `Engine.submit` and the pod router's front door so a request is traced
+    identically whether one engine or a worker fleet serves it. The id is
+    minted whenever tracing is on, sampled or not (request-id plumbing
+    must not depend on the sampling rate); a sampled request pre-allocates
+    its root span id so children can parent onto it before the root closes
+    at the terminal state."""
+    req.trace_id = trace_id
+    req.trace_parent = trace_parent
+    if trace_sampled is None:
+        req.trace_sampled = head_sample(req.tenant)
+    else:
+        req.trace_sampled = bool(trace_sampled) and tracing_enabled()
+    if req.trace_id is None and tracing_enabled():
+        req.trace_id = new_trace_id()
+    if req.trace_sampled:
+        req.span_id = next_span_id()
+
+
+def close_request_trace(req: Request, end: float) -> None:
+    """Close a terminal request's retrospective spans: the decode-lifetime
+    child (first token -> terminal) and the root `serving.request` span
+    carrying status/reason/shed_code. EVERY terminal path must land here
+    exactly once — finished, cancelled, rejected, shed — whether the
+    request died in an engine or at the pod router before any engine saw
+    it."""
+    if not req.trace_sampled:
+        return
+    if req.first_token_at is not None and end > req.first_token_at:
+        # decode lifetime: first token -> terminal (prefill chunks
+        # are their own child spans; this is the streaming tail)
+        record_span("serving.decode_lifetime", req.first_token_at, end,
+                    trace=req.trace_id, parent=req.span_id,
+                    tokens=len(req.tokens))
+    attrs: dict[str, Any] = {
+        "request_id": req.request_id,
+        "tenant": req.tenant,
+        "status": req.status.value,
+        "prompt_len": req.prompt_len,
+        "tokens": len(req.tokens),
+    }
+    if req.ttft_s is not None:
+        attrs["ttft_s"] = req.ttft_s
+    if req.reject_reason is not None:
+        attrs["reason"] = req.reject_reason
+    if req.shed_code is not None:
+        attrs["shed_code"] = req.shed_code
+    record_span("serving.request", req.submitted_at, end,
+                trace=req.trace_id, parent=req.trace_parent,
+                span_id=req.span_id, **attrs)
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineConfig:
     """Serving knobs. `max_len` bounds prompt+generated per slot (admission
@@ -143,10 +197,27 @@ class EngineConfig:
     # collectives (a psum snuck into the family forward). `contracts`
     # maps program name ("admit"/"prefill"/"decode") to an
     # analysis.CollectiveContract; None = the single-host default (NO
-    # collectives, exhaustively). Findings land in the engine registry as
+    # collectives, exhaustively) — or, when `mesh` is set, the
+    # tensor-parallel `analysis.contracts.pod_program_contracts()` (the
+    # sharded programs MUST carry the TP collectives; see below).
+    # Findings land in the engine registry as
     # analysis_findings_total{rule=...}.
     strict: str | None = None
     contracts: Any = None
+    # SPMD serving (serving/pod layer 1): a `jax.sharding.Mesh` with a
+    # "model" axis. The engine then places its KV pool (sharded over KV
+    # heads when they divide the axis, replicated otherwise) and its
+    # per-slot state (replicated) on the mesh, and pins each program's
+    # out_shardings to the same layout — without the pin GSPMD is free to
+    # pick a different output sharding each step and the cache's sharding
+    # (part of the jit cache key) never reaches a fixed point, so the
+    # compile count creeps instead of staying flat at three. Params must
+    # be mesh-placed by the caller (`serving.pod.shard_params`, or the
+    # `serving.pod.sharded_engine` factory that does all of this).
+    # strict-mode audits switch to the COMPILED program text (GSPMD
+    # inserts the TP collectives after lowering), which costs one extra
+    # XLA compile per program at first use.
+    mesh: Any = None
 
 
 def _cache_spec(config) -> tuple[int, int, int]:
@@ -192,6 +263,15 @@ class Engine:
         self.config = config
         self.params = params
         self.engine_config = ec = engine_config or EngineConfig()
+        if ec.mesh is not None and getattr(ec.mesh, "size", 1) <= 1:
+            # a 1-device "mesh" IS single-device serving: there are no
+            # collectives to contract-pin and no layouts to hold at a
+            # fixed point — normalizing it away here keeps
+            # `sharded_engine(..., tensor_parallel=1)` (and a 1-device
+            # host) on the ordinary single-device path instead of
+            # tripping the meshed strict audit, which demands sharded
+            # args and TP reductions that can never exist on one chip
+            self.engine_config = ec = dataclasses.replace(ec, mesh=None)
         self._forward = family if callable(family) else family.forward
         self._tracker = tracker
         self._log_every = log_every
@@ -205,9 +285,15 @@ class Engine:
                 f"strict must be None, 'warn', or 'error'; got {ec.strict!r}")
         self._contracts = ec.contracts
         if ec.strict is not None and self._contracts is None:
-            from ..analysis.contracts import serving_program_contracts
+            if ec.mesh is not None:
+                from ..analysis.contracts import pod_program_contracts
 
-            self._contracts = serving_program_contracts()
+                self._contracts = pod_program_contracts(
+                    num_layers=getattr(config, "num_hidden_layers", None))
+            else:
+                from ..analysis.contracts import serving_program_contracts
+
+                self._contracts = serving_program_contracts()
         # name -> None (audited clean/warned) | AnalysisViolation (cached:
         # re-raised on every later use without re-counting the findings)
         self._audited: dict = {}
@@ -218,6 +304,16 @@ class Engine:
             dtype=ec.cache_dtype, page_size=ec.page_size,
             pad_slack=ec.prefill_chunk, num_pages=ec.num_pages,
         )
+        # SPMD serving: place the pool + per-slot state on the mesh and
+        # remember the layout — _build_programs pins it as out_shardings
+        # so every step's outputs land exactly where its inputs live (the
+        # compile-count-flat fixed point; see EngineConfig.mesh)
+        self._mesh_shardings = None
+        if ec.mesh is not None:
+            from .pod.mesh import cache_state_shardings
+
+            self._mesh_shardings = cache_state_shardings(self.cache, ec.mesh)
+            self.cache = jax.device_put(self.cache, self._mesh_shardings[0])
         # per-engine registry (not the process default) so concurrent
         # engines in one process never collide on series; the histograms
         # are streaming sketches, so a server that steps forever still
@@ -262,7 +358,20 @@ class Engine:
         self._slot_keys = jax.random.key_data(
             jax.random.split(jax.random.key(ec.seed), ec.num_slots))
         self._temps = jnp.zeros((ec.num_slots,), jnp.float32)
+        if self._mesh_shardings is not None:
+            rep = self._mesh_shardings[1]
+            self._tokens = jax.device_put(self._tokens, rep)
+            self._slot_keys = jax.device_put(self._slot_keys, rep)
+            self._temps = jax.device_put(self._temps, rep)
         self._base_key = jax.random.key(ec.seed)
+        # admission hook: called as on_admit(slot, request) at the END of
+        # every admission, after the slot's page table and device state
+        # are installed. First-class (like PagedAllocator's on_evict/
+        # on_unmap) because external control planes — the pod router —
+        # must observe the page allocation the instant it exists: a short
+        # prompt can admit, prefill, and retire inside ONE step(), and
+        # the allocation dies with the slot.
+        self.on_admit: Any = None
         self._build_programs()
 
     # -- compiled programs ---------------------------------------------------
@@ -274,6 +383,14 @@ class Engine:
         # copying it every step; (1, 2) = cache, tokens in both programs
         don = (1, 2) if self.engine_config.donate else ()
         don_admit = (0, 1, 2) if self.engine_config.donate else ()
+        # meshed engines pin output shardings to the input layout so the
+        # jit cache key reaches its fixed point on the FIRST compile
+        # (inputs are placed to exactly these shardings in __init__)
+        admit_out = step_out = None
+        if self._mesh_shardings is not None:
+            cache_sh, rep = self._mesh_shardings
+            admit_out = (cache_sh, rep, rep)
+            step_out = (cache_sh, rep)
 
         def sample_slot(logits, key_raw, position, temp):
             """One slot's next token from [V] logits: traced temperature
@@ -287,7 +404,7 @@ class Engine:
             sampled = sample_token(scaled[None, None, :], key, 1.0)[0]
             return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
 
-        @partial(jax.jit, donate_argnums=don_admit)
+        @partial(jax.jit, donate_argnums=don_admit, out_shardings=admit_out)
         def admit(cache, slot_keys, temps, slot, key_raw, temp, reused_len):
             # a prefix hit starts the slot's length at the reused prefix
             # (those pages already hold its K/V); a miss starts at zero
@@ -296,7 +413,7 @@ class Engine:
             temps = temps.at[slot].set(temp)
             return cache, slot_keys, temps
 
-        @partial(jax.jit, donate_argnums=don)
+        @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
         def prefill(params, cache, tokens, slot_keys, temps, slot,
                     table_row, ids, real_len):
             ks, vs, length = paged_slot_view(cache, table_row, slot)
@@ -314,7 +431,7 @@ class Engine:
             tokens = tokens.at[slot].set(tok)
             return cache, tokens
 
-        @partial(jax.jit, donate_argnums=don)
+        @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
         def decode(params, cache, tokens, slot_keys, temps, live, table):
             # gather OUTSIDE the vmap: one [L, S, R, H, D] view of every
             # slot's pages, exactly the dense layout the family forward
@@ -392,22 +509,7 @@ class Engine:
             eos_token_id=eos_token_id, deadline_s=deadline_s,
             tenant=tenant, slo_ttft_s=slo_ttft_s,
         )
-        req.trace_id = trace_id
-        req.trace_parent = trace_parent
-        if trace_sampled is None:
-            req.trace_sampled = head_sample(tenant)
-        else:
-            req.trace_sampled = bool(trace_sampled) and tracing_enabled()
-        # the id is minted whenever tracing is on — sampled or not: the
-        # request id in /debug views and metric exemplars must not depend
-        # on the sampling rate (only SPAN recording does)
-        if req.trace_id is None and tracing_enabled():
-            req.trace_id = new_trace_id()
-        if req.trace_sampled:
-            # pre-allocate the root span id: children (queue wait, admit,
-            # prefill chunks) parent onto it before the root itself is
-            # recorded at the request's terminal state
-            req.span_id = next_span_id()
+        prepare_request_tracing(req, trace_id, trace_parent, trace_sampled)
         # drain first, THEN capacity-check: a slot freed since the last
         # step (or an expired entry still holding a queue position) must
         # make room before this request is judged against max_queue — the
@@ -516,19 +618,26 @@ class Engine:
         """Strict-mode program passes, once per program, at first use.
 
         Two layers: (1) a direct mesh-placement check on the argument
-        arrays — an arg spanning >1 device means GSPMD will insert
-        collectives at partitioning time, AFTER the lowering this audit
-        reads, so the 'params leaked onto a mesh' hazard is caught here at
-        the placement itself, not in program text; (2) the lowered text
-        (tracing cost, no XLA compile) — shard_map-explicit collectives
-        and host callbacks ARE visible there, and the program's
-        CollectiveContract is checked against it."""
+        arrays. On a single-host engine an arg spanning >1 device means
+        GSPMD will insert collectives at partitioning time, AFTER the
+        lowering this audit reads — the 'params leaked onto a mesh'
+        hazard, caught at the placement itself. On a MESHED engine
+        (EngineConfig.mesh) the check inverts: a prefill/decode whose
+        every argument is fully replicated (or single-device) means the
+        params were never sharded — each device computes the whole model
+        and tensor parallelism silently bought nothing. (2) the program
+        text: single-host engines read the lowering (tracing cost only —
+        shard_map-explicit collectives and host callbacks are visible
+        there); meshed engines read the COMPILED optimized HLO (one extra
+        XLA compile per program, once — the GSPMD-inserted TP collectives
+        only exist there) and check it against the pod contract."""
         if self.engine_config.strict is None:
             return
         from ..analysis.findings import Finding, run_cached_audit
         from ..analysis.program import find_host_transfers
 
         pname = f"serving.{name}"
+        on_mesh = self.engine_config.mesh is not None
 
         def audit():
             findings = []
@@ -537,7 +646,7 @@ class Engine:
                 if isinstance(leaf, jax.Array)
                 and len(leaf.sharding.device_set) > 1
             ]
-            if meshed:
+            if meshed and not on_mesh:
                 ndev = max(len(leaf.sharding.device_set) for leaf in meshed)
                 findings.append(Finding(
                     rule="ATP101",
@@ -546,12 +655,33 @@ class Engine:
                         "devices: GSPMD inserts collectives after lowering, "
                         "invisible to this audit — a single-host engine "
                         "expects unplaced params (sharded-serving setups "
-                        "must pass their own EngineConfig(contracts=...) "
-                        "and audit compiled HLO)"),
+                        "must configure EngineConfig(mesh=...), which "
+                        "audits compiled HLO against the pod contracts)"),
                     path=f"<program:{pname}>",
                     source=f"mesh-placed args x{len(meshed)}",
                 ))
-            text = jitted.lower(*args).as_text()
+            if on_mesh and name in ("prefill", "decode") and not any(
+                    isinstance(leaf, jax.Array)
+                    and len(leaf.sharding.device_set) > 1
+                    and not leaf.sharding.is_fully_replicated
+                    for leaf in jax.tree_util.tree_leaves(args)):
+                findings.append(Finding(
+                    rule="ATP101",
+                    message=(
+                        "tensor-parallel engine with no sharded argument: "
+                        "params were not mesh-placed (pass them through "
+                        "serving.pod.shard_params, or use the "
+                        "serving.pod.sharded_engine factory) — every "
+                        "device is computing the full model"),
+                    path=f"<program:{pname}>",
+                    source="mesh engine, fully-replicated args",
+                ))
+            if on_mesh:
+                # GSPMD collectives exist only post-partitioning: audit
+                # the compiled text (one extra compile, cached audit)
+                text = jitted.lower(*args).compile().as_text()
+            else:
+                text = jitted.lower(*args).as_text()
             findings += find_host_transfers(text, name=pname)
             contract = (self._contracts or {}).get(name)
             if contract is not None:
@@ -599,6 +729,8 @@ class Engine:
         with self._request_span("serving.admit", req, slot=slot.index,
                                 reused_len=alloc.reused_len):
             self.cache, self._slot_keys, self._temps = self._admit_p(*args)
+        if self.on_admit is not None:
+            self.on_admit(slot, req)
 
     def _run_prefill_chunk(self, slot: Slot) -> None:
         chunk = self.engine_config.prefill_chunk
@@ -661,37 +793,13 @@ class Engine:
         return span(name, **attrs)
 
     def _trace_terminal(self, req: Request) -> None:
-        """Close the request's retrospective spans at its terminal state.
-        EVERY terminal path lands here — finished, cancelled, rejected,
-        shed — so a shed request's trace still closes, carrying the
-        machine-readable shed reason."""
-        if not req.trace_sampled:
-            return
+        """Close the request's retrospective spans at its terminal state
+        (the shared `close_request_trace` path — the pod router closes its
+        requests through the same helper)."""
         end = req.finished_at
         if end is None:
             end = self._clock()
-        if req.first_token_at is not None and end > req.first_token_at:
-            # decode lifetime: first token -> terminal (prefill chunks
-            # are their own child spans; this is the streaming tail)
-            record_span("serving.decode_lifetime", req.first_token_at, end,
-                        trace=req.trace_id, parent=req.span_id,
-                        tokens=len(req.tokens))
-        attrs: dict[str, Any] = {
-            "request_id": req.request_id,
-            "tenant": req.tenant,
-            "status": req.status.value,
-            "prompt_len": req.prompt_len,
-            "tokens": len(req.tokens),
-        }
-        if req.ttft_s is not None:
-            attrs["ttft_s"] = req.ttft_s
-        if req.reject_reason is not None:
-            attrs["reason"] = req.reject_reason
-        if req.shed_code is not None:
-            attrs["shed_code"] = req.shed_code
-        record_span("serving.request", req.submitted_at, end,
-                    trace=req.trace_id, parent=req.trace_parent,
-                    span_id=req.span_id, **attrs)
+        close_request_trace(req, end)
 
     def _finalize_request(self, req: Request) -> None:
         """The one terminal path: close the request's trace, then fold it

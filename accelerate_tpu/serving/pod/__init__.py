@@ -1,0 +1,41 @@
+"""Pod-scale serving: mesh-sharded engines + disaggregated workers.
+
+Two composable layers over the continuous-batching engine:
+
+- **Layer 1 (SPMD, `pod.mesh`)** — one engine tensor-parallel over a
+  device mesh: params sharded by the repo's path-pattern rules, the
+  paged KV pool sharded over heads, program out_shardings pinned so the
+  compile count stays flat. `sharded_engine(...)` is the factory;
+  `EngineConfig(mesh=...)` is the knob it turns.
+
+- **Layer 2 (MPMD, `pod.router` / `pod.transfer`)** — prefill and
+  decode split into dedicated worker groups shipping KV pages:
+  `PodRouter` (alias `PodEngine`) exposes the ordinary `ServingEngine`
+  API over the fleet, with role assignment, page-transfer bookkeeping,
+  and decode-side backpressure handled host-side.
+
+Both layers are proven token-exact against the single-device engine on
+seeded traces (tier-1, forced-host-device CPU meshes). See
+docs/serving.md "Pod-scale serving".
+"""
+
+from .mesh import (
+    cache_state_shardings,
+    shard_params,
+    sharded_engine,
+    tensor_mesh,
+)
+from .router import PodConfig, PodEngine, PodRouter
+from .transfer import KVPageShipment, PageTransport
+
+__all__ = [
+    "tensor_mesh",
+    "shard_params",
+    "cache_state_shardings",
+    "sharded_engine",
+    "PodConfig",
+    "PodRouter",
+    "PodEngine",
+    "KVPageShipment",
+    "PageTransport",
+]

@@ -570,6 +570,32 @@ class Scheduler:
             admitted.append((slot, req))
         return admitted
 
+    def adopt_running(self, request: Request, alloc: Any = None,
+                      now: float | None = None) -> Slot | None:
+        """Attach an externally prepared request straight into a free
+        slot, already in DECODE state with its whole prompt accounted as
+        done — the pod page-shipping path (serving/pod): prefill happened
+        on another worker and the KV pages were installed by the caller,
+        so this slot's next step is its first decode. Bypasses the queue
+        on purpose (the pod router owns admission policy; this scheduler
+        only owns the slot table). Returns the slot, or None when no slot
+        is free — the caller must NOT have allocated pages yet in that
+        case, or must release them."""
+        now = self.clock() if now is None else now
+        for slot in self.slots:
+            if slot.state is SlotState.IDLE:
+                if request.request_id < 0:
+                    request.request_id = next(self._ids)
+                request.status = RequestStatus.RUNNING
+                if request.admitted_at is None:
+                    request.admitted_at = now
+                slot.request = request
+                slot.state = SlotState.DECODE
+                slot.alloc = alloc
+                slot.prompt_done = request.prompt_len
+                return slot
+        return None
+
     # -- the interleave policy ----------------------------------------------
 
     def next_action(self) -> tuple[str, Any] | None:

@@ -74,8 +74,15 @@ TRANSFORMER_RULES: tuple[tuple[str, SpecTemplate], ...] = (
     (r"experts.*(down_proj|w2)[/.](kernel|weight)$",
      (AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
     (r"router[/.](kernel|weight)$", (None, None)),
-    # column-parallel (output dim sharded): q/k/v, MLP up/gate — (in, out)
-    (r"(q_proj|k_proj|v_proj|query|key|value|gate_proj|up_proj|wi|w1|w3|fc1|c_fc)[/.](kernel|weight)$",
+    # column-parallel (output dim sharded): q/k/v incl. fused qkv (one
+    # [in, 3h] kernel whose out dim slices to per-device head groups) —
+    # gpt2's `c_attn` matched NO alternative and silently replicated the
+    # biggest attention matmul under tensor parallelism; neox's
+    # `query_key_value` only matched through the `value` substring (the
+    # rules are unanchored re.search), which is an accident, not a
+    # contract — both are now named explicitly — and MLP up/gate. (in, out)
+    (r"(q_proj|k_proj|v_proj|query|key|value|c_attn|query_key_value"
+     r"|gate_proj|up_proj|wi|w1|w3|fc1|c_fc)[/.](kernel|weight)$",
      (AXIS_FSDP, AXIS_MODEL)),
     # row-parallel (input dim sharded): attention out, MLP down — (in, out)
     (r"(o_proj|out_proj|dense|down_proj|wo|w2|fc2|c_proj)[/.](kernel|weight)$",

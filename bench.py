@@ -68,6 +68,7 @@ _PHASE_METRICS = {
     "serving": ("serving_offered_load", "summary"),
     "serving_prefix": ("serving_prefix_reuse", "summary"),
     "server": ("server_http_load", "summary"),
+    "pod": ("serving_pod_offered_load", "summary"),
 }
 
 
@@ -312,6 +313,27 @@ def _server_row(num_requests: int = 12) -> dict:
     return row
 
 
+def _pod_row(num_requests: int = 10) -> dict:
+    """Disaggregated-pod offered-load smoke (ISSUE 9): one prefill + one
+    decode worker with KV pages shipping between them, behind the same
+    submit/stream surface — reports the shipment counters and the
+    per-role compile counts next to the latency percentiles, so a pod
+    regression (shipments -> 0, compiles creeping) is visible in the
+    same one-line JSON as the training row."""
+    sb = _load_serve_bench()
+    engine, cfg = sb.build_tiny_pod_engine(
+        "llama", pod_roles=(1, 1), num_slots=4, max_len=128,
+        prefill_chunk=16)
+    s = sb.run_offered_load(engine, cfg.vocab_size,
+                            num_requests=num_requests, rate_hz=200.0,
+                            prompt_len=(4, 16), max_new_tokens=(4, 8))
+    keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+            "per_token_p50_ms", "requests_finished", "pod_shipments",
+            "pod_pages_shipped", "pod_backpressure_stalls",
+            "compiles_decode", "compiles_install", "compiles_extract")
+    return {k: round(float(s[k]), 3) for k in keep if k in s}
+
+
 def _child_main() -> None:
     """Runs inside a bench child process (BENCH_CHILD=1). BENCH_PHASE
     selects which phase this child IS: "train" (default, the full
@@ -326,7 +348,7 @@ def _child_main() -> None:
         from accelerate_tpu.utils.environment import force_cpu_platform
 
         force_cpu_platform()
-    if phase in ("serving", "serving_prefix", "server"):
+    if phase in ("serving", "serving_prefix", "server", "pod"):
         if not on_cpu:
             # spawned on the TPU-success path: if the tunnel dropped
             # after the train child, jax would silently fall back to CPU
@@ -340,7 +362,8 @@ def _child_main() -> None:
                 sys.exit(3)
         row = {"serving": _serving_row,
                "serving_prefix": _serving_prefix_row,
-               "server": _server_row}[phase]()
+               "server": _server_row,
+               "pod": _pod_row}[phase]()
         print(json.dumps(row))
         return
     if on_cpu:
@@ -402,6 +425,7 @@ def _emit(payload: dict, cpu: bool) -> None:
         extra["serving_prefix"] = _phase_row(
             "serving_prefix", _run_phase("serving_prefix", cpu))
         extra["server"] = _phase_row("server", _run_phase("server", cpu))
+        extra["pod"] = _phase_row("pod", _run_phase("pod", cpu))
     _normalize_row(payload, "llama_train_tokens_per_sec_per_chip",
                    "tokens/s/chip")
     payload["schema_version"] = _SCHEMA_VERSION

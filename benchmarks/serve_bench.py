@@ -24,6 +24,13 @@ unique suffix) — the workload the paged KV cache's radix-tree prefix
 reuse is built for; `--no-prefix-cache` is the A/B baseline on the same
 trace.
 
+`--pod-roles prefill=N,decode=M` drives the same offered load through a
+DISAGGREGATED pod (`serving.pod.PodEngine`): N prefill workers produce
+KV pages that ship to M decode workers owning the slots; `--pod-tp K`
+additionally mesh-shards every worker over K devices. The summary then
+carries the pod counters (`pod_shipments`, `pod_pages_shipped`,
+`pod_backpressure_stalls`) next to the usual latency percentiles.
+
 `--tenants` switches to the MULTI-TENANT HTTP harness (`run_http_load`):
 the real `accelerate_tpu.server` front door is stood up in-process on an
 ephemeral port and per-tenant client fleets drive it over actual HTTP —
@@ -83,6 +90,62 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       page_size=page_size, prefix_cache=prefix_cache,
                       metrics_port=metrics_port, tenants=tenants)
     return Engine(family, cfg, params, ec), cfg
+
+
+def parse_pod_roles(arg: str) -> tuple[int, int]:
+    """'prefill=N,decode=M' -> (N, M). Order-insensitive; both required."""
+    roles = {}
+    for part in arg.split(","):
+        name, _, val = part.strip().partition("=")
+        if name not in ("prefill", "decode") or not val.isdigit():
+            raise ValueError(
+                f"bad --pod-roles entry {part!r} (want prefill=N,decode=M)")
+        if name in roles:
+            raise ValueError(
+                f"--pod-roles names {name!r} twice — a typo'd duplicate "
+                "would silently run the wrong worker split")
+        roles[name] = int(val)
+    if set(roles) != {"prefill", "decode"}:
+        raise ValueError(
+            f"--pod-roles needs BOTH roles, got {sorted(roles)}")
+    return roles["prefill"], roles["decode"]
+
+
+def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
+                          tensor_parallel: int = 1, num_slots: int = 4,
+                          max_len: int = 128, prefill_chunk: int = 16,
+                          max_queue: int = 64, seed: int = 0,
+                          page_size: int = 16, prefix_cache: bool = True,
+                          metrics_port: int | None = None, tenants=None):
+    """A disaggregated pod (serving.pod.PodEngine) on the named family:
+    `pod_roles=(N, M)` prefill/decode workers, optionally `tensor_parallel`
+    chips per worker. Same submit/step surface as the single engine, so
+    `run_offered_load` drives it unchanged."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.serving import EngineConfig
+    from accelerate_tpu.serving.pod import PodConfig, PodEngine
+
+    if family_name == "llama":
+        from accelerate_tpu.models import llama as family
+
+        cfg = family.LlamaConfig.tiny()
+    elif family_name == "gpt2":
+        from accelerate_tpu.models import gpt2 as family
+
+        cfg = family.GPT2Config.tiny()
+    else:
+        raise ValueError(f"unknown family {family_name!r}")
+    params = family.init_params(cfg, jax.random.key(seed))
+    ec = EngineConfig(num_slots=num_slots, max_len=max_len,
+                      prefill_chunk=prefill_chunk, max_queue=max_queue,
+                      cache_dtype=jnp.bfloat16, seed=seed,
+                      page_size=page_size, prefix_cache=prefix_cache,
+                      metrics_port=metrics_port, tenants=tenants)
+    pc = PodConfig(prefill_workers=pod_roles[0], decode_workers=pod_roles[1],
+                   tensor_parallel=tensor_parallel)
+    return PodEngine(family, cfg, params, ec, pc), cfg
 
 
 def run_offered_load(
@@ -520,6 +583,13 @@ def main() -> None:
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics while the load runs "
                         "(0 = ephemeral port, printed to stderr)")
+    p.add_argument("--pod-roles", default=None, metavar="prefill=N,decode=M",
+                   help="disaggregated-pod mode: drive the offered load "
+                        "through serving.pod.PodEngine with N prefill and "
+                        "M decode workers (KV pages ship between them)")
+    p.add_argument("--pod-tp", type=int, default=1,
+                   help="with --pod-roles: tensor-parallel width per "
+                        "worker (mesh-sharded layer 1 under the pod)")
     p.add_argument("--tenants", default=None,
                    help="multi-tenant HTTP harness: semicolon-separated "
                         "specs, e.g. 'gold:priority=0,weight=4,slo=0.3,"
@@ -565,11 +635,20 @@ def main() -> None:
     if args.prefix_pool and args.prefix_len:
         max_len = max(max_len, args.prefix_len + args.prompt_len[1]
                       + args.max_new_tokens[1])
-    engine, cfg = build_tiny_engine(
-        args.family, num_slots=args.slots, max_len=max_len,
-        prefill_chunk=args.prefill_chunk, seed=args.seed,
-        page_size=args.page_size, prefix_cache=not args.no_prefix_cache,
-        metrics_port=args.metrics_port)
+    if args.pod_roles:
+        engine, cfg = build_tiny_pod_engine(
+            args.family, pod_roles=parse_pod_roles(args.pod_roles),
+            tensor_parallel=args.pod_tp, num_slots=args.slots,
+            max_len=max_len, prefill_chunk=args.prefill_chunk,
+            seed=args.seed, page_size=args.page_size,
+            prefix_cache=not args.no_prefix_cache,
+            metrics_port=args.metrics_port)
+    else:
+        engine, cfg = build_tiny_engine(
+            args.family, num_slots=args.slots, max_len=max_len,
+            prefill_chunk=args.prefill_chunk, seed=args.seed,
+            page_size=args.page_size, prefix_cache=not args.no_prefix_cache,
+            metrics_port=args.metrics_port)
     if engine.metrics_server is not None:
         import sys
 

@@ -6,6 +6,7 @@ process over 8 XLA host devices, so sharding/collective logic is exercised
 without hardware.
 """
 
+import functools
 import os
 
 # Must be set before jax initializes its backends.
@@ -70,3 +71,74 @@ def reset_state():
 @pytest.fixture
 def devices():
     return jax.devices()
+
+
+# ---------------------------------------------------------------------------
+# forced-host-device subprocess harness (pod-scale serving tests)
+# ---------------------------------------------------------------------------
+
+_FORCED_DEVICE_PROBE_CODE = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.exit(0 if jax.device_count() == int(sys.argv[1]) else 7)
+"""
+
+
+@functools.lru_cache()
+def _forced_device_unsupported(n: int) -> str | None:
+    """None when this jaxlib can stand up an N-forced-host-device CPU
+    backend in a fresh process, else a skip reason. Probed ONCE per
+    session per N with a minimal import (same spirit as
+    test_utils.multiprocess_backend_supported): some jaxlib builds
+    ignore the flag or wedge at backend init on exotic CPUs, and a pod
+    test must skip with a reason rather than fail collection or hang."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _FORCED_DEVICE_PROBE_CODE, str(n)],
+            env=env, capture_output=True, text=True, timeout=120,
+            start_new_session=True)
+    except subprocess.TimeoutExpired:
+        return f"jaxlib wedged initializing a {n}-forced-device CPU backend"
+    if proc.returncode == 7:
+        return f"jaxlib ignores xla_force_host_platform_device_count={n}"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        return (f"{n}-forced-device probe failed (rc={proc.returncode}): "
+                f"{tail[-1][:200] if tail else 'no output'}")
+    return None
+
+
+@pytest.fixture
+def forced_device_run():
+    """Run a python script in a subprocess pinned to EXACTLY `n_devices`
+    forced host CPU devices (`XLA_FLAGS=--xla_force_host_platform_
+    device_count=N` + the jax_platforms=cpu config override the hosted
+    image needs). Skips with a reason when this jaxlib can't force that
+    device count; kills the whole process group on timeout so a wedged
+    backend never hangs the suite. Returns the child's stdout."""
+    from accelerate_tpu.test_utils import execute_subprocess
+
+    def run(script_path: str, n_devices: int, args=(), timeout: int = 600):
+        reason = _forced_device_unsupported(n_devices)
+        if reason is not None:
+            pytest.skip(reason)
+        import sys
+
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count={n_devices}",
+        }
+        return execute_subprocess(
+            [sys.executable, script_path, *map(str, args)], env=env,
+            timeout=timeout)
+
+    return run

@@ -149,7 +149,7 @@ def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
               if e.get("BENCH_PHASE") != "train"]
     assert len(train) == 1, "TPU child must not be spawned under a cpu pin"
     assert train[0]["BENCH_TPU_SKIPPED"] == "1"
-    assert phases == ["serving", "serving_prefix", "server"]
+    assert phases == ["serving", "serving_prefix", "server", "pod"]
     assert all(e["JAX_PLATFORMS"] == "cpu" for e in calls)
     line = json.loads(capsys.readouterr().out.strip())
     assert "skipped" in line and "error" not in line
@@ -218,7 +218,7 @@ def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
     bench.main()
     line = json.loads(capsys.readouterr().out.strip())
     assert line["value"] == 123.0
-    for row in ("serving", "serving_prefix", "server"):
+    for row in ("serving", "serving_prefix", "server", "pod"):
         assert "no tpu visible" in line["extra"][row]["error"]
 
 
@@ -393,7 +393,7 @@ def test_schema_v2_row_normalizer():
 def _assert_schema_v2(line: dict):
     assert line["schema_version"] == 2
     rows = [line] + [line["extra"][k]
-                     for k in ("serving", "serving_prefix", "server")
+                     for k in ("serving", "serving_prefix", "server", "pod")
                      if k in line.get("extra", {})]
     for row in rows:
         assert row.get("metric"), row
@@ -445,6 +445,7 @@ def test_emitted_line_meets_schema_v2(monkeypatch, capsys):
     line = json.loads(capsys.readouterr().out.strip())
     _assert_schema_v2(line)
     assert "hung" in line["extra"]["server"]["error"]
+    assert "hung" in line["extra"]["pod"]["error"]
 
 
 def test_debug_requests_and_incident_bundle_in_process(tmp_path):
@@ -517,3 +518,61 @@ def test_debug_requests_and_incident_bundle_in_process(tmp_path):
             "metrics.json", "scheduler.json"} <= names
     assert cli_main(["incident", "show", os.path.basename(bundle),
                      "--dir", str(tmp_path)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# pod phase (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+
+def _load_serve_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(ROOT, "benchmarks", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    return sb
+
+
+def test_serve_bench_pod_roles_parse():
+    sb = _load_serve_bench()
+    assert sb.parse_pod_roles("prefill=2,decode=3") == (2, 3)
+    assert sb.parse_pod_roles("decode=1,prefill=1") == (1, 1)
+    with pytest.raises(ValueError, match="BOTH roles"):
+        sb.parse_pod_roles("prefill=2")
+    with pytest.raises(ValueError, match="bad --pod-roles"):
+        sb.parse_pod_roles("prefill=2,decode=x")
+    with pytest.raises(ValueError, match="twice"):
+        sb.parse_pod_roles("prefill=1,decode=2,decode=8")
+
+
+def test_serve_bench_pod_mode_smoke():
+    """The offered-load harness drives a disaggregated pod through the
+    same submit/step surface: miniature in-process load, shipment
+    counters populated, per-role compile counts flat."""
+    sb = _load_serve_bench()
+    engine, cfg = sb.build_tiny_pod_engine(
+        "gpt2", pod_roles=(1, 1), num_slots=2, max_len=32, prefill_chunk=8)
+    summary = sb.run_offered_load(
+        engine, cfg.vocab_size, num_requests=4, rate_hz=500.0,
+        prompt_len=(2, 6), max_new_tokens=(2, 4))
+    assert summary["requests_finished"] == 4
+    assert summary["tokens_per_sec"] > 0
+    assert summary["pod_shipments"] > 0
+    assert summary["pod_pages_shipped"] > 0
+    assert summary["compiles_decode"] == 1
+    assert summary["compiles_install"] == 1
+
+
+def test_bench_pod_row_shape():
+    """bench.py's failure-isolated extra.pod phase row: shipment
+    counters and per-role compiles next to the latency percentiles."""
+    bench = _load_bench()
+    row = bench._pod_row(num_requests=5)
+    assert row["requests_finished"] == 5
+    assert row["pod_shipments"] > 0
+    assert row["pod_pages_shipped"] >= row["pod_shipments"]
+    assert row["compiles_decode"] == 1
+    assert row["compiles_install"] == 1
+    assert row["tokens_per_sec"] > 0

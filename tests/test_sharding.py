@@ -52,6 +52,33 @@ def test_tp_fsdp_mesh_plan():
     assert plan["embed_tokens"]["embedding"].spec == P("model", "fsdp")
 
 
+def test_fused_qkv_kernels_are_column_parallel():
+    """The fused [in, 3h] qkv kernel must shard its OUT dim on the model
+    axis like the split projections do. gpt2's `c_attn` matched no rule
+    and silently REPLICATED the biggest attention matmul on a
+    tensor-parallel serving mesh (ISSUE 9); neox's `query_key_value`
+    only matched by the `value`-substring accident — both are pinned
+    explicitly now."""
+    params = {
+        "layers": {
+            "attn": {
+                "c_attn": {"kernel": jnp.zeros((2, 64, 192))},
+                "query_key_value": {"kernel": jnp.zeros((2, 64, 192))},
+            },
+        },
+    }
+    plan = plan_sharding(params, MeshConfig(axes={"fsdp": 2, "model": 4}).build())
+    attn = plan["layers"]["attn"]
+    assert attn["c_attn"]["kernel"].spec == P(None, "fsdp", "model")
+    assert attn["query_key_value"]["kernel"].spec == P(None, "fsdp", "model")
+    # a model-only serving mesh (serving.pod tensor_mesh): out dim sharded
+    from accelerate_tpu.serving.pod import tensor_mesh
+
+    plan = plan_sharding(params, tensor_mesh(4))
+    assert plan["layers"]["attn"]["c_attn"]["kernel"].spec \
+        == P(None, None, "model")
+
+
 def test_replicated_plan_when_shard_params_false():
     mesh = MeshConfig(axes={"fsdp": 8}).build()
     plan = plan_sharding(make_params(), mesh, shard_params=False)
