@@ -98,7 +98,9 @@ def contract_for(name: str, flavor: str | None = None) -> CollectiveContract:
     return contracts[name]
 
 
-def serving_program_contracts() -> dict[str, CollectiveContract]:
+def serving_program_contracts(
+    paged_kernel: bool = False,
+) -> dict[str, CollectiveContract]:
     """Default contracts for a SINGLE-DEVICE serving engine's three
     programs: admit/prefill/decode must carry NO collectives — one
     appearing means a sharding leak (params accidentally mesh-placed) or
@@ -108,15 +110,24 @@ def serving_program_contracts() -> dict[str, CollectiveContract]:
     CANONICAL_COLLECTIVES — so the exhaustive no-collectives clause
     covers the paged programs unchanged.
 
+    `paged_kernel=True` is the kernel-backed decode variant
+    (`EngineConfig(paged_attention=True)`): the Pallas paged-attention
+    custom call is a chip-local op — not a collective, not a host
+    transfer — so the decode program keeps the SAME exhaustive
+    no-collectives clause; the variant is named distinctly so a contract
+    failure report says which decode flavor it audited.
+
     "No collectives" is the single-device promise only: a mesh-sharded
     engine (`EngineConfig(mesh=...)`, serving/pod) MUST communicate, and
     its strict audit defaults to `pod_program_contracts()` below —
     which pins the tensor-parallel collectives instead of forbidding
     them. Engines with bespoke sharding pass their own contracts via
     `EngineConfig(contracts=...)`."""
+    variant = {"decode": ".paged-kernel" if paged_kernel else ""}
     return {
         name: CollectiveContract(
-            name=f"serving.{name}", forbid=CANONICAL_COLLECTIVES,
+            name=f"serving.{name}{variant.get(name, '')}",
+            forbid=CANONICAL_COLLECTIVES,
             exhaustive=True,
         )
         for name in ("admit", "prefill", "decode")
@@ -125,6 +136,7 @@ def serving_program_contracts() -> dict[str, CollectiveContract]:
 
 def pod_program_contracts(
     num_layers: int | None = None,
+    paged_kernel: bool = False,
 ) -> dict[str, CollectiveContract]:
     """Contracts for a tensor-parallel (mesh-sharded) serving engine's
     programs (`serving/pod` layer 1, audited against the COMPILED HLO —
@@ -144,23 +156,35 @@ def pod_program_contracts(
       replicate: still NO collectives, exhaustively — a collective here
       means the slot state accidentally sharded.
     - `extract`/`install` (the page-shipping programs,
-      serving/pod/transfer.py) gather/scatter pool pages: chip-local
-      when the pool is head-sharded, at most resharding movement when it
-      is not; an all-to-all or reduction would mean page *contents* are
-      being recombined across chips, which the shipment design never
-      does: forbidden."""
+      serving/pod/transfer.py) gather/scatter pool pages (int8 pools:
+      codes + scale blocks, shipped verbatim): chip-local when the pool
+      is head-sharded, at most resharding movement when it is not (incl.
+      the page-dim-sharded GQA fallback); an all-to-all or reduction
+      would mean page *contents* are being recombined across chips,
+      which the shipment design never does: forbidden.
+
+    `paged_kernel=True` names the decode contract's kernel-backed
+    variant with UNCHANGED clauses (a pallas custom call is chip-local —
+    not a collective). Today a MESHED engine always resolves
+    `paged_attention` to the dense path (the kernel is opaque to GSPMD),
+    so this variant is reached only by a future shard_map-wrapped
+    kernel; the pod layer composes with the kernel through its
+    single-device decode workers, which audit under
+    `serving_program_contracts(paged_kernel=True)`."""
     moving = dict(
         require=(("all-reduce", "reduce-scatter"),),
         forbid=("all-to-all",),
     )
     if num_layers:
         moving["at_least"] = {"all-reduce": int(num_layers)}
+    decode_name = ("serving.pod.decode.paged-kernel" if paged_kernel
+                   else "serving.pod.decode")
     return {
         "admit": CollectiveContract(
             name="serving.pod.admit", forbid=CANONICAL_COLLECTIVES,
             exhaustive=True),
         "prefill": CollectiveContract(name="serving.pod.prefill", **moving),
-        "decode": CollectiveContract(name="serving.pod.decode", **moving),
+        "decode": CollectiveContract(name=decode_name, **moving),
         "extract": CollectiveContract(
             name="serving.pod.extract",
             forbid=("all-to-all", "all-reduce", "reduce-scatter")),

@@ -26,6 +26,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .common import dot_product_attention, repeat_kv
+
 
 def make_kv_caches(num_layers: int, batch: int, max_len: int,
                    num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
@@ -45,6 +47,10 @@ def rope_table_len(config_max: int, kv_caches) -> int:
     angles, not gather-clamp every overflow position to the last row."""
     if kv_caches is None:
         return config_max
+    if getattr(kv_caches[2], "is_paged_meta", False):
+        # paged pool: the cache reach is one slot's view (pages_per_slot
+        # * page_size), not the pool's page count
+        return max(config_max, kv_caches[2].rows)
     return max(config_max, kv_caches[0].shape[2])
 
 
@@ -88,6 +94,46 @@ def windowed_cached_attention_mask(k_len: int, positions, mask=None,
         return kv_mask
     in_band = jnp.arange(k_len)[None, None, :] > positions[:, :, None] - window
     return kv_mask & in_band
+
+
+def decode_attention(q, k, v, kv_cache, positions, mask=None,
+                     window: int | None = None, n_rep: int = 1):
+    """The decode-path cache-attend step every causal family shares:
+    write this step's K/V into the cache, attend over it, return
+    (attn_out, new_cache). Dispatches on the cache flavor:
+
+    - dense stacked caches ((k, v, cache_len) of [B, M, Hkv, D]
+      buffers): exactly the classic pipeline — `extend_cache`,
+      `windowed_cached_attention_mask`, GQA `repeat_kv`, einsum
+      attention. `new_cache` is the familiar (k_full, v_full, len+S).
+    - the serving engine's paged pool (`ops.paged_attention.PagedKV`
+      pair + `PagedDecodeMeta` in the cache_len slot): each slot's live
+      pages stream through the Pallas paged-attention kernel in place —
+      no gather, no repeat_kv (the GQA group broadcast happens
+      in-kernel). `new_cache` then carries this step's per-slot K/V
+      ROWS ([B, 1, Hkv, D], cast to the pool's row dtype) for the
+      engine to scatter — the traced program never rewrites the pool.
+
+    The paged check is an attribute marker so the dense path (training,
+    single-request generate) never imports the pallas-backed module."""
+    if getattr(kv_cache[0], "is_paged_kv", False):
+        from ..ops.paged_attention import paged_decode_attention
+
+        if mask is not None:
+            raise ValueError(
+                "key-padding masks are not supported on the paged decode "
+                "path (the engine's position masking is in-kernel)")
+        pk, pv, meta = kv_cache
+        out, (k_row, v_row) = paged_decode_attention(q, k, v, pk, pv, meta,
+                                                     window=window)
+        return out, (k_row, v_row, meta)
+    k_full, v_full, new_cache = extend_cache(kv_cache, k, v)
+    m = windowed_cached_attention_mask(k_full.shape[1], positions, mask,
+                                       window)
+    out = dot_product_attention(q, repeat_kv(k_full, n_rep),
+                                repeat_kv(v_full, n_rep), mask=m,
+                                causal=False)
+    return out, new_cache
 
 
 def _is_batched_keys(key) -> bool:

@@ -29,8 +29,7 @@ from .common import (
 from .decode import (
     build_generate,
     build_streamed_generate,
-    cached_attention_mask,
-    extend_cache,
+    decode_attention,
     make_kv_caches,
     rope_table_len,
 )
@@ -140,9 +139,12 @@ def _layer_body(config: GPTJConfig, x, layer, sin, cos, positions, mask,
     ], axis=-1)
     new_cache = None
     if kv_cache is not None:
-        k, v, new_cache = extend_cache(kv_cache, k, v)
-        mask = cached_attention_mask(k.shape[1], positions, mask)
-        attn = dot_product_attention(q, k, v, mask=mask, causal=False)
+        # shared cache-attend step (models/decode.py): dense stacked
+        # caches keep the classic extend/mask/einsum path; the serving
+        # engine's paged pool streams live pages through the Pallas
+        # paged-attention kernel instead of gathering
+        attn, new_cache = decode_attention(q, k, v, kv_cache, positions,
+                                           mask=mask)
     else:
         attn = dot_product_attention(q, k, v, mask=mask, causal=True)
     attn_out, m_o = dense_maybe_fp8(

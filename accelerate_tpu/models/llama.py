@@ -30,10 +30,9 @@ import jax.numpy as jnp
 from .decode import (
     build_generate,
     build_streamed_generate,
-    extend_cache,
+    decode_attention,
     make_kv_caches,
     rope_table_len,
-    windowed_cached_attention_mask,
 )
 from .common import (
     apply_rope,
@@ -199,53 +198,56 @@ def _attention(config: LlamaConfig, layer: dict, x, cos, sin, positions, mask,
     k = apply_rope(k, cos, sin, positions)
     new_cache = None
     if kv_cache is not None:
-        k, v, new_cache = extend_cache(kv_cache, k, v)
-        mask = windowed_cached_attention_mask(k.shape[1], positions, mask,
-                                              config.sliding_window)
-        causal = False
+        # the shared cache-attend step (models/decode.py): dense stacked
+        # caches keep the classic extend/mask/einsum path; the serving
+        # engine's paged pool streams live pages through the Pallas
+        # paged-attention kernel (GQA broadcast in-kernel, no repeat_kv)
+        out, new_cache = decode_attention(
+            q, k, v, kv_cache, positions, mask=mask,
+            window=config.sliding_window, n_rep=nh // nkv)
     else:
-        causal = True
-    backend = select_attention_backend(
-        config.attention_backend,
-        on_tpu=jax.devices()[0].platform == "tpu",
-        decoding=kv_cache is not None,
-        seq_len=s,
-    )
-    window = config.sliding_window
-    # flash, ring, and ulysses all take [B, S] key-padding masks natively
-    # (ring rotates mask chunks with K/V; ulysses all-gathers the mask), so
-    # padded batches keep every fast path; all three take `window` too
-    # (ring: exact global-position banding in the einsum fold; ulysses: the
-    # band rides the flash kernel after the head scatter)
-    key_mask = mask if mask is None or getattr(mask, "ndim", 0) == 2 else None
-    if backend == "ring" and kv_cache is None and (mask is None or key_mask is not None):
-        # ring handles GQA itself: un-repeated K/V chunks ride the ring (the
-        # repeat factor never touches ICI)
-        from ..parallel.ring_attention import ring_attention
+        backend = select_attention_backend(
+            config.attention_backend,
+            on_tpu=jax.devices()[0].platform == "tpu",
+            decoding=False,
+            seq_len=s,
+        )
+        window = config.sliding_window
+        # flash, ring, and ulysses all take [B, S] key-padding masks
+        # natively (ring rotates mask chunks with K/V; ulysses all-gathers
+        # the mask), so padded batches keep every fast path; all three take
+        # `window` too (ring: exact global-position banding in the einsum
+        # fold; ulysses: the band rides the flash kernel after the head
+        # scatter)
+        key_mask = (mask if mask is None or getattr(mask, "ndim", 0) == 2
+                    else None)
+        if backend == "ring" and (mask is None or key_mask is not None):
+            # ring handles GQA itself: un-repeated K/V chunks ride the ring
+            # (the repeat factor never touches ICI)
+            from ..parallel.ring_attention import ring_attention
 
-        out = ring_attention(q, k, v, causal=True, mask=key_mask,
-                             window=window)
-    elif backend == "ulysses" and kv_cache is None and (mask is None or key_mask is not None):
-        # ulysses also keeps GQA K/V un-repeated on the wire (repeat happens
-        # after its all-to-all)
-        from ..parallel.ulysses import ulysses_attention
+            out = ring_attention(q, k, v, causal=True, mask=key_mask,
+                                 window=window)
+        elif backend == "ulysses" and (mask is None or key_mask is not None):
+            # ulysses also keeps GQA K/V un-repeated on the wire (repeat
+            # happens after its all-to-all)
+            from ..parallel.ulysses import ulysses_attention
 
-        out = ulysses_attention(q, k, v, causal=True, mask=key_mask,
-                                window=window)
-    else:
-        k = repeat_kv(k, nh // nkv)
-        v = repeat_kv(v, nh // nkv)
-        if backend == "flash" and kv_cache is None and (
-            mask is None or getattr(mask, "ndim", 0) == 2
-        ):
-            from ..ops.flash_attention import flash_attention
-
-            out = flash_attention(q, k, v, causal=True, mask=mask,
-                                  window=window)
+            out = ulysses_attention(q, k, v, causal=True, mask=key_mask,
+                                    window=window)
         else:
-            out = dot_product_attention(q, k, v, mask=mask, causal=causal,
-                                        window=window if kv_cache is None
-                                        else None)
+            k = repeat_kv(k, nh // nkv)
+            v = repeat_kv(v, nh // nkv)
+            if backend == "flash" and (
+                mask is None or getattr(mask, "ndim", 0) == 2
+            ):
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=True, mask=mask,
+                                      window=window)
+            else:
+                out = dot_product_attention(q, k, v, mask=mask, causal=True,
+                                            window=window)
     out = out.reshape(b, s, nh * hd)
     o, mo = _dense_maybe_fp8(out, layer["attn"]["o_proj"]["kernel"],
                              fa.get("o_proj"))

@@ -1,0 +1,398 @@
+"""Pallas paged-attention decode kernel: walk the page table IN the kernel.
+
+The serving engine's decode step used to gather every slot's pages into a
+dense [L, S, rows, H, D] view (`serving/cache.py paged_batch_view`)
+*before* the vmapped family forward — O(pool) HBM reads per token,
+rebuilt outside the attention op, growing with `pages_per_slot` however
+short the live sequences are. This kernel inverts that: the pool stays
+in place in HBM and the page table drives the kernel's BlockSpec index
+maps (scalar prefetch), so each grid step stages exactly ONE page of one
+slot's K/V into VMEM — pages are read once, where they live, and only a
+slot's *live* pages are visited (dead table entries re-map to an
+already-fetched block, so Mosaic's pipeline revisit elides the fetch).
+The pjit/TPUv4 rule (arxiv 2204.06514) still holds: the table and
+lengths are traced *data*, so one compiled program covers every page
+mapping, request mix, and eviction history.
+
+Layout and semantics:
+
+- pool K/V: [num_pages + 1, page_size, Hkv, D] per layer (the serving
+  pool minus its leading layer dim — the kernel is called inside the
+  family forward's `lax.scan` over layers). The last page is the
+  reserved trash page backing padded table entries.
+- page table: [slots, pages_per_slot] int32; lengths: [slots] int32.
+- q: one token per slot, GQA grouped as [slots, Hkv, group, D] — the
+  head-group broadcast happens in-kernel (each grid step dots the whole
+  q group against its kv head's page), so K/V are never `repeat_kv`'d.
+- the NEW token's K/V (this step's, position == length) are folded into
+  the online softmax as a final single-key update instead of being
+  written to the pool first: the kernel never writes, the engine
+  scatters the one new row per slot afterwards (`paged_append_rows`).
+- int8 pools (`PagedKV.scales` set) dequantize per page INSIDE the
+  kernel — codes * per-row-per-head scales — so the HBM stream is the
+  int8 bytes, not a pre-dequantized bf16 copy.
+
+Masking matches `models/decode.cached_attention_mask` exactly: a slot's
+query (position == length) attends pool rows < length plus its own new
+K/V; `window` applies the HF sliding-window band (key visible iff
+q - key < window). Retired slots (all-trash tables, stale lengths)
+compute garbage that the engine discards via its `live` lane mask —
+same contract as the dense gather path.
+
+On non-TPU backends the kernel runs in pallas interpret mode (slow, for
+tests) — tier-1 proves exactness against `paged_decode_reference` and
+token-exactness against the dense-gather engine path on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# `TPUCompilerParams` was renamed `CompilerParams` in newer jax; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+NEG_INF = -1e30
+_LANES = 128  # TPU vector lane width; scalar-per-group state is kept 2D
+
+__all__ = [
+    "PagedKV",
+    "PagedDecodeMeta",
+    "paged_decode_attention",
+    "paged_decode_reference",
+]
+
+
+# ---------------------------------------------------------------------------
+# the engine <-> family interface types
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedKV:
+    """One pool buffer (K or V) as it threads through a family forward.
+
+    `data` is the [L, pages+1, page_size, Hkv, D] pool (or a per-layer
+    slice of it — `lax.scan` over the leading dim slices both children
+    together); `scales` is the int8 mode's [L, pages+1, page_size, Hkv]
+    per-row-per-head scale array, None for a bf16 pool. `compute_dtype`
+    is the dtype attention math materializes K/V rows in (and the dtype
+    of the new-token rows handed back for the engine to write); None
+    defaults to `data.dtype` (bf16 pools) or bfloat16 (int8 pools).
+
+    The `is_paged_kv` marker lets `models/decode.decode_attention`
+    dispatch without importing this (pallas-importing) module on the
+    dense path."""
+
+    is_paged_kv = True
+
+    def __init__(self, data, scales=None, compute_dtype=None):
+        self.data = data
+        self.scales = scales
+        self.compute_dtype = compute_dtype
+
+    @property
+    def quantized(self) -> bool:
+        return self.scales is not None
+
+    @property
+    def row_dtype(self):
+        """The dtype K/V rows materialize in (see class docstring)."""
+        if self.compute_dtype is not None:
+            return self.compute_dtype
+        return jnp.bfloat16 if self.quantized else self.data.dtype
+
+    def tree_flatten(self):
+        return (self.data, self.scales), (self.compute_dtype,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scales = children
+        return cls(data, scales, compute_dtype=aux[0])
+
+
+@jax.tree_util.register_pytree_node_class
+class PagedDecodeMeta:
+    """The paged decode step's per-slot addressing, riding the family
+    cache tuple's third slot (where the dense path carries `cache_len`).
+
+    `table` [slots, pages_per_slot] int32 and `lengths` [slots] int32 are
+    traced data; `rows` (pages_per_slot * page_size, static) is what
+    `rope_table_len` sizes the rotary tables by. Families advance the
+    dense `cache_len` with `+ seq_len` when returning new caches —
+    `__add__` absorbs that as a no-op: per-slot length advance is the
+    engine's job (live-lane masked, in `paged_append_rows`), not the
+    traced program's."""
+
+    is_paged_meta = True
+
+    def __init__(self, table, lengths, rows: int):
+        self.table = table
+        self.lengths = lengths
+        self.rows = rows
+
+    def __add__(self, other):
+        return self
+
+    def tree_flatten(self):
+        return (self.table, self.lengths), (self.rows,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        table, lengths = children
+        return cls(table, lengths, rows=aux[0])
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(table_ref, lengths_ref, q_ref, kn_ref, vn_ref,
+                         pk_ref, pv_ref, *rest, sm_scale: float,
+                         page_size: int, pages_per_slot: int,
+                         window: int | None, quantized: bool):
+    """Grid [slots, Hkv, pages_per_slot] (pages innermost/arbitrary):
+    each step folds one page of one slot's kv head into the online
+    softmax; the last step also folds the new token's K/V and finalizes.
+    `table_ref`/`lengths_ref` are scalar-prefetch SMEM refs — the same
+    values the BlockSpec index maps used to choose the page blocks."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        (ks_ref, vs_ref), (o_ref, m_scr, l_scr, acc_scr) = (None, None), rest
+    s, j = pl.program_id(0), pl.program_id(2)
+    length = lengths_ref[s]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def update(s_blk, v_blk):
+        """One online-softmax step: fold pre-scaled, pre-masked scores
+        s_blk [G, n] and values v_blk [n, D] into the running state.
+        Probabilities stay f32 through the PV dot — decode is
+        bandwidth-bound, not MXU-bound, and the dense reference path
+        keeps f32 probabilities too."""
+        m_prev = m_scr[...][:, :1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_blk, axis=-1, keepdims=True))
+        p = jnp.exp(s_blk - m_new)
+        # a fully-masked block keeps m_new at NEG_INF where exp(s - m)
+        # would be exp(0) = 1 per masked key — zero those explicitly
+        p = jnp.where(s_blk <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    # a page is live iff it holds at least one row below the slot's
+    # length; dead pages (allocation slack, trash padding) compute
+    # nothing, and their index map re-targeted an already-fetched block
+    live = j * page_size < length
+
+    @pl.when(live)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)           # [G, D]
+        k = pk_ref[0, :, 0, :]                        # [ps, D]
+        v = pv_ref[0, :, 0, :]
+        if quantized:
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0].astype(
+                jnp.float32)[:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0].astype(
+                jnp.float32)[:, None]
+        s_blk = jnp.dot(q, k.T.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * sm_scale
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        keep = pos < length
+        if window is not None:
+            # HF sliding-window convention: key visible iff q - key <
+            # window; the query sits at position == length
+            keep = keep & (pos > length - window)
+        s_blk = jnp.where(keep, s_blk, NEG_INF)
+        update(s_blk, v)
+
+    @pl.when(j == pages_per_slot - 1)
+    def _tail():
+        # the new token's K/V (position == length, always visible — its
+        # window distance is 0) folds as one more single-key update;
+        # then finalize. l > 0 always: this key contributes exp(0) when
+        # it is the running max.
+        q = q_ref[0, 0].astype(jnp.float32)
+        kn = kn_ref[0, 0].astype(jnp.float32)          # [D]
+        s_new = jnp.dot(q, kn[:, None],
+                        preferred_element_type=jnp.float32) * sm_scale
+        update(s_new, vn_ref[0, 0][None, :])
+        l = l_scr[...][:, :1]
+        o_ref[0, 0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _paged_attention_call(q4, kn, vn, pool_k, pool_v, k_scales, v_scales,
+                          table, lengths, window: int | None,
+                          interpret: bool):
+    """q4 [S, Hkv, G, D], kn/vn [S, Hkv, D], pool [N+1, ps, Hkv, D]
+    (+ scales [N+1, ps, Hkv] when quantized) -> out [S, Hkv, G, D]."""
+    S, Hkv, G, D = q4.shape
+    P = table.shape[1]
+    ps = pool_k.shape[1]
+    quantized = k_scales is not None
+    sm_scale = 1.0 / math.sqrt(D)
+
+    def page_map(s, h, j, table_ref, lengths_ref):
+        # dead steps (page start >= length) re-target page 0 of the
+        # slot's table: consecutive dead steps then revisit one block
+        # instead of streaming allocation slack / trash padding
+        j_live = jnp.where(j * ps < jnp.maximum(lengths_ref[s], 1), j, 0)
+        return table_ref[s * P + j_live], 0, h, 0
+
+    def per_slot(s, h, j, table_ref, lengths_ref):
+        return (s, h, 0, 0)
+
+    def per_head_row(s, h, j, table_ref, lengths_ref):
+        return (s, h, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, G, D), per_slot),
+        pl.BlockSpec((1, 1, D), per_head_row),
+        pl.BlockSpec((1, 1, D), per_head_row),
+        pl.BlockSpec((1, ps, 1, D), page_map),
+        pl.BlockSpec((1, ps, 1, D), page_map),
+    ]
+    operands = [q4, kn, vn, pool_k, pool_v]
+    if quantized:
+        scale_map = (lambda s, h, j, table_ref, lengths_ref:
+                     page_map(s, h, j, table_ref, lengths_ref)[:3])
+        in_specs += [pl.BlockSpec((1, ps, 1), scale_map),
+                     pl.BlockSpec((1, ps, 1), scale_map)]
+        operands += [k_scales, v_scales]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, Hkv, P),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, G, D), per_slot),
+        scratch_shapes=[
+            pltpu.VMEM((G, _LANES), jnp.float32),
+            pltpu.VMEM((G, _LANES), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_decode_kernel, sm_scale=sm_scale, page_size=ps,
+        pages_per_slot=P, window=window, quantized=quantized)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, Hkv, G, D), q4.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(table.reshape(-1), lengths, *operands)
+
+
+# ---------------------------------------------------------------------------
+# the op the shared decode path calls
+# ---------------------------------------------------------------------------
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    pk: PagedKV,
+    pv: PagedKV,
+    meta: PagedDecodeMeta,
+    window: int | None = None,
+    interpret: bool | None = None,
+):
+    """One decode step of paged attention for every slot at once.
+
+    q: [S, 1, H, D] (S slots, one token each, H = Hkv * group);
+    k_new/v_new: [S, 1, Hkv, D] — this step's K/V, folded in-kernel and
+    returned (cast to the pool's row dtype) for the engine to append.
+    Returns (out [S, 1, H, D], (k_row, v_row) both [S, 1, Hkv, D])."""
+    S, sq, H, D = q.shape
+    if sq != 1:
+        raise ValueError(
+            f"paged decode attention is one token per slot; got S_q={sq} "
+            "(chunked prefill stays on the dense-gather path)")
+    Hkv = k_new.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads ({H}) not a multiple of kv heads ({Hkv})")
+    if meta.table.shape[0] != S:
+        raise ValueError(
+            f"page table covers {meta.table.shape[0]} slots, q has {S}")
+    if window is not None and (window <= 0 or window >= meta.rows):
+        window = None  # band wider than the cache reach: plain causal
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    G = H // Hkv
+    row_dtype = pk.row_dtype
+    # the fold must see exactly the bytes the engine will write, so a
+    # later step reading the row from the pool agrees with this step
+    k_row = k_new.astype(row_dtype)
+    v_row = v_new.astype(row_dtype)
+    q4 = q[:, 0].reshape(S, Hkv, G, D)
+    out = _paged_attention_call(
+        q4, k_row[:, 0], v_row[:, 0], pk.data, pv.data, pk.scales,
+        pv.scales, meta.table, meta.lengths, window, interpret)
+    return out.reshape(S, 1, H, D), (k_row, v_row)
+
+
+def paged_decode_reference(
+    q: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    pk: PagedKV,
+    pv: PagedKV,
+    meta: PagedDecodeMeta,
+    window: int | None = None,
+):
+    """Dense-gather reference with identical semantics (and the
+    executable spec of them): gather every table page, dequantize,
+    overlay the new token's row at position == length, mask rows the
+    query may not see, plain f32 softmax. The exactness tests pin the
+    kernel to this; the serving engine's dense path is the same math
+    threaded through the family forward."""
+    S, _, H, D = q.shape
+    Hkv = k_new.shape[2]
+    G = H // Hkv
+    ps = pk.data.shape[1]
+    R = meta.table.shape[1] * ps
+    row_dtype = pk.row_dtype
+
+    def dense(p: PagedKV):
+        pages = p.data[meta.table]                      # [S, P, ps, Hkv, D]
+        full = pages.astype(jnp.float32)
+        if p.quantized:
+            full = full * p.scales[meta.table].astype(jnp.float32)[..., None]
+        return full.reshape(S, R, Hkv, D)
+
+    k_all, v_all = dense(pk), dense(pv)
+    k_row = k_new.astype(row_dtype)
+    v_row = v_new.astype(row_dtype)
+    rows = jnp.arange(R, dtype=jnp.int32)
+    sel = (rows[None, :] == meta.lengths[:, None])[:, :, None, None]
+    k_all = jnp.where(sel, k_row.astype(jnp.float32), k_all)
+    v_all = jnp.where(sel, v_row.astype(jnp.float32), v_all)
+    keep = rows[None, :] <= meta.lengths[:, None]
+    if window is not None and window < R:
+        keep = keep & (rows[None, :] > meta.lengths[:, None] - window)
+    q4 = q[:, 0].reshape(S, Hkv, G, D).astype(jnp.float32)
+    s = jnp.einsum("shgd,srhd->shgr", q4, k_all) / math.sqrt(D)
+    s = jnp.where(keep[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("shgr,srhd->shgd", p, v_all)
+    return out.reshape(S, 1, H, D).astype(q.dtype), (k_row, v_row)

@@ -123,6 +123,33 @@ def dequantize(qt: QuantizedTensor, dtype=None) -> jax.Array:
     return wf.reshape(*qt.shape).astype(dtype or qt.dtype)
 
 
+def kv_quantize_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-row int8 quantization for KV-cache rows: x [..., D]
+    -> (codes int8 [..., D], scales bf16 [...]). This is `quantize`'s
+    block-scale rule with block_size == D — one scale per (row, head) —
+    jit-friendly and shape-preserving so the serving cache can scatter
+    codes and scales with the same indices it scatters bf16 rows with.
+    Per-ROW scales (not per-page) keep appends independent: writing a new
+    row into a partially-filled page never re-scales its neighbours, so
+    shared (copy-on-write) pages stay bit-stable however many sharers
+    race."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = (absmax / 127.0).astype(jnp.bfloat16)
+    safe = jnp.maximum(absmax, 1e-12) / 127.0
+    codes = jnp.clip(jnp.round(xf / safe[..., None]), -128, 127).astype(
+        jnp.int8)
+    return codes, scales
+
+
+def kv_dequantize_rows(codes: jax.Array, scales: jax.Array,
+                       dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of `kv_quantize_rows`: codes [..., D] * scales [...] ->
+    [..., D] in `dtype` (f32 multiply, like `dequantize`)."""
+    return (codes.astype(jnp.float32)
+            * scales.astype(jnp.float32)[..., None]).astype(dtype)
+
+
 def quantized_matmul(x: jax.Array, qt: QuantizedTensor) -> jax.Array:
     """x @ w with w quantized; dequant fuses into the dot under jit."""
     w = dequantize(qt, dtype=x.dtype)

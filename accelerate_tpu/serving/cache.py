@@ -183,6 +183,19 @@ class PagedKVCache:
     per-slot decode depth (which STARTS at the reused prefix length on a
     prefix hit). The arrays are pytree children so the cache threads
     through jit and donates; `page_size`/`pages_per_slot`/... are static.
+
+    QUANTIZED mode (`create(kv_dtype="int8")`): k/v hold int8 codes and
+    `k_scale`/`v_scale` ([L, pages+1, page_size, H] bf16, one symmetric
+    absmax scale per row per head — `ops/quant.py kv_quantize_rows`)
+    ride alongside as extra pytree children. Halving the bytes per page
+    doubles the pages — and therefore the concurrent users — a fixed
+    HBM budget holds. All writes quantize and all dense views
+    dequantize (to `compute_dtype`), so the gather/scatter programs and
+    the host-side page accounting are unchanged; the Pallas
+    paged-attention kernel dequantizes per page in-kernel instead of
+    materializing a dense copy. Per-ROW scales keep appends independent
+    (a new row never re-scales a page's existing rows), which is what
+    keeps shared copy-on-write pages bit-stable.
     """
 
     k: jax.Array
@@ -192,6 +205,9 @@ class PagedKVCache:
     pages_per_slot: int
     max_len: int
     pad_slack: int
+    k_scale: jax.Array | None = None
+    v_scale: jax.Array | None = None
+    compute_dtype: Any = jnp.bfloat16
 
     @classmethod
     def create(
@@ -205,9 +221,15 @@ class PagedKVCache:
         page_size: int = 16,
         pad_slack: int = 0,
         num_pages: int | None = None,
+        kv_dtype: Any = None,
     ) -> "PagedKVCache":
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if kv_dtype not in (None, "int8", jnp.int8):
+            raise ValueError(
+                f"kv_dtype must be None (store in `dtype`) or 'int8', "
+                f"got {kv_dtype!r}")
+        quantized = kv_dtype is not None
         # a slot's view must cover max_len rows plus the chunk-padding
         # spill (see SlotKVCache docstring) — round up to whole pages
         pages_per_slot = -(-(max_len + pad_slack) // page_size)
@@ -218,15 +240,25 @@ class PagedKVCache:
                 f"num_pages({num_pages}) < pages_per_slot({pages_per_slot}):"
                 " a max-size request could never be admitted")
         shape = (num_layers, num_pages + 1, page_size, num_kv_heads, head_dim)
+        scale_shape = shape[:-1]
         return cls(
-            k=jnp.zeros(shape, dtype),
-            v=jnp.zeros(shape, dtype),
+            k=jnp.zeros(shape, jnp.int8 if quantized else dtype),
+            v=jnp.zeros(shape, jnp.int8 if quantized else dtype),
             lengths=jnp.zeros((num_slots,), jnp.int32),
             page_size=page_size,
             pages_per_slot=pages_per_slot,
             max_len=max_len,
             pad_slack=pad_slack,
+            k_scale=jnp.zeros(scale_shape, jnp.bfloat16) if quantized
+            else None,
+            v_scale=jnp.zeros(scale_shape, jnp.bfloat16) if quantized
+            else None,
+            compute_dtype=dtype,
         )
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
     @property
     def num_layers(self) -> int:
@@ -251,97 +283,165 @@ class PagedKVCache:
         """Rows in one slot's gathered view (pages_per_slot * page_size)."""
         return self.pages_per_slot * self.page_size
 
+    @property
+    def page_nbytes(self) -> int:
+        """HBM bytes one page costs across K and V (codes + scales in
+        quantized mode) and all layers — the unit behind the
+        `serving_kv_bytes_in_use` gauge and the HBM math in
+        docs/serving.md: pages a budget holds = budget / page_nbytes."""
+        L, _, ps, H, D = self.k.shape
+        per = L * ps * H * D * self.k.dtype.itemsize
+        if self.quantized:
+            per += L * ps * H * self.k_scale.dtype.itemsize
+        return 2 * per
+
     def nbytes(self) -> int:
-        return self.k.nbytes + self.v.nbytes
+        total = self.k.nbytes + self.v.nbytes
+        if self.quantized:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return total
+
+
+def _dense_pages(codes: jax.Array, scales: jax.Array | None,
+                 idx: jax.Array, dtype) -> jax.Array:
+    """Gather pool pages at `idx` (any int32 index shape) and materialize
+    them densely: a plain gather for a bf16 pool, gather + per-row
+    dequantization for an int8 pool."""
+    pages = codes[:, idx]
+    if scales is None:
+        return pages
+    from ..ops.quant import kv_dequantize_rows
+
+    return kv_dequantize_rows(pages, scales[:, idx], dtype)
 
 
 def paged_slot_view(cache: PagedKVCache, table_row: jax.Array,
                     slot: jax.Array):
     """One slot's pages gathered into `models/decode.py` layout:
     (k [L, 1, R, H, D], v [L, 1, R, H, D], length scalar), R =
-    pages_per_slot * page_size. `table_row` ([pages_per_slot] int32) and
-    `slot` are traced — one compiled program covers every slot and every
-    page mapping."""
+    pages_per_slot * page_size, dequantized to `compute_dtype` on an
+    int8 pool. `table_row` ([pages_per_slot] int32) and `slot` are
+    traced — one compiled program covers every slot and every page
+    mapping."""
     L, _, ps, H, D = cache.k.shape
     P = cache.pages_per_slot
-    ks = cache.k[:, table_row].reshape(L, 1, P * ps, H, D)
-    vs = cache.v[:, table_row].reshape(L, 1, P * ps, H, D)
+    ks = _dense_pages(cache.k, cache.k_scale, table_row,
+                      cache.compute_dtype).reshape(L, 1, P * ps, H, D)
+    vs = _dense_pages(cache.v, cache.v_scale, table_row,
+                      cache.compute_dtype).reshape(L, 1, P * ps, H, D)
     return ks, vs, cache.lengths[slot]
 
 
 def paged_write_slot(cache: PagedKVCache, table_row: jax.Array,
                      slot: jax.Array, new_k: jax.Array, new_v: jax.Array,
                      advance: jax.Array, chunk: int) -> PagedKVCache:
-    """Scatter the pages a prefill chunk can touch back to the pool and
+    """Scatter the rows a prefill chunk wrote back to the pool and
     advance the slot's length by `advance` REAL tokens. The chunk only
-    writes view rows [length, length + chunk) — at most
-    ceil(chunk/page_size) + 1 consecutive pages — so scattering just that
-    window keeps per-chunk write traffic O(chunk), not O(max_len) (a
-    full-view scatter with traced page indices also defeats XLA's
-    donation aliasing: a pool copy per chunk). `chunk` must be a static
-    python int. When the window clamps at the view's tail, or starts
-    mid-page, the extra pages receive their unchanged gathered bytes —
-    shared pages are only ever re-written with their own values
-    (value-identical no-op); the rows that DO change always lie in
-    private pages by the allocator's invariant."""
+    changes view rows [length, length + chunk), so exactly those `chunk`
+    rows scatter (row -> its page via `table_row`) — per-chunk write
+    traffic is O(chunk), not O(max_len), and a full-view scatter with
+    traced page indices would also defeat XLA's donation aliasing (a
+    pool copy per chunk). `chunk` must be a static python int. Row
+    granularity (rather than the former whole-page window) is what makes
+    the int8 mode safe: every written row is at or past `length`, hence
+    in a PRIVATE page by the allocator's invariant — shared
+    copy-on-write pages are never re-encoded, so their codes/scales stay
+    bit-identical however many sharers race (an int8 round-trip is NOT
+    idempotent, so rewriting a shared page with "the same values" would
+    actually drift them)."""
     L, _, ps, H, D = cache.k.shape
-    P = cache.pages_per_slot
-    n = min(P, -(-chunk // ps) + 1)
+    R = cache.rows
     length = cache.lengths[slot]
-    first = jnp.minimum(length // ps, P - n).astype(jnp.int32)
-    pages = jax.lax.dynamic_slice(table_row, (first,), (n,))
-    win_k = jax.lax.dynamic_slice(
-        new_k.reshape(L, P, ps, H, D), (0, first, 0, 0, 0), (L, n, ps, H, D))
-    win_v = jax.lax.dynamic_slice(
-        new_v.reshape(L, P, ps, H, D), (0, first, 0, 0, 0), (L, n, ps, H, D))
+    # rows never spill past the view: length <= max_len and pad_slack
+    # covers the chunk padding (module docstring)
+    rows = length + jnp.arange(chunk, dtype=jnp.int32)
+    pages = jnp.take(table_row, rows // ps)
+    offs = rows % ps
+    win_k = jnp.take(new_k.reshape(L, R, H, D), rows, axis=1)
+    win_v = jnp.take(new_v.reshape(L, R, H, D), rows, axis=1)
+    return _scatter_rows(cache, pages, offs, win_k, win_v,
+                         cache.lengths.at[slot].set(length + advance))
+
+
+def _scatter_rows(cache: PagedKVCache, pages: jax.Array, offs: jax.Array,
+                  rows_k: jax.Array, rows_v: jax.Array,
+                  new_lengths: jax.Array) -> PagedKVCache:
+    """Scatter row payloads [L, n, H, D] at (page, offset) pairs,
+    quantizing codes + per-row scales on an int8 pool. The shared tail
+    of every pool write path (prefill chunks, decode appends, both
+    engine attention modes)."""
+    if not cache.quantized:
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[:, pages, offs].set(rows_k.astype(cache.k.dtype)),
+            v=cache.v.at[:, pages, offs].set(rows_v.astype(cache.v.dtype)),
+            lengths=new_lengths,
+        )
+    from ..ops.quant import kv_quantize_rows
+
+    ck, sk = kv_quantize_rows(rows_k)
+    cv, sv = kv_quantize_rows(rows_v)
     return dataclasses.replace(
         cache,
-        k=cache.k.at[:, pages].set(win_k),
-        v=cache.v.at[:, pages].set(win_v),
-        lengths=cache.lengths.at[slot].set(length + advance),
+        k=cache.k.at[:, pages, offs].set(ck),
+        v=cache.v.at[:, pages, offs].set(cv),
+        k_scale=cache.k_scale.at[:, pages, offs].set(sk),
+        v_scale=cache.v_scale.at[:, pages, offs].set(sv),
+        lengths=new_lengths,
     )
 
 
 def paged_batch_view(cache: PagedKVCache, table: jax.Array):
     """All slots' pages gathered into the dense decode layout:
-    (k [L, S, R, H, D], v [L, S, R, H, D]). `table` is the full
+    (k [L, S, R, H, D], v [L, S, R, H, D]), dequantized to
+    `compute_dtype` on an int8 pool. `table` is the full
     [S, pages_per_slot] int32 page table (traced)."""
     L, _, ps, H, D = cache.k.shape
     S = cache.num_slots
     P = cache.pages_per_slot
-    ks = cache.k[:, table].reshape(L, S, P * ps, H, D)
-    vs = cache.v[:, table].reshape(L, S, P * ps, H, D)
+    ks = _dense_pages(cache.k, cache.k_scale, table,
+                      cache.compute_dtype).reshape(L, S, P * ps, H, D)
+    vs = _dense_pages(cache.v, cache.v_scale, table,
+                      cache.compute_dtype).reshape(L, S, P * ps, H, D)
     return ks, vs
+
+
+def paged_append_rows(cache: PagedKVCache, table: jax.Array,
+                      row_k: jax.Array, row_v: jax.Array,
+                      live: jax.Array) -> PagedKVCache:
+    """Write each slot's SINGLE new row ([L, S, H, D] — the K/V of the
+    token decode just produced, at view row `length`) to its page and
+    advance live lanes' lengths by one. Scattering one row per slot
+    keeps per-token write traffic O(slots), not O(pool) (a full-view
+    scatter with dynamic page indices also defeats XLA's donation
+    aliasing, so it would copy the pool every step). A live slot's
+    current-length row always lies in a PRIVATE page (allocator
+    invariant), so no two live lanes collide; retired lanes' tables are
+    all-trash (the engine resets them at release), so their dead writes
+    land in the trash page — never in a page that may have been
+    reallocated. This is the write half of BOTH decode attention modes:
+    the dense gather path extracts the row from the returned views
+    (`paged_append_batch`), the Pallas kernel path hands the rows over
+    directly."""
+    _, _, ps, _, _ = cache.k.shape
+    row = cache.lengths                                  # [S] view row
+    page = jnp.take_along_axis(table, (row // ps)[:, None], axis=1)[:, 0]
+    off = row % ps
+    return _scatter_rows(cache, page, off, row_k, row_v,
+                         cache.lengths + live.astype(jnp.int32))
 
 
 def paged_append_batch(cache: PagedKVCache, table: jax.Array,
                        new_k: jax.Array, new_v: jax.Array,
                        live: jax.Array) -> PagedKVCache:
-    """Write each slot's SINGLE new row (the K/V of the token decode just
-    produced, at view row `length`) back to its page and advance live
-    lanes' lengths by one. The family forward returns the whole updated
-    [L, S, R, H, D] views, but decode only ever changes one row per slot
-    — scattering just that row keeps per-token write traffic O(slots),
-    not O(pool) (a full-view scatter with dynamic page indices also
-    defeats XLA's donation aliasing, so it would copy the pool every
-    step). A live slot's current-length row always lies in a PRIVATE page
-    (allocator invariant), so no two live lanes collide; retired lanes'
-    tables are all-trash (the engine resets them at release), so their
-    dead writes land in the trash page — never in a page that may have
-    been reallocated."""
-    _, _, ps, _, _ = cache.k.shape
-    row = cache.lengths                                  # [S] view row
-    page = jnp.take_along_axis(table, (row // ps)[:, None], axis=1)[:, 0]
-    off = row % ps
+    """`paged_append_rows` for the dense-gather decode path, where the
+    family forward returns whole updated [L, S, R, H, D] views: extract
+    the one changed row per slot (view row `length`), then scatter."""
+    row = cache.lengths
     idx = row[None, :, None, None, None]
     row_k = jnp.take_along_axis(new_k, idx, axis=2)[:, :, 0]   # [L, S, H, D]
     row_v = jnp.take_along_axis(new_v, idx, axis=2)[:, :, 0]
-    return dataclasses.replace(
-        cache,
-        k=cache.k.at[:, page, off].set(row_k),
-        v=cache.v.at[:, page, off].set(row_v),
-        lengths=cache.lengths + live.astype(jnp.int32),
-    )
+    return paged_append_rows(cache, table, row_k, row_v, live)
 
 
 def paged_admit_slot(cache: PagedKVCache, slot: jax.Array,
@@ -354,16 +454,18 @@ def paged_admit_slot(cache: PagedKVCache, slot: jax.Array,
 
 
 def _flatten_paged(cache: PagedKVCache):
-    return (cache.k, cache.v, cache.lengths), (
-        cache.page_size, cache.pages_per_slot, cache.max_len, cache.pad_slack)
+    return (cache.k, cache.v, cache.lengths, cache.k_scale, cache.v_scale), (
+        cache.page_size, cache.pages_per_slot, cache.max_len,
+        cache.pad_slack, cache.compute_dtype)
 
 
 def _unflatten_paged(aux, children):
-    k, v, lengths = children
-    page_size, pages_per_slot, max_len, pad_slack = aux
+    k, v, lengths, k_scale, v_scale = children
+    page_size, pages_per_slot, max_len, pad_slack, compute_dtype = aux
     return PagedKVCache(k=k, v=v, lengths=lengths, page_size=page_size,
                         pages_per_slot=pages_per_slot, max_len=max_len,
-                        pad_slack=pad_slack)
+                        pad_slack=pad_slack, k_scale=k_scale,
+                        v_scale=v_scale, compute_dtype=compute_dtype)
 
 
 jax.tree_util.register_pytree_node(PagedKVCache, _flatten_paged,

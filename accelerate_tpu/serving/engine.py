@@ -76,6 +76,7 @@ from .cache import (
     PagedKVCache,
     paged_admit_slot,
     paged_append_batch,
+    paged_append_rows,
     paged_batch_view,
     paged_slot_view,
     paged_write_slot,
@@ -173,6 +174,29 @@ class EngineConfig:
     page_size: int = 16
     num_pages: int | None = None
     prefix_cache: bool = True
+    # decode attention op. True: the Pallas paged-attention kernel
+    # (ops/paged_attention.py) walks the page table INSIDE attention —
+    # pages are read once, in place, only live pages per slot, GQA
+    # broadcast in-kernel; one batched forward replaces the per-slot
+    # vmap. False: the reference dense-gather path (paged_batch_view
+    # before the vmapped forward — O(pool) reads per token). "auto"
+    # picks the kernel on a single-device TPU and the dense path
+    # elsewhere (on CPU the kernel runs in interpret mode — exact, and
+    # what the tier-1 exactness tests drive explicitly, but far too slow
+    # to default to; on a meshed engine the kernel is opaque to GSPMD,
+    # which would gather the head-sharded pool around it — explicit True
+    # there is an error). Either way the compile count stays flat at
+    # admit/prefill/decode = 1/1/1.
+    paged_attention: Any = "auto"
+    # KV pool storage dtype. None stores pages in `cache_dtype`; "int8"
+    # stores int8 codes + per-row-per-head bf16 scales (serving/cache.py)
+    # — half the bytes per page, so a fixed HBM budget holds ~2x the
+    # pages (= concurrent users). Both attention paths dequantize (the
+    # kernel per page in VMEM, the dense path at gather); prefill/decode
+    # writes quantize; pod shipments carry codes + scales, halving wire
+    # bytes too. Accuracy is gated in tests by a logit-error bound and
+    # greedy-token agreement.
+    kv_dtype: Any = None
     # multi-tenant scheduling: an iterable/dict of scheduler.TenantSpec
     # (priority tiers, DRR weights, TTFT SLOs). None = the single
     # "default" tenant, i.e. plain FIFO — the pre-tenancy behavior.
@@ -230,6 +254,23 @@ def _cache_spec(config) -> tuple[int, int, int]:
     return config.num_hidden_layers, kv, config.head_dim
 
 
+def _resolve_paged_attention(setting, mesh) -> bool:
+    """EngineConfig.paged_attention -> use-the-kernel bool (see the
+    config field's comment for the policy)."""
+    if setting == "auto":
+        return mesh is None and jax.devices()[0].platform == "tpu"
+    use = bool(setting)
+    if use and mesh is not None:
+        raise ValueError(
+            "paged_attention=True is not supported on a meshed engine: a "
+            "pallas kernel is opaque to GSPMD, which would gather the "
+            "head-sharded pool around it instead of partitioning the "
+            "kernel. Meshed engines keep the dense-gather decode path "
+            "('auto' resolves to False there); single-device pod decode "
+            "workers (tensor_parallel=1) can use the kernel.")
+    return use
+
+
 def _as_raw_key(key) -> jax.Array:
     """uint32[2] key data from a typed key, raw key, or None."""
     if key is None:
@@ -283,6 +324,8 @@ class Engine:
         if ec.strict is not None and ec.strict not in ("warn", "error"):
             raise ValueError(
                 f"strict must be None, 'warn', or 'error'; got {ec.strict!r}")
+        self._use_paged_kernel = _resolve_paged_attention(
+            ec.paged_attention, ec.mesh)
         self._contracts = ec.contracts
         if ec.strict is not None and self._contracts is None:
             if ec.mesh is not None:
@@ -293,7 +336,8 @@ class Engine:
             else:
                 from ..analysis.contracts import serving_program_contracts
 
-                self._contracts = serving_program_contracts()
+                self._contracts = serving_program_contracts(
+                    paged_kernel=self._use_paged_kernel)
         # name -> None (audited clean/warned) | AnalysisViolation (cached:
         # re-raised on every later use without re-counting the findings)
         self._audited: dict = {}
@@ -303,6 +347,7 @@ class Engine:
             num_layers, ec.num_slots, ec.max_len, num_kv, head_dim,
             dtype=ec.cache_dtype, page_size=ec.page_size,
             pad_slack=ec.prefill_chunk, num_pages=ec.num_pages,
+            kv_dtype=ec.kv_dtype,
         )
         # SPMD serving: place the pool + per-slot state on the mesh and
         # remember the layout — _build_programs pins it as out_shardings
@@ -431,29 +476,58 @@ class Engine:
             tokens = tokens.at[slot].set(tok)
             return cache, tokens
 
-        @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
-        def decode(params, cache, tokens, slot_keys, temps, live, table):
-            # gather OUTSIDE the vmap: one [L, S, R, H, D] view of every
-            # slot's pages, exactly the dense layout the family forward
-            # already vmaps over; the per-page indices are traced data
-            k_all, v_all = paged_batch_view(cache, table)
+        if self._use_paged_kernel:
+            from ..ops.paged_attention import PagedDecodeMeta, PagedKV
 
-            def single(tok, length, k_slot, v_slot):
-                logits, (nk, nv, _) = forward(
-                    config, params, tok[None, None],
-                    positions=length[None, None],
-                    kv_caches=(k_slot[:, None], v_slot[:, None], length),
+            rows = self.cache.rows
+
+            @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
+            def decode(params, cache, tokens, slot_keys, temps, live, table):
+                # the Pallas kernel walks the page table INSIDE attention:
+                # no gather, no per-slot vmap — one batched forward whose
+                # cache-attend step (models/decode.decode_attention)
+                # streams each slot's live pages through VMEM in place and
+                # hands back only the per-slot new K/V rows to scatter
+                kvc = (PagedKV(cache.k, cache.k_scale, cache.compute_dtype),
+                       PagedKV(cache.v, cache.v_scale, cache.compute_dtype),
+                       PagedDecodeMeta(table, cache.lengths, rows=rows))
+                logits, (row_k, row_v, _) = forward(
+                    config, params, tokens[:, None],
+                    positions=cache.lengths[:, None], kv_caches=kvc,
                 )
-                return logits[0, 0].astype(jnp.float32), nk[:, 0], nv[:, 0]
+                last = logits[:, 0].astype(jnp.float32)
+                next_tok = jax.vmap(sample_slot)(
+                    last, slot_keys, cache.lengths + 1, temps)
+                tokens = jnp.where(live, next_tok, tokens)
+                cache = paged_append_rows(cache, table, row_k[:, :, 0],
+                                          row_v[:, :, 0], live)
+                return cache, tokens
+        else:
+            @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
+            def decode(params, cache, tokens, slot_keys, temps, live, table):
+                # the dense-gather reference path: one [L, S, R, H, D]
+                # view of every slot's pages gathered OUTSIDE the vmap,
+                # exactly the layout the family forward already vmaps
+                # over; the per-page indices are traced data
+                k_all, v_all = paged_batch_view(cache, table)
 
-            last, nk, nv = jax.vmap(
-                single, in_axes=(0, 0, 1, 1), out_axes=(0, 1, 1)
-            )(tokens, cache.lengths, k_all, v_all)
-            next_tok = jax.vmap(sample_slot)(
-                last, slot_keys, cache.lengths + 1, temps)
-            tokens = jnp.where(live, next_tok, tokens)
-            cache = paged_append_batch(cache, table, nk, nv, live)
-            return cache, tokens
+                def single(tok, length, k_slot, v_slot):
+                    logits, (nk, nv, _) = forward(
+                        config, params, tok[None, None],
+                        positions=length[None, None],
+                        kv_caches=(k_slot[:, None], v_slot[:, None], length),
+                    )
+                    return (logits[0, 0].astype(jnp.float32), nk[:, 0],
+                            nv[:, 0])
+
+                last, nk, nv = jax.vmap(
+                    single, in_axes=(0, 0, 1, 1), out_axes=(0, 1, 1)
+                )(tokens, cache.lengths, k_all, v_all)
+                next_tok = jax.vmap(sample_slot)(
+                    last, slot_keys, cache.lengths + 1, temps)
+                tokens = jnp.where(live, next_tok, tokens)
+                cache = paged_append_batch(cache, table, nk, nv, live)
+                return cache, tokens
 
         self._admit_p, self._prefill_p, self._decode_p = admit, prefill, decode
 
@@ -701,8 +775,9 @@ class Engine:
         lane's masked ride-along writes in later decode steps can never
         land in a page now owned by someone else."""
         self._table[index, :] = self.cache.trash_page
-        self.metrics.set_page_gauges(self.allocator.pages_in_use,
-                                     self.allocator.pages_free)
+        self.metrics.set_page_gauges(
+            self.allocator.pages_in_use, self.allocator.pages_free,
+            self.allocator.pages_in_use * self.cache.page_nbytes)
 
     def _run_admit(self, slot: Slot, req: Request) -> None:
         key_raw = _as_raw_key(req.key)
@@ -714,8 +789,9 @@ class Engine:
         row[:] = self.cache.trash_page
         row[:len(alloc.pages)] = alloc.pages
         self.metrics.note_admission(req.prompt_len, alloc.reused_len)
-        self.metrics.set_page_gauges(self.allocator.pages_in_use,
-                                     self.allocator.pages_free)
+        self.metrics.set_page_gauges(
+            self.allocator.pages_in_use, self.allocator.pages_free,
+            self.allocator.pages_in_use * self.cache.page_nbytes)
         if req.trace_sampled:
             # the queue-wait span is only known in retrospect: it closes
             # the moment admission happens
@@ -774,7 +850,8 @@ class Engine:
             self.cache, self._tokens = self._decode_p(*args)
         toks = np.asarray(self._tokens)  # the per-step host read
         self.timer.tick(block_on=None)
-        self.metrics.note_decode_step()
+        self.metrics.note_decode_step(
+            "kernel" if self._use_paged_kernel else "dense")
         for s in slots:
             req = s.request
             if self.scheduler.note_token(s, int(toks[s.index])):
@@ -921,8 +998,9 @@ class Engine:
                                name="serving_step")
         # page-pool gauges reflect CURRENT state, not a window: re-sync
         # (the prefix tree and its cached pages survive a metrics reset)
-        self.metrics.set_page_gauges(self.allocator.pages_in_use,
-                                     self.allocator.pages_free)
+        self.metrics.set_page_gauges(
+            self.allocator.pages_in_use, self.allocator.pages_free,
+            self.allocator.pages_in_use * self.cache.page_nbytes)
         # decode_steps restarts from 0, so the log guard must too — a stale
         # value would swallow the first post-reset log point
         self._last_logged = 0
@@ -935,6 +1013,9 @@ class Engine:
         """Flat serving metrics (TTFT/per-token percentiles, occupancy,
         queue depth, tokens/sec) + the StepTimer's host-overhead meters."""
         out = self.metrics.summary()
+        # pool capacity next to the in-use bytes gauge: pages a fixed HBM
+        # budget holds = budget / page_nbytes, which int8 pages double
+        out["pages_capacity"] = float(self.cache.num_pages)
         if self.timer._dispatch_hist.count:
             out["host_dispatch_us_mean"] = self.timer.host_dispatch_us
         out.update({f"compiles_{k}": float(v)

@@ -63,6 +63,11 @@ class ServingMetrics:
         self._g_tokens_per_sec = r.gauge("serving_tokens_per_sec")
         self._g_pages_in_use = r.gauge("serving_pages_in_use")
         self._g_pages_free = r.gauge("serving_pages_free")
+        # KV HBM actually held by live slots + cached prefixes (pages in
+        # use x per-page bytes incl. int8 scales) — the series that shows
+        # kv_dtype="int8" halving the footprint for the same page count
+        self._g_kv_bytes = r.gauge("serving_kv_bytes_in_use")
+        self._c_decode_path: dict = {}
         self.started_at: float | None = None
         self.stopped_at: float | None = None
 
@@ -126,8 +131,19 @@ class ServingMetrics:
     def page_evictions(self) -> int:
         return int(self._c_evictions.value)
 
-    def note_decode_step(self) -> None:
+    def note_decode_step(self, path: str = "dense") -> None:
+        """`path` is which decode attention op served the step —
+        "kernel" (Pallas paged attention) or "dense" (gather reference)
+        — so a config regression that silently drops the kernel shows
+        up as the labeled counter going flat. The labeled counter is
+        cached per path (this runs in the per-token host hot loop —
+        same once-resolved pattern as every sibling series)."""
         self._c_decode.inc()
+        ctr = self._c_decode_path.get(path)
+        if ctr is None:
+            ctr = self._c_decode_path[path] = self.registry.counter(
+                "serving_decode_path_total", path=path)
+        ctr.inc()
 
     def note_prefill_chunk(self) -> None:
         self._c_prefill.inc()
@@ -143,9 +159,12 @@ class ServingMetrics:
     def note_page_evictions(self, n: int) -> None:
         self._c_evictions.inc(n)
 
-    def set_page_gauges(self, in_use: int, free: int) -> None:
+    def set_page_gauges(self, in_use: int, free: int,
+                        bytes_in_use: int | None = None) -> None:
         self._g_pages_in_use.set(in_use)
         self._g_pages_free.set(free)
+        if bytes_in_use is not None:
+            self._g_kv_bytes.set(bytes_in_use)
 
     def observe_step(self, live_slots: int, num_slots: int,
                      queue_depth: int) -> None:
@@ -224,6 +243,7 @@ class ServingMetrics:
             "page_evictions": float(self.page_evictions),
             "pages_in_use": float(self._g_pages_in_use.value),
             "pages_free": float(self._g_pages_free.value),
+            "kv_bytes_in_use": float(self._g_kv_bytes.value),
         }
         if self.prefix_lookups:
             out["prefix_hit_rate"] = self.prefix_hits / self.prefix_lookups

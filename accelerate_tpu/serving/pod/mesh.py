@@ -87,9 +87,16 @@ def cache_state_shardings(cache, mesh: Mesh):
     [L, pages+1, page_size, H, D]) when H divides the model axis — each
     chip owns its heads' pages outright, page gathers/scatters stay
     chip-local, and pool HBM scales 1/N. When H doesn't divide (tiny-GQA
-    models on a wide mesh) the pool replicates: correct, latency still
-    scales with the sharded matmuls, memory doesn't — callers who care
-    should pick a mesh the head count divides.
+    models on a wide mesh) the pool falls back to sharding over the PAGE
+    dim (axis 1) when the page count (+1 trash page) divides the axis —
+    each chip owns a stripe of whole pages, so pool HBM still scales 1/N
+    and a big pool never replicates per chip; the per-step page gathers
+    then cross chips (GSPMD inserts the movement), trading bandwidth for
+    memory. Only when NEITHER dim divides does the pool replicate:
+    correct, latency still scales with the sharded matmuls, memory
+    doesn't — size `num_pages` so pages+1 divides the mesh if the head
+    count can't. int8 pools shard their scale arrays identically (same
+    leading dims).
 
     The specs deliberately omit trailing `None` entries
     (`P(None, None, None, "model")`, not `...,"model", None)`): GSPMD
@@ -100,9 +107,19 @@ def cache_state_shardings(cache, mesh: Mesh):
     n = mesh.shape[AXIS_MODEL]
     rep = NamedSharding(mesh, PartitionSpec())
     num_heads = cache.k.shape[3]
-    kv = (NamedSharding(mesh, PartitionSpec(None, None, None, AXIS_MODEL))
-          if num_heads % n == 0 else rep)
-    cache_sh = dataclasses.replace(cache, k=kv, v=kv, lengths=rep)
+    # one spec serves pool and scales in every branch: the sharded dim
+    # (heads = axis 3, pages = axis 1) sits at the same index in the 5-D
+    # pool and the 4-D scale array
+    if num_heads % n == 0:
+        kv = NamedSharding(mesh, PartitionSpec(None, None, None, AXIS_MODEL))
+    elif cache.k.shape[1] % n == 0:
+        kv = NamedSharding(mesh, PartitionSpec(None, AXIS_MODEL))
+    else:
+        kv = rep
+    cache_sh = dataclasses.replace(
+        cache, k=kv, v=kv, lengths=rep,
+        k_scale=kv if cache.quantized else None,
+        v_scale=kv if cache.quantized else None)
     return cache_sh, rep
 
 

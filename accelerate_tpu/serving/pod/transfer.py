@@ -74,12 +74,19 @@ class KVPageShipment:
     eos_token_id: int | None
     src_worker: int = -1
     extracted_at: float = 0.0    # router clock; the page_transfer span start
+    # int8 pools ship their codes as-is plus the per-row-per-head scale
+    # blocks ([L, pages_per_slot, page_size, H]) — the wire carries half
+    # the bytes of a bf16 shipment; None on bf16 pools
+    k_scales: np.ndarray | None = None
+    v_scales: np.ndarray | None = None
 
     @property
     def page_bytes(self) -> int:
         """Real payload bytes (prompt pages only), the number a transport
         would put on the wire."""
         per_page = self.k_pages[:, 0].nbytes + self.v_pages[:, 0].nbytes
+        if self.k_scales is not None:
+            per_page += self.k_scales[:, 0].nbytes + self.v_scales[:, 0].nbytes
         return self.n_prompt_pages * per_page
 
 
@@ -95,29 +102,59 @@ class PageTransport:
 
     def __init__(self, engine):
         self._engine = engine
+        self._quantized = engine.cache.quantized
         install_out = None
         if engine._mesh_shardings is not None:
             cache_sh, rep = engine._mesh_shardings
             install_out = (cache_sh, rep)
 
-        @jax.jit
-        def extract(cache, rows):
-            # rows: [pages_per_slot] int32 (traced data — any mapping,
-            # one program); gathers [L, P, ps, H, D] per buffer
-            return cache.k[:, rows], cache.v[:, rows]
+        if self._quantized:
+            # int8 pool: codes ship verbatim with their scale blocks —
+            # no dequant/requant round-trip (which would drift the codes;
+            # shipped pages must stay byte-identical to the prefill
+            # worker's, the same invariant COW sharing relies on)
+            @jax.jit
+            def extract(cache, rows):
+                return (cache.k[:, rows], cache.v[:, rows],
+                        cache.k_scale[:, rows], cache.v_scale[:, rows])
 
-        @partial(jax.jit, donate_argnums=(0, 1), out_shardings=install_out)
-        def install(cache, tokens, slot, rows, k_pages, v_pages, first_tok):
-            # trash-padded `rows` entries scatter their pages into the
-            # reserved trash page — dead writes, never a live page
-            return (
-                dataclasses.replace(
-                    cache,
-                    k=cache.k.at[:, rows].set(k_pages.astype(cache.k.dtype)),
-                    v=cache.v.at[:, rows].set(v_pages.astype(cache.v.dtype)),
-                ),
-                tokens.at[slot].set(first_tok),
-            )
+            @partial(jax.jit, donate_argnums=(0, 1),
+                     out_shardings=install_out)
+            def install(cache, tokens, slot, rows, k_pages, v_pages,
+                        first_tok, k_scales, v_scales):
+                return (
+                    dataclasses.replace(
+                        cache,
+                        k=cache.k.at[:, rows].set(k_pages),
+                        v=cache.v.at[:, rows].set(v_pages),
+                        k_scale=cache.k_scale.at[:, rows].set(k_scales),
+                        v_scale=cache.v_scale.at[:, rows].set(v_scales),
+                    ),
+                    tokens.at[slot].set(first_tok),
+                )
+        else:
+            @jax.jit
+            def extract(cache, rows):
+                # rows: [pages_per_slot] int32 (traced data — any mapping,
+                # one program); gathers [L, P, ps, H, D] per buffer
+                return cache.k[:, rows], cache.v[:, rows]
+
+            @partial(jax.jit, donate_argnums=(0, 1),
+                     out_shardings=install_out)
+            def install(cache, tokens, slot, rows, k_pages, v_pages,
+                        first_tok):
+                # trash-padded `rows` entries scatter their pages into the
+                # reserved trash page — dead writes, never a live page
+                return (
+                    dataclasses.replace(
+                        cache,
+                        k=cache.k.at[:, rows].set(
+                            k_pages.astype(cache.k.dtype)),
+                        v=cache.v.at[:, rows].set(
+                            v_pages.astype(cache.v.dtype)),
+                    ),
+                    tokens.at[slot].set(first_tok),
+                )
 
         self._extract_p = extract
         self._install_p = install
@@ -142,7 +179,13 @@ class PageTransport:
                       np.int32)
         row[:len(pages)] = pages
         eng._strict_audit("extract", self._extract_p, (eng.cache, row))
-        k_pages, v_pages = self._extract_p(eng.cache, row)
+        out = self._extract_p(eng.cache, row)
+        k_scales = v_scales = None
+        if self._quantized:
+            k_pages, v_pages, k_scales, v_scales = out
+            k_scales, v_scales = np.asarray(k_scales), np.asarray(v_scales)
+        else:
+            k_pages, v_pages = out
         n_prompt = -(-request.prompt_len // eng.cache.page_size)
         return KVPageShipment(
             prompt=request.prompt,
@@ -156,6 +199,8 @@ class PageTransport:
             eos_token_id=request.eos_token_id,
             src_worker=src_worker,
             extracted_at=extracted_at,
+            k_scales=k_scales,
+            v_scales=v_scales,
         )
 
     # -- decode side ---------------------------------------------------------
@@ -176,6 +221,8 @@ class PageTransport:
         args = (eng.cache, eng._tokens, jnp.int32(slot_index), row,
                 shipment.k_pages, shipment.v_pages,
                 jnp.int32(shipment.first_token))
+        if self._quantized:
+            args += (shipment.k_scales, shipment.v_scales)
         eng._strict_audit("install", self._install_p, args)
         eng.cache, eng._tokens = self._install_p(*args)
         admit_args = (eng.cache, eng._slot_keys, eng._temps,

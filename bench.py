@@ -256,7 +256,11 @@ def _load_serve_bench():
 
 def _serving_row() -> dict:
     """Offered-load smoke through the continuous-batching engine
-    (benchmarks/serve_bench.py): tokens/sec + TTFT/per-token percentiles."""
+    (benchmarks/serve_bench.py): tokens/sec + TTFT/per-token percentiles.
+    The row names which decode attention op and KV dtype produced the
+    numbers (paged_attention resolves per platform: Pallas kernel on a
+    single-device TPU, dense gather on CPU) so BENCH_r* lines stay
+    comparable across configs."""
     sb = _load_serve_bench()
     engine, cfg = sb.build_tiny_engine("llama", num_slots=4, max_len=128,
                                        prefill_chunk=16)
@@ -264,8 +268,14 @@ def _serving_row() -> dict:
                             rate_hz=200.0)
     keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
             "per_token_p50_ms", "per_token_p99_ms", "slot_occupancy_mean",
-            "requests_finished", "requests_rejected")
-    return {k: round(float(s[k]), 2) for k in keep if k in s}
+            "requests_finished", "requests_rejected", "kv_bytes_in_use",
+            "pages_capacity")
+    row = {k: round(float(s[k]), 2) for k in keep if k in s}
+    row["paged_attention"] = ("kernel" if engine._use_paged_kernel
+                              else "dense")
+    row["kv_dtype"] = ("int8" if engine.cache.quantized
+                       else str(engine.cache.k.dtype))
+    return row
 
 
 def _serving_prefix_row(num_requests: int = 12, prefix_pool: int = 4,

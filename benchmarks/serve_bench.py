@@ -62,12 +62,17 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       max_queue: int = 64, seed: int = 0,
                       metrics_port: int | None = None,
                       page_size: int = 16, prefix_cache: bool = True,
-                      tenants=None):
+                      tenants=None, kv_dtype=None,
+                      paged_attention="auto"):
     """A small engine on the named family (tiny config, fresh params).
     `metrics_port` turns on the engine's Prometheus endpoint (0 binds an
     ephemeral port, reported on `engine.metrics_server.port`);
     `prefix_cache=False` keeps the paged cache but disables cross-request
-    prefix reuse (the A/B baseline for the shared-prefix workload)."""
+    prefix reuse (the A/B baseline for the shared-prefix workload);
+    `kv_dtype="int8"` quantizes the KV pool and `paged_attention`
+    selects the decode attention op (True = Pallas kernel, False =
+    dense-gather reference, "auto" = kernel on single-device TPU) — the
+    A/B axes of the paged-attention bench."""
     import jax
     import jax.numpy as jnp
 
@@ -88,7 +93,8 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       prefill_chunk=prefill_chunk, max_queue=max_queue,
                       cache_dtype=jnp.bfloat16, seed=seed,
                       page_size=page_size, prefix_cache=prefix_cache,
-                      metrics_port=metrics_port, tenants=tenants)
+                      metrics_port=metrics_port, tenants=tenants,
+                      kv_dtype=kv_dtype, paged_attention=paged_attention)
     return Engine(family, cfg, params, ec), cfg
 
 
@@ -116,11 +122,14 @@ def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
                           max_len: int = 128, prefill_chunk: int = 16,
                           max_queue: int = 64, seed: int = 0,
                           page_size: int = 16, prefix_cache: bool = True,
-                          metrics_port: int | None = None, tenants=None):
+                          metrics_port: int | None = None, tenants=None,
+                          kv_dtype=None, paged_attention="auto"):
     """A disaggregated pod (serving.pod.PodEngine) on the named family:
     `pod_roles=(N, M)` prefill/decode workers, optionally `tensor_parallel`
     chips per worker. Same submit/step surface as the single engine, so
-    `run_offered_load` drives it unchanged."""
+    `run_offered_load` drives it unchanged. `kv_dtype="int8"` quantizes
+    every worker's pool AND the page shipments between them (half the
+    wire bytes)."""
     import jax
     import jax.numpy as jnp
 
@@ -142,7 +151,8 @@ def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
                       prefill_chunk=prefill_chunk, max_queue=max_queue,
                       cache_dtype=jnp.bfloat16, seed=seed,
                       page_size=page_size, prefix_cache=prefix_cache,
-                      metrics_port=metrics_port, tenants=tenants)
+                      metrics_port=metrics_port, tenants=tenants,
+                      kv_dtype=kv_dtype, paged_attention=paged_attention)
     pc = PodConfig(prefill_workers=pod_roles[0], decode_workers=pod_roles[1],
                    tensor_parallel=tensor_parallel)
     return PodEngine(family, cfg, params, ec, pc), cfg
@@ -580,6 +590,15 @@ def main() -> None:
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable cross-request prefix reuse (paged cache "
                         "kept) — the A/B baseline")
+    p.add_argument("--kv-dtype", default="bf16", choices=("bf16", "int8"),
+                   help="KV pool storage: int8 stores codes + per-row "
+                        "scales — half the bytes per page, 2x the pages "
+                        "a fixed HBM budget holds (summary reports "
+                        "kv_bytes_in_use and pages_capacity)")
+    p.add_argument("--no-paged-attention", action="store_true",
+                   help="force the dense-gather decode path (the Pallas "
+                        "paged-attention kernel's A/B baseline; default "
+                        "'auto' uses the kernel on single-device TPU)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics while the load runs "
                         "(0 = ephemeral port, printed to stderr)")
@@ -612,7 +631,9 @@ def main() -> None:
             args.family, num_slots=args.slots, max_len=args.max_len,
             prefill_chunk=args.prefill_chunk, seed=args.seed,
             page_size=args.page_size,
-            prefix_cache=not args.no_prefix_cache, tenants=specs)
+            prefix_cache=not args.no_prefix_cache, tenants=specs,
+            kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
+            paged_attention=False if args.no_paged_attention else "auto")
         summary = run_http_load(
             engine, cfg.vocab_size, specs, loads,
             num_requests=args.num_requests, mode=args.mode,
@@ -642,13 +663,17 @@ def main() -> None:
             max_len=max_len, prefill_chunk=args.prefill_chunk,
             seed=args.seed, page_size=args.page_size,
             prefix_cache=not args.no_prefix_cache,
-            metrics_port=args.metrics_port)
+            metrics_port=args.metrics_port,
+            kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
+            paged_attention=False if args.no_paged_attention else "auto")
     else:
         engine, cfg = build_tiny_engine(
             args.family, num_slots=args.slots, max_len=max_len,
             prefill_chunk=args.prefill_chunk, seed=args.seed,
             page_size=args.page_size, prefix_cache=not args.no_prefix_cache,
-            metrics_port=args.metrics_port)
+            metrics_port=args.metrics_port,
+            kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
+            paged_attention=False if args.no_paged_attention else "auto")
     if engine.metrics_server is not None:
         import sys
 

@@ -576,3 +576,42 @@ def test_bench_pod_row_shape():
     assert row["compiles_decode"] == 1
     assert row["compiles_install"] == 1
     assert row["tokens_per_sec"] > 0
+
+
+def test_bench_serving_row_names_kernel_and_kv_dtype():
+    """ISSUE 10: the extra.serving row carries which decode attention op
+    and KV dtype produced the numbers (plus the kv-bytes/capacity pair),
+    so BENCH_r* lines are comparable across configs."""
+    bench = _load_bench()
+    row = bench._serving_row()
+    assert row["paged_attention"] in ("kernel", "dense")
+    assert row["kv_dtype"] in ("int8", "bfloat16", "float32")
+    assert row["pages_capacity"] > 0
+    assert "kv_bytes_in_use" in row
+
+
+def test_serve_bench_kv_dtype_and_paged_attention_flags():
+    """The --kv-dtype/--no-paged-attention A/B axes reach the engine:
+    int8 halves kv_bytes_in_use per page (same page count on the same
+    seeded load) and the summary reports the capacity fields."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(ROOT, "benchmarks", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    out = {}
+    for kvd in (None, "int8"):
+        engine, cfg = sb.build_tiny_engine(
+            "gpt2", num_slots=2, max_len=32, prefill_chunk=8,
+            kv_dtype=kvd, paged_attention=False)
+        assert engine._use_paged_kernel is False
+        summary = sb.run_offered_load(
+            engine, cfg.vocab_size, num_requests=3, rate_hz=500.0,
+            prompt_len=(2, 6), max_new_tokens=(2, 3))
+        assert summary["requests_finished"] == 3
+        assert summary["pages_capacity"] == engine.cache.num_pages
+        out[kvd] = engine.cache.page_nbytes
+    # code bytes halve; the per-row scales add the documented 2/D
+    ratio = out["int8"] / out[None]
+    assert 0.5 < ratio <= 0.6, out

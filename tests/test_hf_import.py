@@ -392,12 +392,13 @@ def test_sliding_window_decode_matches_forward():
     prefix, caches = llama.forward(cfg, params, ids[:, :5], kv_caches=caches)
     np.testing.assert_allclose(np.asarray(prefix), np.asarray(full[:, :5]),
                                atol=2e-2)
+    # jitted once, positions traced (15 eager op-by-op forwards were a
+    # tier-1 top-30 cost)
+    step = jax.jit(lambda tok, pos, c: llama.forward(
+        cfg, params, tok, positions=pos, kv_caches=c))
     outs = []
     for t in range(5, 20):  # decode well past window=6
-        lg, caches = llama.forward(
-            cfg, params, ids[:, t : t + 1],
-            positions=jnp.full((2, 1), t), kv_caches=caches,
-        )
+        lg, caches = step(ids[:, t : t + 1], jnp.full((2, 1), t), caches)
         outs.append(lg)
     decoded = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(decoded), np.asarray(full[:, 5:]),

@@ -44,13 +44,15 @@ def test_llama_decode_matches_forward():
     caches = llama.init_kv_caches(cfg, 2, 16, dtype=jnp.float32)
     prefix, caches = llama.forward(cfg, params, ids[:, :5], kv_caches=caches)
     np.testing.assert_allclose(np.asarray(prefix), np.asarray(full[:, :5]), atol=2e-2)
-    # decode one token at a time
+    # decode one token at a time — jitted once, positions traced (5 eager
+    # op-by-op forwards re-dispatched the whole layer scan per step and
+    # were a tier-1 top-30 cost)
+    step = jax.jit(lambda tok, pos, c: llama.forward(
+        cfg, params, tok, positions=pos, kv_caches=c))
     outs = []
     for t in range(5, 10):
-        step_logits, caches = llama.forward(
-            cfg, params, ids[:, t : t + 1],
-            positions=jnp.full((2, 1), t), kv_caches=caches,
-        )
+        step_logits, caches = step(ids[:, t : t + 1],
+                                   jnp.full((2, 1), t), caches)
         outs.append(step_logits)
     decoded = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(decoded), np.asarray(full[:, 5:]), atol=2e-2)
@@ -352,10 +354,14 @@ def test_t5_decode_matches_forward():
          rng.integers(0, cfg.vocab_size, (2, 6)).astype(np.int32)], axis=1)
     full = t5.forward(cfg, params, enc_ids, dec_ids)
     state = t5.init_decode_state(cfg, params, enc_ids, max_new_tokens=7)
+    # jitted once, positions traced (7 eager op-by-op decoder passes were
+    # a tier-1 top-30 cost)
+    step = jax.jit(lambda tok, pos, st: t5.decode_step(
+        cfg, params, tok, pos, st))
     outs = []
     for t in range(7):
-        logits, state = t5.decode_step(
-            cfg, params, dec_ids[:, t : t + 1], jnp.full((2, 1), t), state)
+        logits, state = step(dec_ids[:, t : t + 1], jnp.full((2, 1), t),
+                             state)
         outs.append(logits)
     decoded = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(decoded), np.asarray(full),
@@ -425,12 +431,14 @@ def test_zoo_decode_past_max_position_embeddings(name):
         [ids, ids[:, :8]], axis=1))
     caches = mod.init_kv_caches(cfg, 1, 20, dtype=jnp.float32)
     _, caches = mod.forward(cfg, params, ids, kv_caches=caches)
+    # jitted once, positions traced (8 eager steps per family re-ran the
+    # whole layer scan op-by-op — a tier-1 top-30 cost x3 families)
+    step = jax.jit(lambda tok, pos, c: mod.forward(
+        cfg, params, tok, positions=pos, kv_caches=c))
     outs = []
     seq = jnp.concatenate([ids, ids[:, :8]], axis=1)
     for t in range(12, 20):
-        lg, caches = mod.forward(cfg, params, seq[:, t : t + 1],
-                                 positions=jnp.full((1, 1), t),
-                                 kv_caches=caches)
+        lg, caches = step(seq[:, t : t + 1], jnp.full((1, 1), t), caches)
         outs.append(lg)
     decoded = jnp.concatenate(outs, axis=1)
     np.testing.assert_allclose(np.asarray(decoded),

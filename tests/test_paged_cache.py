@@ -390,3 +390,125 @@ def test_prometheus_exposition_carries_page_and_prefix_series(gpt2_setup):
         assert "serving_prefix_hits_total 1.0" in body
     finally:
         eng.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: int8 KV pool mode
+# ---------------------------------------------------------------------------
+
+
+def test_int8_pool_shapes_pytree_and_page_nbytes():
+    """Quantized create: int8 codes + bf16 per-row-per-head scales as
+    extra pytree children; page_nbytes is the HBM-math unit ((D+2)/2D of
+    a bf16 page — half the code bytes plus the 2/D scale overhead)."""
+    kw = dict(num_layers=2, num_slots=2, max_len=32, num_kv_heads=2,
+              head_dim=16, page_size=8, pad_slack=8)
+    bf = PagedKVCache.create(**kw)
+    q = PagedKVCache.create(**kw, kv_dtype="int8")
+    assert q.quantized and not bf.quantized
+    assert q.k.dtype == jnp.int8
+    assert q.k_scale.shape == q.k.shape[:-1]
+    assert q.k_scale.dtype == jnp.bfloat16
+    D = kw["head_dim"]
+    assert q.page_nbytes / bf.page_nbytes == (D + 2) / (2 * D)
+    assert q.nbytes() == q.k.nbytes * 2 + q.k_scale.nbytes * 2
+    leaves, treedef = jax.tree_util.tree_flatten(q)
+    assert len(leaves) == 5  # k, v, lengths, k_scale, v_scale
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert rebuilt.quantized and rebuilt.compute_dtype == jnp.bfloat16
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVCache.create(**kw, kv_dtype="int4")
+
+
+def test_int8_write_then_view_roundtrips_and_leaves_other_rows_bitstable():
+    """Row-granular quantized writes: a later chunk's write never
+    re-encodes earlier rows (an int8 round-trip is not idempotent, so
+    whole-page rewrites would drift shared bytes — the COW hazard the
+    per-row design removes)."""
+    from accelerate_tpu.serving.cache import paged_slot_view, paged_write_slot
+
+    rng = np.random.default_rng(0)
+    cache = PagedKVCache.create(num_layers=1, num_slots=1, max_len=16,
+                                num_kv_heads=2, head_dim=8, page_size=8,
+                                pad_slack=8, kv_dtype="int8",
+                                dtype=jnp.float32)
+    table_row = jnp.arange(cache.pages_per_slot, dtype=jnp.int32)
+    R = cache.rows
+    chunk = 8
+
+    def payload(seed):
+        return jnp.asarray(rng.normal(size=(1, 1, R, 2, 8)), jnp.float32)
+
+    first = payload(1)
+    cache = paged_write_slot(cache, table_row, jnp.int32(0), first, first,
+                             jnp.int32(5), chunk)  # rows 0..7, 5 real
+    codes_after_first = np.asarray(cache.k).copy()
+    scales_after_first = np.asarray(cache.k_scale).copy()
+    cache = paged_write_slot(cache, table_row, jnp.int32(0), payload(2),
+                             payload(2), jnp.int32(8), chunk)  # rows 5..12
+    # rows 0..4 (written only by the first chunk) are bit-identical
+    np.testing.assert_array_equal(np.asarray(cache.k)[:, 0, :5],
+                                  codes_after_first[:, 0, :5])
+    np.testing.assert_array_equal(np.asarray(cache.k_scale)[:, 0, :5],
+                                  scales_after_first[:, 0, :5])
+    # and the dense view dequantizes to within the int8 error of the
+    # payload on the real rows
+    ks, _, length = paged_slot_view(cache, table_row, jnp.int32(0))
+    assert int(length) == 13
+    got = np.asarray(ks[0, 0, :5], np.float32)
+    want = np.asarray(first[0, 0, :5], np.float32)
+    absmax = np.abs(want).max(-1, keepdims=True)
+    assert np.all(np.abs(got - want) <= absmax * (1 / 254 + 2 ** -8) + 1e-6)
+
+
+def test_int8_append_rows_quantizes_one_row_per_live_slot():
+    from accelerate_tpu.serving.cache import paged_append_rows
+
+    cache = PagedKVCache.create(num_layers=1, num_slots=2, max_len=16,
+                                num_kv_heads=2, head_dim=8, page_size=8,
+                                pad_slack=0, kv_dtype="int8",
+                                dtype=jnp.float32)
+    import dataclasses
+
+    cache = dataclasses.replace(cache,
+                                lengths=jnp.asarray([3, 0], jnp.int32))
+    table = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    rng = np.random.default_rng(1)
+    row_k = jnp.asarray(rng.normal(size=(1, 2, 2, 8)), jnp.float32)
+    row_v = jnp.asarray(rng.normal(size=(1, 2, 2, 8)), jnp.float32)
+    out = paged_append_rows(cache, table, row_k, row_v,
+                            jnp.asarray([True, False]))
+    assert out.lengths.tolist() == [4, 0]  # only the live lane advances
+    # slot 0 row landed at page 0 offset 3, quantized
+    from accelerate_tpu.ops.quant import kv_dequantize_rows
+
+    got = kv_dequantize_rows(out.k[0, 0, 3], out.k_scale[0, 0, 3],
+                             jnp.float32)
+    want = np.asarray(row_k[0, 0], np.float32)
+    absmax = np.abs(want).max(-1, keepdims=True)
+    assert np.all(np.abs(np.asarray(got) - want)
+                  <= absmax * (1 / 254 + 2 ** -8) + 1e-6)
+
+
+def test_int8_prefix_reuse_vs_no_reuse_same_trace(gpt2_setup):
+    """COW sharing under quantization: the reuse-vs-cold A/B stays
+    token-identical with int8 pages (shared pages' codes are never
+    re-encoded — bit-stable however many sharers race) and still saves
+    prefill chunks."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(6)
+    shared = rng.integers(0, cfg.vocab_size, (16,)).astype(np.int32)
+    trace = [np.concatenate(
+        [shared, rng.integers(0, cfg.vocab_size,
+                              (int(rng.integers(2, 6)),)).astype(np.int32)])
+        for _ in range(6)]
+    results = {}
+    for reuse in (True, False):
+        eng = _engine(cfg, params, prefix_cache=reuse, kv_dtype="int8")
+        reqs = [eng.submit(p, max_new_tokens=4) for p in trace]
+        eng.run_until_idle()
+        assert all(r.status is RequestStatus.FINISHED for r in reqs)
+        results[reuse] = ([r.tokens for r in reqs],
+                          eng.metrics.prefill_chunks)
+    assert results[True][0] == results[False][0]
+    assert results[True][1] < results[False][1]

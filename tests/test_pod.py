@@ -574,3 +574,92 @@ def test_pod_exactness_under_forced_devices(forced_device_run, n_devices):
     out = forced_device_run(script, n_devices, args=(n_devices,),
                             timeout=420)
     assert "POD_EXACTNESS_OK" in out
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: int8 KV shipments + page-dim pool sharding
+# ---------------------------------------------------------------------------
+
+
+def test_pod_int8_shipments_byte_identical_to_single_engine(gpt2_setup):
+    """kv_dtype="int8" through the pod: every worker's pool quantizes
+    and shipments carry codes + scales verbatim (no dequant/requant
+    round-trip that would drift the codes) — pod output matches the
+    single int8 engine byte for byte, with the kernel-backed decode
+    worker variant too."""
+    cfg, params = gpt2_setup
+    ref = [r.tokens for r in _run_trace(
+        Engine(gpt2, cfg, params, _ec(kv_dtype="int8")), cfg)]
+    for pa in (False, True):
+        pod = PodEngine(gpt2, cfg, params,
+                        _ec(kv_dtype="int8", paged_attention=pa),
+                        PodConfig(prefill_workers=1, decode_workers=1))
+        reqs = _run_trace(pod, cfg)
+        assert [r.tokens for r in reqs] == ref, f"paged_attention={pa}"
+        assert pod.metrics_summary()["pod_shipments"] == 4.0
+
+
+def test_shipment_page_bytes_halve_under_int8():
+    """The wire-bytes claim: an int8 shipment's page_bytes are the code
+    bytes (half of bf16) plus the scale blocks — (D+2)/2D of the bf16
+    payload for the same page geometry."""
+    L, P, ps, H, D = 1, 5, 8, 2, 4
+    common = dict(prompt=np.arange(20, dtype=np.int32), first_token=1,
+                  n_prompt_pages=2, key_raw=np.zeros((2,), np.uint32),
+                  temperature=0.0, max_new_tokens=4, eos_token_id=None)
+    bf16 = KVPageShipment(
+        k_pages=np.zeros((L, P, ps, H, D), np.dtype("bfloat16")
+                         if hasattr(np, "bfloat16") else np.float16),
+        v_pages=np.zeros((L, P, ps, H, D), np.float16), **common)
+    i8 = KVPageShipment(
+        k_pages=np.zeros((L, P, ps, H, D), np.int8),
+        v_pages=np.zeros((L, P, ps, H, D), np.int8),
+        k_scales=np.zeros((L, P, ps, H), np.float16),
+        v_scales=np.zeros((L, P, ps, H), np.float16), **common)
+    assert i8.page_bytes / bf16.page_bytes == (D + 2) / (2 * D)
+
+
+def test_pool_page_dim_sharding_when_heads_dont_divide():
+    """ISSUE 10 satellite (pod GQA follow-up from PR 9): llama-tiny's 2
+    KV heads don't divide a 4-wide mesh — the pool used to fully
+    replicate per chip. With a page count the mesh divides (pages+1 %
+    n == 0) it now shards over the PAGE dim instead, stays token-exact,
+    and holds the compile count; when neither dim divides it still
+    falls back to replication (the old behavior, pinned by
+    test_sharded_engine_nondividing_heads_stays_compile_flat)."""
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.key(0))
+    ref_eng = Engine(llama, cfg, params, _ec(num_slots=2, num_pages=11))
+    ref = [r.tokens for r in _run_trace(ref_eng, cfg, budgets=(5, 5, 3, 3))]
+    # pages+1 = 12 divides the 4-wide mesh -> page-dim sharded pool
+    eng = sharded_engine(llama, cfg, params,
+                         _ec(num_slots=2, num_pages=11),
+                         mesh=tensor_mesh(4))
+    assert tuple(eng.cache.k.sharding.spec) == (None, "model")
+    got = [r.tokens for r in _run_trace(eng, cfg, budgets=(5, 5, 3, 3))]
+    assert got == ref
+    assert eng.compile_stats() == {"admit": 1, "prefill": 1, "decode": 1}
+    # replicate fallback: neither heads (2) nor pages+1 (11) divide 4
+    fallback = cache_state_shardings(
+        Engine(llama, cfg, params, _ec(num_slots=2, num_pages=10)).cache,
+        tensor_mesh(4))[0]
+    assert fallback.k.is_fully_replicated
+
+
+def test_contract_factories_name_paged_kernel_variant():
+    """ISSUE 10: both contract factories gain the kernel-backed decode
+    variant — same clauses (a pallas custom call is chip-local, not a
+    collective), distinct name so audit reports say which decode flavor
+    they checked. A kernel-backed engine under strict mode resolves to
+    the variant automatically (pinned by
+    test_paged_kernel_gqa_and_slot_reuse_token_exact's strict=error)."""
+    plain = serving_program_contracts()
+    kern = serving_program_contracts(paged_kernel=True)
+    assert kern["decode"].name == "serving.decode.paged-kernel"
+    assert plain["decode"].name == "serving.decode"
+    assert kern["decode"].forbid == plain["decode"].forbid
+    assert kern["decode"].exhaustive
+    pod_kern = pod_program_contracts(num_layers=2, paged_kernel=True)
+    assert pod_kern["decode"].name == "serving.pod.decode.paged-kernel"
+    assert pod_kern["decode"].require == pod_program_contracts(
+        num_layers=2)["decode"].require
