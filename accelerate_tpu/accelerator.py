@@ -45,6 +45,7 @@ from .sharding import (
     transformer_rules,
 )
 from .state import AcceleratorState, GradientState, PartialState
+from .telemetry.cost import CostTable, fence as _cost_fence, resolve_sample_every
 from .telemetry.export import start_metrics_server
 from .telemetry.registry import get_registry
 from .telemetry.trace import span
@@ -134,7 +135,9 @@ class _CompiledTrainStep:
     def __init__(self, step_fn: Callable, donate: bool,
                  strict: str | None = None, contract=None,
                  replication_threshold: int = 1 << 26,
-                 on_finding: Callable | None = None):
+                 on_finding: Callable | None = None,
+                 cost_table: CostTable | None = None,
+                 cost_name: str = "train_step"):
         self._step_fn = step_fn
         self._donate = donate
         self._by_layout: dict = {}   # (treedef, leaf shardings) -> jitted
@@ -155,6 +158,14 @@ class _CompiledTrainStep:
         # re-raised on every later dispatch attempt WITHOUT re-running the
         # audit, so telemetry counts each finding once)
         self._audited: dict = {}
+        # device-cost attribution (ISSUE 11): the static FLOPs/bytes of
+        # each compiled variant land in `cost_table` once per akey (the
+        # same key the AOT/audit caches use), and every Kth dispatch is
+        # fence-timed into program_device_time_seconds{program=train_step}
+        # — MFU from MEASURED device time, not free-running wall windows
+        self._cost = cost_table
+        self._cost_name = cost_name
+        self._cost_keys: set = set()
 
     def _layout_key(self, state):
         leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -215,7 +226,19 @@ class _CompiledTrainStep:
         compiled = self._aot.get(akey)
         if compiled is None:
             self._aot_compiles += 1
-            compiled = self._aot[akey] = jitted.lower(state, *batch).compile()
+            lowered = jitted.lower(state, *batch)
+            if self._cost is not None and akey not in self._cost_keys:
+                # static cost capture rides the lowering the compile
+                # needs anyway — zero extra work, once per (layout,
+                # batch sig); a re-warm for a new shape refreshes the
+                # entry. The LOWERED (pre-partition) stage reports
+                # GLOBAL FLOPs, matching the cost table's
+                # peak-x-num_chips denominator (the Compiled stage is
+                # the post-SPMD per-device program — registering it
+                # would silently flip the entry's meaning per path)
+                self._cost_keys.add(akey)
+                self._cost.register(self._cost_name, lowered, replace=True)
+            compiled = self._aot[akey] = lowered.compile()
             # drop the identity fast path: it would keep dispatching to the
             # callable captured before this warmup and never consult the
             # fresh executable (e.g. warming up for an upcoming batch-shape
@@ -241,6 +264,24 @@ class _CompiledTrainStep:
         return compiled
 
     def __call__(self, state, *batch):
+        # sampled device-time measurement: every Kth call pays a fence
+        # pair so the TRUE device step duration (not the async dispatch)
+        # lands in the cost table's histogram. Host-side only — the
+        # compiled program and the dispatch caches are untouched.
+        sampling = (self._cost is not None
+                    and self._cost.sample_due(self._cost_name))
+        if sampling:
+            if not self._cost.has(self._cost_name):
+                # plain-jit path that never warmed: capture the static
+                # cost from a lowering once (tracing cost only)
+                try:
+                    self._cost.register(self._cost_name,
+                                        self.lower(state, *batch))
+                except Exception:
+                    pass
+            _cost_fence(state)
+            compiles_before = self._aot_compiles + self._cache_size()
+            t0 = self._cost.clock()
         with span("accelerate_tpu.train_step.dispatch"):
             last = self._last
             if last is not None and last[0]() is state:
@@ -297,6 +338,15 @@ class _CompiledTrainStep:
             except TypeError:  # plain-container states (dicts) aren't weakref-able
                 ref = None
             self._last = None if ref is None else (ref, fn, jitted)
+        if sampling:
+            _cost_fence(out)
+            # a sampled call that COMPILED (first sight of a new layout /
+            # batch signature, on either the AOT or plain-jit path) must
+            # not record: a 30s compile logged as one 'device time'
+            # sample would poison the mean/p99 and the derived MFU gauge
+            if self._aot_compiles + self._cache_size() == compiles_before:
+                self._cost.record_device_time(self._cost_name,
+                                              self._cost.clock() - t0)
         if self._on_dispatch is not None:
             self._on_dispatch()
         return out
@@ -337,6 +387,7 @@ class Accelerator:
         kwargs_handlers: list | None = None,
         metrics_port: int | None = None,
         stall_timeout_s: float | None = None,
+        cost_sample_every: int | None = None,
         strict: str | None = None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(
@@ -518,6 +569,17 @@ class Accelerator:
         self._c_train_steps = self.telemetry.counter(
             "accelerator_train_steps_total")
         self._c_logs = self.telemetry.counter("accelerator_log_calls_total")
+        # device-cost attribution (ISSUE 11): static FLOPs/bytes per
+        # compiled train step + sampled fence-pair device timing, shared
+        # by every train_step() this accelerator builds. Cadence:
+        # `cost_sample_every` kwarg, else ACCELERATE_TPU_COST_SAMPLE_EVERY,
+        # default every 16th step (one device sync per 16 steps); 0
+        # disables sampling.
+        self.cost_table = CostTable(
+            registry=self.telemetry,
+            sample_every=resolve_sample_every(cost_sample_every),
+            num_chips=jax.device_count)
+        self._cost_names_built = 0
 
         # --- strict mode (ISSUE 4): transfer guard + trace-time program audit
         # strict="warn" logs implicit device->host transfers and warns on
@@ -1145,10 +1207,18 @@ class Accelerator:
                 metrics["aux"] = aux
             return new_state, metrics
 
+        # each built step gets its own cost-table name: two steps (a
+        # train and an eval fn) sharing "train_step" would overwrite
+        # each other's FLOPs entry and merge their device-time samples
+        # into one histogram — a silently wrong MFU
+        self._cost_names_built += 1
+        n = self._cost_names_built
         step = _CompiledTrainStep(
             step_fn, donate=donate, strict=self.strict, contract=contract,
             replication_threshold=replication_threshold,
             on_finding=self._note_analysis_finding,
+            cost_table=self.cost_table,
+            cost_name="train_step" if n == 1 else f"train_step_{n}",
         )
         step._on_dispatch = self._note_train_dispatch
         return step

@@ -21,6 +21,8 @@ SUBCOMMAND_MODULES = [
     "accelerate_tpu.commands.lint",
     "accelerate_tpu.commands.serve",
     "accelerate_tpu.commands.incident",
+    "accelerate_tpu.commands.profile",
+    "accelerate_tpu.commands.bench_diff",
 ]
 
 

@@ -158,6 +158,27 @@ def _run_show(base: str, ref: str, fmt: str) -> int:
         for e in tail[-8:]:
             print(f"  {e.get('name')} dur={e.get('dur_ns', 0) / 1e6:.3f}ms"
                   f" trace={e.get('trace_id')}")
+    cost = files.get("cost_table.json") or {}
+    programs = cost.get("programs") or {}
+    if programs:
+        # what the device was DOING with its time, frozen at the
+        # incident: per-program FLOPs/bytes + measured device time
+        print("device cost (per call):")
+        rooflines = cost.get("rooflines") or {}
+        for name in sorted(programs):
+            p = programs[name]
+            sheet = rooflines.get(name) or {}
+            line = (f"  {name}: {p.get('flops', 0) / 1e6:.2f} MFLOP, "
+                    f"{p.get('bytes_accessed', 0) / 1e6:.2f} MB "
+                    f"[{p.get('source', '?')}], calls={p.get('calls', 0)}")
+            mean = sheet.get("device_time_mean_s")
+            if isinstance(mean, (int, float)):
+                line += f", device {mean * 1e3:.3f} ms"
+            mfu = sheet.get("mfu")
+            if isinstance(mfu, (int, float)):
+                line += (f", mfu {mfu:.4f} "
+                         f"(idle {sheet.get('mxu_idle_fraction', 0):.3f})")
+            print(line)
     metrics = files.get("metrics.json") or {}
     counters = metrics.get("counters") or {}
     if counters:

@@ -1,0 +1,156 @@
+"""`accelerate-tpu profile` — capture an XLA/XProf trace on demand.
+
+Two modes, both reusing `profiler.profile()` (ISSUE 11):
+
+- **remote** (`--url`): ask a RUNNING front door for a capture via its
+  gated `/debug/profile` endpoint — the trace records live traffic on
+  the serving box, no restart, no code change::
+
+      accelerate-tpu profile --url http://127.0.0.1:8000 \
+          --duration-s 2 --logdir /tmp/trace
+
+  (the server must run with `--debug-endpoints`; a 404 back means the
+  gate is off.)
+
+- **local** (default): build a tiny model-zoo engine in THIS process,
+  run a short decode workload under the profiler, and print the logdir
+  — the smoke path that proves the capture pipeline end to end before
+  pointing it at production::
+
+      accelerate-tpu profile --duration-s 1 --family llama
+
+Either way the output is one JSON line naming the logdir; open it in
+TensorBoard / XProf / Perfetto. Exit codes: 0 ok, 2 bad args or an
+unreachable/refusing server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def register_subcommand(subparsers) -> None:
+    parser = subparsers.add_parser(
+        "profile",
+        help="capture a jax.profiler trace (local smoke or a running "
+             "server's /debug/profile)",
+        description=(
+            "On-demand XLA trace capture; see "
+            "docs/observability.md#device-cost--goodput."
+        ),
+    )
+    parser.add_argument(
+        "--url", default=None, metavar="http://HOST:PORT",
+        help="trigger a capture on a running front door (requires "
+             "--debug-endpoints on the server); default: local smoke")
+    parser.add_argument("--duration-s", type=float, default=1.0,
+                        help="capture window in seconds (max 60)")
+    parser.add_argument("--logdir", default=None,
+                        help="trace output directory (default: a fresh "
+                             "temp dir; remote captures resolve it "
+                             "server-side)")
+    parser.add_argument("--family", default="llama",
+                        choices=("llama", "gpt2"),
+                        help="local mode: model-zoo family to drive")
+    parser.set_defaults(func=run_profile)
+
+
+def run_profile(args: argparse.Namespace) -> int:
+    if not 0.0 < args.duration_s <= 60.0:
+        print(f"profile: duration_s must be in (0, 60], got "
+              f"{args.duration_s}", file=sys.stderr)
+        return 2
+    if args.url:
+        return _remote_capture(args)
+    return _local_capture(args)
+
+
+def _remote_capture(args: argparse.Namespace) -> int:
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    query = {"duration_s": f"{args.duration_s:g}"}
+    if args.logdir:
+        query["logdir"] = args.logdir
+    url = (args.url.rstrip("/") + "/debug/profile?"
+           + urllib.parse.urlencode(query))
+    try:
+        # the capture runs for duration_s before the server answers
+        with urllib.request.urlopen(
+                url, timeout=args.duration_s + 30.0) as resp:
+            body = resp.read().decode()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")[:300]
+        hint = (" (is the server running with --debug-endpoints?)"
+                if e.code == 404 else "")
+        print(f"profile: server answered {e.code}{hint}: {detail}",
+              file=sys.stderr)
+        return 2
+    except (urllib.error.URLError, OSError) as e:
+        print(f"profile: cannot reach {args.url}: {e}", file=sys.stderr)
+        return 2
+    print(body.strip())
+    return 0
+
+
+def _local_capture(args: argparse.Namespace) -> int:
+    """The in-process smoke: a tiny engine decodes under the profiler
+    for ~duration_s, so the trace shows real admit/prefill/decode
+    programs (imports stay inside: registering the subcommand must not
+    pull jax)."""
+    import tempfile
+    import time
+
+    import jax
+    import numpy as np
+
+    from ..profiler import profile
+    from ..serving import Engine, EngineConfig
+
+    if args.family == "llama":
+        from ..models import llama as family
+
+        cfg = family.LlamaConfig.tiny()
+    else:
+        from ..models import gpt2 as family
+
+        cfg = family.GPT2Config.tiny()
+    logdir = args.logdir or tempfile.mkdtemp(
+        prefix="accelerate-tpu-profile-")
+    params = family.init_params(cfg, jax.random.key(0))
+    engine = Engine(family, cfg, params,
+                    EngineConfig(num_slots=2, max_len=96,
+                                 prefill_chunk=16))
+    rng = np.random.default_rng(0)
+
+    def one_wave() -> None:
+        for _ in range(2):
+            engine.submit(
+                rng.integers(0, cfg.vocab_size, (8,)).astype(np.int32),
+                max_new_tokens=8)
+        engine.run_until_idle()
+
+    one_wave()  # compile the three programs OUTSIDE the capture
+    steps = 0
+    with profile(logdir):
+        deadline = time.perf_counter() + args.duration_s
+        while time.perf_counter() < deadline:
+            one_wave()
+            steps += 1
+    engine.close()
+    print(json.dumps({"profile": {
+        "logdir": logdir, "duration_s": args.duration_s,
+        "mode": "local", "family": args.family, "waves": steps,
+    }}))
+    return 0
+
+
+if __name__ == "__main__":
+    # `python -m accelerate_tpu.commands.profile ...` must behave like
+    # `accelerate-tpu profile ...` (the lint `__main__`-guard lesson)
+    from .accelerate_cli import main
+
+    sys.exit(main(["profile", *sys.argv[1:]]))

@@ -74,8 +74,9 @@ def register_subcommand(subparsers) -> None:
     parser.add_argument(
         "--debug-endpoints", action="store_true",
         help="enable the read-only /debug/{requests,slots,pages,"
-             "scheduler} introspection routes (off by default: they "
-             "expose workload shape)")
+             "scheduler} introspection routes and the on-demand "
+             "/debug/profile jax.profiler capture (off by default: "
+             "they expose workload shape)")
     parser.add_argument(
         "--trace", action="store_true",
         help="enable host-span request tracing (equivalent to "
@@ -151,7 +152,8 @@ def run_serve(args: argparse.Namespace) -> int:
             "routes": ["/v1/completions", "/v1/chat/completions",
                        "/v1/models", "/healthz", "/metrics"]
             + (["/debug/requests", "/debug/slots", "/debug/pages",
-                "/debug/scheduler"] if args.debug_endpoints else []),
+                "/debug/scheduler", "/debug/profile"]
+               if args.debug_endpoints else []),
             "trace": bool(args.trace),
         }))
         return 0

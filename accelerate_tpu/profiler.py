@@ -95,6 +95,24 @@ def causal_lm_train_flops(n_params: int, tokens: int,
     return flops
 
 
+def causal_lm_infer_flops(n_params: int, tokens: int,
+                          num_layers: int = 0, hidden_size: int = 0,
+                          kv_len: int = 0, attention: bool = True) -> float:
+    """FLOPs to DECODE `tokens` tokens (forward only — no 6ND here):
+    ~2 FLOPs per parameter per token for the weight matmuls, plus the
+    paged-attention term — each new token attends over `kv_len` cached
+    positions, costing ~4 * L * h * kv_len FLOPs (QK^T and AV, 2 each;
+    GQA shrinks the cache read, not the query-side FLOPs, so `hidden_size`
+    stays the full model width). This is the accounting the serving cost
+    table's analytic fallback and decode-MFU meters use — reusing the
+    training 6ND formula for decode overstates FLOPs 3x and hides how
+    idle the MXU actually is."""
+    flops = 2.0 * n_params * tokens
+    if attention and num_layers and kv_len:
+        flops += 4.0 * num_layers * hidden_size * kv_len * tokens
+    return flops
+
+
 @dataclass
 class StepTimer:
     """Per-step timing + throughput/MFU meter, with host-overhead breakdown.
@@ -146,9 +164,14 @@ class StepTimer:
     _seen: int = 0
     _dispatch_seen: int = 0
     _stall_seen: int = 0
+    # wall window spanning exactly the recorded (post-warmup) steps:
+    # goodput = useful step-time / wall-time over this window
+    _window_start: float | None = None
+    _window_end: float | None = None
     _step_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
     _dispatch_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
     _stall_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
+    _overhead_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
 
     def __post_init__(self) -> None:
         make = (self.registry.histogram if self.registry is not None
@@ -159,16 +182,20 @@ class StepTimer:
             self._dispatch_hist = make(f"{self.name}_dispatch_seconds")
         if self._stall_hist is None:
             self._stall_hist = make(f"{self.name}_input_stall_seconds")
+        if self._overhead_hist is None:
+            self._overhead_hist = make(f"{self.name}_overhead_seconds")
 
     def reset(self) -> None:
         """Zero the recorded samples (and warmup progress) in place. With
         a registry, the series OBJECTS are shared by name — a second timer
         with the same (registry, name) continues the same series unless
         reset; the exporter keeps serving the zeroed series either way."""
-        for hist in (self._step_hist, self._dispatch_hist, self._stall_hist):
+        for hist in (self._step_hist, self._dispatch_hist, self._stall_hist,
+                     self._overhead_hist):
             hist.reset()
         self._last = None
         self._seen = self._dispatch_seen = self._stall_seen = 0
+        self._window_start = self._window_end = None
 
     def tick(self, block_on: Any = None) -> float | None:
         """Record one step boundary; returns this step's seconds (or None
@@ -183,6 +210,12 @@ class StepTimer:
             if self._seen > self.warmup_steps:
                 elapsed = now - self._last
                 self._step_hist.record(elapsed)
+                self._window_end = now
+        if self._seen <= self.warmup_steps:
+            # this tick starts the first post-warmup interval: the
+            # goodput window opens here, so warmup/compile never counts
+            # as lost wall time
+            self._window_start = now
         self._last = now
         return elapsed
 
@@ -206,6 +239,21 @@ class StepTimer:
         self._stall_seen += 1
         if self._stall_seen > self.warmup_steps:
             self._stall_hist.record(time.perf_counter() - t0)
+
+    @contextlib.contextmanager
+    def overhead(self) -> Iterator[None]:
+        """Mark non-step wall time the loop KNOWS about (a checkpoint
+        save, an eval pass, a log flush) so `goodput` can subtract it.
+        Tick-to-tick intervals tile the wall clock, so unmarked work
+        between ticks is indistinguishable from step time — this marker
+        is how a training loop makes its goodput honest::
+
+            with timer.overhead():
+                accelerator.save_state(path)
+        """
+        t0 = time.perf_counter()
+        yield
+        self._overhead_hist.record(time.perf_counter() - t0)
 
     @property
     def host_dispatch_us(self) -> float:
@@ -240,6 +288,26 @@ class StepTimer:
     def tokens_per_sec(self) -> float:
         return self.steps_per_sec * self.tokens_per_step
 
+    @property
+    def goodput(self) -> float:
+        """Useful step-time / wall-time over the recorded window, in
+        [0, 1]. Tick-to-tick intervals TILE the window, so the only
+        non-useful time this meter can subtract is what the loop
+        measured: `input_stall()` readings and `overhead()` markers
+        (checkpoint saves, eval passes). Unmarked between-tick work is
+        counted as step time — wrap it in `overhead()` or the reading
+        is an upper bound. NaN before any step records."""
+        if (not self._step_hist.count or self._window_start is None
+                or self._window_end is None):
+            return float("nan")
+        wall = self._window_end - self._window_start
+        if wall <= 0:
+            return float("nan")
+        lost = (self._stall_hist.sum if self._stall_hist.count else 0.0) \
+            + (self._overhead_hist.sum if self._overhead_hist.count else 0.0)
+        useful = max(0.0, self._step_hist.sum - lost)
+        return min(1.0, useful / wall)
+
     def mfu(self) -> float:
         """Model FLOPs utilization in [0,1] against chip peak * num_chips."""
         peak = self.peak_flops if self.peak_flops is not None else peak_flops_per_chip()
@@ -260,6 +328,9 @@ class StepTimer:
             # bounded memory for a run of any length
             out["step_time_p50_s"] = self._step_hist.quantile(0.5)
             out["step_time_p99_s"] = self._step_hist.quantile(0.99)
+            g = self.goodput
+            if g == g:
+                out["goodput"] = g
         if self.tokens_per_step:
             out["tokens_per_sec"] = self.tokens_per_sec
             chips = self.num_chips if self.num_chips is not None else jax.device_count()

@@ -17,6 +17,14 @@ the server too, and an inference front door needs exactly these routes:
                                  serving.pod.PodEngine (404 on a single
                                  engine, and — like every /debug route —
                                  for every method when the gate is off)
+    GET  /debug/profile?duration_s=N[&logdir=D]
+                                 on-demand jax.profiler capture: records
+                                 an XLA/XProf trace of the live engine
+                                 for N seconds (engine keeps serving —
+                                 the drive loop shares the event loop)
+                                 and answers with the logdir; one
+                                 capture at a time (409 while busy).
+                                 Gated with the other /debug routes.
 
 Request tracing: every generate request gets a trace id — minted fresh,
 or joined from a valid inbound W3C `traceparent` header — returned as
@@ -69,7 +77,8 @@ __all__ = ["HttpFrontDoor"]
 
 _REASONS = {200: "OK", 400: "Bad Request", 401: "Unauthorized",
             404: "Not Found", 405: "Method Not Allowed",
-            408: "Request Timeout", 413: "Payload Too Large",
+            408: "Request Timeout", 409: "Conflict",
+            413: "Payload Too Large",
             429: "Too Many Requests", 500: "Internal Server Error",
             503: "Service Unavailable", 504: "Gateway Timeout"}
 
@@ -135,6 +144,7 @@ class HttpFrontDoor:
         self._server: asyncio.base_events.Server | None = None
         self._inflight: set[asyncio.Task] = set()
         self._req_ids = itertools.count(1)
+        self._profiling = False  # one /debug/profile capture at a time
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -223,12 +233,12 @@ class HttpFrontDoor:
             raise _BadRequest(413, f"body exceeds {self.config.max_body_bytes}"
                               " bytes")
         body = await reader.readexactly(length) if length else b""
-        return method, target.split("?")[0], headers, body
+        return method, target, headers, body
 
     async def _handle(self, reader, writer) -> None:
         try:
             try:
-                method, path, headers, body = await asyncio.wait_for(
+                method, target, headers, body = await asyncio.wait_for(
                     self._read_request(reader), timeout=30.0)
             except _BadRequest as e:
                 await self._send_json(writer, e.status,
@@ -237,7 +247,8 @@ class HttpFrontDoor:
             except (asyncio.IncompleteReadError, asyncio.TimeoutError,
                     ConnectionError):
                 return  # the client never finished a request
-            await self._route(writer, method, path, headers, body)
+            path, _, query = target.partition("?")
+            await self._route(writer, method, path, query, headers, body)
         except ConnectionError:
             pass  # disconnects are handled at the streaming sites
         except Exception as e:  # a handler bug must answer 500, not hang
@@ -254,8 +265,8 @@ class HttpFrontDoor:
             except Exception:
                 pass
 
-    async def _route(self, writer, method: str, path: str, headers: dict,
-                     body: bytes) -> None:
+    async def _route(self, writer, method: str, path: str, query: str,
+                     headers: dict, body: bytes) -> None:
         handler: Callable[..., Awaitable] | None = None
         if path == "/healthz":
             handler = self._handle_health
@@ -286,7 +297,7 @@ class HttpFrontDoor:
         # HEAD mirrors GET minus the body (same status/headers/length):
         # health probes HEAD /metrics and /healthz before trusting them,
         # and this route must behave like the standalone exporter's
-        await handler(writer, path, headers, method == "HEAD")
+        await handler(writer, path, query, headers, method == "HEAD")
 
     # -- response writing ----------------------------------------------------
 
@@ -324,14 +335,14 @@ class HttpFrontDoor:
 
     # -- plumbing routes -----------------------------------------------------
 
-    async def _handle_health(self, writer, path, headers,
+    async def _handle_health(self, writer, path, query, headers,
                              head_only=False) -> None:
         ok, reason = self.service.health()
         await self._send_json(writer, 200 if ok else 503,
                               {"status": "ok" if ok else "unavailable",
                                "reason": reason}, head_only=head_only)
 
-    async def _handle_metrics(self, writer, path, headers,
+    async def _handle_metrics(self, writer, path, query, headers,
                               head_only=False) -> None:
         # the SAME negotiation as the standalone exporter: an OpenMetrics
         # Accept gets bucket histograms with trace-id exemplars on the
@@ -341,7 +352,7 @@ class HttpFrontDoor:
         await self._send_raw(writer, 200, text.encode(), ctype,
                              head_only=head_only)
 
-    async def _handle_models(self, writer, path, headers,
+    async def _handle_models(self, writer, path, query, headers,
                              head_only=False) -> None:
         await self._send_json(writer, 200, {
             "object": "list",
@@ -349,13 +360,16 @@ class HttpFrontDoor:
                       "created": 0, "owned_by": "accelerate-tpu"}],
         }, head_only=head_only)
 
-    async def _handle_debug(self, writer, path, headers,
+    async def _handle_debug(self, writer, path, query, headers,
                             head_only=False) -> None:
         """Read-only introspection. Gated OFF by default in `_route`
         (when disabled, /debug/* — any method — 404s exactly like
         unknown paths: the namespace's existence is not advertised to
         an unauthorized prober)."""
         section = path[len("/debug/"):]
+        if section == "profile":
+            await self._handle_profile(writer, query, head_only)
+            return
         state = self.service.debug_state(section)
         if state is None:
             await self._send_json(writer, 404,
@@ -364,6 +378,68 @@ class HttpFrontDoor:
         await self._send_json(writer, 200, {section: state}
                               if isinstance(state, list) else state,
                               head_only=head_only)
+
+    async def _handle_profile(self, writer, query: str,
+                              head_only=False) -> None:
+        """On-demand `jax.profiler` capture (ISSUE 11): record an XLA
+        trace of whatever the engine is doing for `duration_s` seconds
+        and answer with the logdir. The engine keeps serving — its drive
+        loop shares this event loop, so the captured window IS live
+        traffic. One capture at a time: jax has a single global tracer,
+        so a concurrent request answers 409 instead of crashing it."""
+        if head_only:
+            # the one debug route with a side effect: a HEAD probe must
+            # not start a 1-60s capture (nor burn the one-at-a-time
+            # slot, nor litter tempdirs) — 405, not GET-minus-body
+            await self._send_json(writer, 405, error_body(
+                "HEAD not allowed on /debug/profile; use GET"))
+            return
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query)
+        try:
+            duration = float(params.get("duration_s", ["1.0"])[0])
+        except ValueError:
+            await self._send_json(writer, 400, error_body(
+                f"bad duration_s {params.get('duration_s')!r}"))
+            return
+        if not 0.0 < duration <= 60.0:
+            await self._send_json(writer, 400, error_body(
+                f"duration_s must be in (0, 60], got {duration}"))
+            return
+        if self._profiling:
+            # busy check BEFORE any side effect: a 409'd request must
+            # not litter a tempdir per rejected poll
+            await self._send_json(writer, 409, error_body(
+                "a profiler capture is already running (jax has one "
+                "global tracer)", "conflict"))
+            return
+        logdir = params.get("logdir", [None])[0]
+        auto_dir = logdir is None
+        if auto_dir:
+            import tempfile
+
+            logdir = tempfile.mkdtemp(prefix="accelerate-tpu-profile-")
+        self._profiling = True
+        from ..profiler import profile as _profile
+
+        try:
+            with _profile(logdir):
+                await asyncio.sleep(duration)
+        except Exception as e:
+            if auto_dir:
+                import shutil
+
+                shutil.rmtree(logdir, ignore_errors=True)
+            await self._send_json(writer, 500, error_body(
+                f"profiler capture failed: {type(e).__name__}: {e}",
+                "server_error"))
+            return
+        finally:
+            self._profiling = False
+        await self._send_json(writer, 200, {"profile": {
+            "logdir": logdir, "duration_s": duration,
+        }}, head_only=head_only)
 
     # -- generation ----------------------------------------------------------
 

@@ -67,6 +67,10 @@ class ServingMetrics:
         # use x per-page bytes incl. int8 scales) — the series that shows
         # kv_dtype="int8" halving the footprint for the same page count
         self._g_kv_bytes = r.gauge("serving_kv_bytes_in_use")
+        # goodput: useful generated-token device-time / wall-time — the
+        # engine computes it from the cost table's sampled device times
+        # (Engine._goodput) and keeps this gauge live per step
+        self._g_goodput = r.gauge("serving_goodput")
         self._c_decode_path: dict = {}
         self.started_at: float | None = None
         self.stopped_at: float | None = None
@@ -158,6 +162,9 @@ class ServingMetrics:
 
     def note_page_evictions(self, n: int) -> None:
         self._c_evictions.inc(n)
+
+    def set_goodput(self, value: float) -> None:
+        self._g_goodput.set(value)
 
     def set_page_gauges(self, in_use: int, free: int,
                         bytes_in_use: int | None = None) -> None:

@@ -58,6 +58,14 @@ from .export import (
     write_snapshot,
 )
 from .aggregate import aggregate_flat, aggregate_snapshot
+from .cost import (
+    COST_SAMPLE_EVERY_ENV,
+    CostTable,
+    ProgramCost,
+    device_peaks,
+    extract_cost_analysis,
+    resolve_sample_every,
+)
 from .watchdog import (
     INCIDENT_DIR_ENV,
     STALL_TIMEOUT_ENV,
@@ -100,6 +108,12 @@ __all__ = [
     "METRICS_HOST_ENV",
     "aggregate_snapshot",
     "aggregate_flat",
+    "CostTable",
+    "ProgramCost",
+    "device_peaks",
+    "extract_cost_analysis",
+    "resolve_sample_every",
+    "COST_SAMPLE_EVERY_ENV",
     "StallWatchdog",
     "StallError",
     "resolve_stall_timeout",

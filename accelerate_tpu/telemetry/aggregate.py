@@ -8,11 +8,16 @@ uses) and reduces:
 
 - counters  -> global sum (global tokens/sec comes from summed token
   counters over the window),
-- gauges    -> min / mean / max across hosts (per-host HBM high-water
-  marks surface as `name__max`),
+- gauges    -> min / mean / max / sum across hosts (per-host HBM
+  high-water marks surface as `name__max`; the sum is what the
+  per-program COST gauges need — `program_flops{...}` summed over hosts
+  is the pod-wide FLOPs per call, the numerator of pod-level MFU),
 - histograms -> the serialized sketches MERGE, so rank 0 reports true
   global p50/p99 — and `name__slowest_host_mean` exposes the worst
   per-host mean (the straggler signal a merged distribution hides).
+  The per-program `program_device_time_seconds{program=...}` sketches
+  ride this path unchanged: a pod's decode-straggler host shows up as
+  its `__slowest_host_mean` pulling away from the merged p50.
 
 Call it at log boundaries from EVERY process (it is a collective);
 every host gets the aggregate back, rank 0 typically logs it.
@@ -78,7 +83,7 @@ def aggregate_snapshot(registry: MetricsRegistry | None = None,
                 if key in s.get("gauges", {})]
         red = _reduce_scalar(vals)
         out["gauges"][key] = {"min": red["min"], "mean": red["mean"],
-                              "max": red["max"]}
+                              "max": red["max"], "sum": red["sum"]}
 
     keys = {k for s in snapshots for k in s.get("histograms", {})}
     for key in sorted(keys):
@@ -136,6 +141,11 @@ def aggregate_flat(registry: MetricsRegistry | None = None,
     for key, red in agg["gauges"].items():
         for stat in ("min", "mean", "max"):
             flat[f"{prefix}{key}__{stat}"] = red[stat]
+        # additive cost gauges get the cross-host total too: summed
+        # program_flops is the pod-wide FLOPs per call (per-host min/
+        # mean/max of a FLOP count answers nothing)
+        if key.startswith(("program_flops", "program_bytes_accessed")):
+            flat[f"{prefix}{key}__sum"] = red["sum"]
     for key, entry in agg["histograms"].items():
         for stat in ("count", "mean", "p50", "p90", "p99"):
             if stat in entry:

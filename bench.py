@@ -183,6 +183,23 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         tail_timer.tick(m["loss"])
     tail_summary = tail_timer.summary()
 
+    # goodput + measured roofline (ISSUE 11): training goodput is useful
+    # step-time / wall-time from the synchronized window; the accelerator's
+    # cost table carries the compiled step's FLOPs and the fence-sampled
+    # device times accumulated by every dispatch above
+    # only a MEASURED goodput lands in the row: defaulting a missing
+    # reading to 1.0 would hand bench-diff a fabricated best-case
+    # baseline that flags every later honest reading as a regression
+    goodput_row = {}
+    if "goodput" in tail_summary:
+        goodput_row["training"] = round(tail_summary["goodput"], 4)
+    train_sheet = acc.cost_table.roofline("train_step") or {}
+    if "device_time_mean_s" in train_sheet:
+        goodput_row["train_device_time_sampled_ms"] = round(
+            train_sheet["device_time_mean_s"] * 1e3, 4)
+    if "mfu" in train_sheet:
+        goodput_row["train_mfu_measured"] = round(train_sheet["mfu"], 5)
+
     n_chips = jax.device_count()
     tokens_per_step = batch * seq
     tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
@@ -206,6 +223,7 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         "device": device_kind,
         "n_chips": n_chips,
         "host_dispatch_us": round(host_dispatch_us, 1),
+        "goodput": goodput_row,
         # telemetry row (ISSUE 3): step-time tail latency from the shared
         # streaming-histogram meter, not just means
         "telemetry": {
@@ -269,7 +287,11 @@ def _serving_row() -> dict:
     keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
             "per_token_p50_ms", "per_token_p99_ms", "slot_occupancy_mean",
             "requests_finished", "requests_rejected", "kv_bytes_in_use",
-            "pages_capacity")
+            "pages_capacity",
+            # roofline + goodput (ISSUE 11): what the device was doing,
+            # from the engine's cost table and fence-sampled device times
+            "decode_mfu", "decode_mxu_idle_fraction", "decode_hbm_bw_util",
+            "decode_device_time_mean_ms", "goodput")
     row = {k: round(float(s[k]), 2) for k in keep if k in s}
     row["paged_attention"] = ("kernel" if engine._use_paged_kernel
                               else "dense")
@@ -295,7 +317,8 @@ def _serving_prefix_row(num_requests: int = 12, prefix_pool: int = 4,
         prefix_pool=prefix_pool, prefix_len=prefix_len)
     keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
             "prefill_chunks", "prefix_hits", "prefix_hit_rate",
-            "cached_token_fraction", "page_evictions", "requests_finished")
+            "cached_token_fraction", "page_evictions", "requests_finished",
+            "goodput")
     return {k: round(float(s[k]), 3) for k in keep if k in s}
 
 

@@ -1,0 +1,32 @@
+"""Bench regression gate, script form (ISSUE 11).
+
+Compares two bench rows (bench.py one-line JSON, or BENCH_r*.json
+capture files wrapping the row under "parsed") with per-metric relative
+tolerances and direction-aware semantics — tokens/s falling and
+ttft_p99_ms rising are both regressions; configuration fields (seq,
+params, wall_s, device) are never compared::
+
+    python benchmarks/regression.py BENCH_r02.json new.json \
+        --tolerance 0.05 --metric-tolerance ttft_p99_ms=0.25
+
+Exit codes: 0 = pass, 1 = regression (a compared metric moved worse
+than its tolerance, or a headline/phase row went value -> error),
+2 = malformed input. This is the same gate as `accelerate-tpu
+bench-diff` (accelerate_tpu/commands/bench_diff.py owns the logic); the
+script form exists so the r01-r05 trajectory can be checked from a bare
+checkout: `python benchmarks/regression.py BENCH_r01.json BENCH_r02.json`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+if __name__ == "__main__":
+    # script invocation puts benchmarks/ (not the repo root) on sys.path
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from accelerate_tpu.commands.bench_diff import main
+
+    sys.exit(main(sys.argv[1:]))

@@ -16,7 +16,16 @@ Emits ONE JSON line:
              "requests_finished": ..., "requests_rejected": ...,
              "requests_expired": ..., "slot_occupancy_mean": ...,
              "prefix_hit_rate": ..., "cached_token_fraction": ...,
+             "decode_mfu": ..., "decode_mxu_idle_fraction": ...,
+             "decode_device_time_mean_ms": ..., "goodput": ...,
              "compiles_decode": 1, ...}}
+
+The roofline fields (decode MFU / HBM-bandwidth utilization / MXU-idle
+fraction, measured device-time percentiles) and `goodput` come from the
+engine's cost table (ISSUE 11, telemetry/cost.py) — sampled fence-pair
+device timing against the per-program FLOPs/bytes cost table, nominal
+peaks off TPU. Gate a run against a previous one with
+`accelerate-tpu bench-diff old.json new.json`.
 
 `--prefix-pool N --prefix-len L` switches the prompt generator to
 shared-prefix traffic (each prompt = one of N fixed L-token prefixes + a

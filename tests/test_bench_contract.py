@@ -92,6 +92,13 @@ def test_serve_bench_smoke_emits_serving_metrics():
     assert summary["ttft_p50_ms"] > 0
     assert summary["per_token_p50_ms"] > 0
     assert summary["compiles_decode"] == 1
+    # the ISSUE 11 acceptance smoke: decode MFU / MXU-idle / goodput
+    # non-null on CPU (nominal peaks — labeled, but the pipeline flows),
+    # with the compile count still flat (sampling is host-side)
+    for key in ("decode_mfu", "decode_mxu_idle_fraction", "goodput",
+                "decode_device_time_mean_ms"):
+        assert key in summary and summary[key] == summary[key], key
+    assert 0.0 < summary["goodput"] <= 1.0
 
 
 def test_bench_serving_row_shape():
@@ -103,6 +110,9 @@ def test_bench_serving_row_shape():
     for field in ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
                   "per_token_p50_ms", "per_token_p99_ms"):
         assert row[field] > 0, row
+    # roofline/goodput fields (ISSUE 11) ride the same row
+    for field in ("decode_mfu", "decode_mxu_idle_fraction", "goodput"):
+        assert field in row and row[field] == row[field], (field, row)
 
 
 def test_bench_serving_prefix_row_shape():
@@ -615,3 +625,194 @@ def test_serve_bench_kv_dtype_and_paged_attention_flags():
     # code bytes halve; the per-row scales add the documented 2/D
     ratio = out["int8"] / out[None]
     assert 0.5 < ratio <= 0.6, out
+
+
+# ---------------------------------------------------------------------------
+# device-cost attribution & the bench regression gate (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def _write_row(tmp_path, name: str, row: dict) -> str:
+    path = os.path.join(str(tmp_path), name)
+    with open(path, "w") as f:
+        json.dump(row, f)
+    return path
+
+
+def test_bench_diff_exit_codes(tmp_path):
+    """The regression gate's three verdicts, driven on a REAL bench row
+    (BENCH_r02.json, the r02 TPU capture): identical rows pass (0), a
+    synthetically degraded copy exits 1, a contract-violating row exits
+    2."""
+    from accelerate_tpu.commands.bench_diff import load_row, main
+
+    real = os.path.join(ROOT, "BENCH_r02.json")
+    assert main([real, real]) == 0
+
+    row = load_row(real)
+    bad = json.loads(json.dumps(row))
+    bad["value"] = row["value"] * 0.8           # tokens/s fell 20%
+    bad["extra"]["mfu"] = row["extra"]["mfu"] * 0.7
+    degraded = _write_row(tmp_path, "degraded.json", bad)
+    assert main([real, degraded]) == 1
+    # generous tolerance waves the same drop through
+    assert main([real, degraded, "--tolerance", "0.5"]) == 0
+    # per-metric override: only the mfu drop is out of tolerance
+    assert main([real, degraded, "--tolerance", "0.5",
+                 "--metric-tolerance", "mfu=0.1"]) == 1
+
+    # vs_baseline is a compared top-level metric, not a dead table entry
+    vb = json.loads(json.dumps(row))
+    vb["vs_baseline"] = row["vs_baseline"] * 0.5
+    assert main([real, _write_row(tmp_path, "vb.json", vb)]) == 1
+
+    malformed = _write_row(tmp_path, "malformed.json", {"value": 3})
+    assert main([real, malformed]) == 2
+    assert main([real, os.path.join(str(tmp_path), "missing.json")]) == 2
+
+
+def test_bench_diff_headline_value_to_error_regresses(tmp_path):
+    """Losing the number IS a regression: a baseline with a real value
+    against a candidate whose headline carries an error must fail the
+    gate (exit 1, 'degraded' in the report) — and a deliberate operator
+    skip must NOT."""
+    from accelerate_tpu.commands.bench_diff import (
+        compare_rows, load_row, main)
+
+    real = os.path.join(ROOT, "BENCH_r02.json")
+    err_row = {"schema_version": 2,
+               "metric": "llama_train_tokens_per_sec_per_chip",
+               "unit": "tokens/s/chip", "value": None,
+               "error": "tunnel down", "extra": {}}
+    err = _write_row(tmp_path, "err.json", err_row)
+    assert main([real, err]) == 1
+    report = compare_rows(load_row(real), err_row)
+    assert report["degraded"]
+    skip_row = dict(err_row, error=None, skipped="operator cpu pin")
+    skipped = _write_row(tmp_path, "skip.json", skip_row)
+    assert main([real, skipped]) == 0
+
+
+def test_bench_diff_phase_row_regression(tmp_path):
+    """Schema-v2 phase rows compare their value dicts with direction
+    awareness: ttft_p99_ms RISING is the regression; tokens_per_sec
+    rising is an improvement."""
+    from accelerate_tpu.commands.bench_diff import compare_rows
+
+    def line(ttft, tps):
+        return {
+            "schema_version": 2, "metric": "m", "unit": "u", "value": 1.0,
+            "extra": {"serving": {
+                "metric": "serving_offered_load", "unit": "summary",
+                "value": {"ttft_p99_ms": ttft, "tokens_per_sec": tps,
+                          "wall_s": 3.0}}},
+        }
+
+    report = compare_rows(line(10.0, 100.0), line(20.0, 150.0))
+    keys = {e["key"] for e in report["regressions"]}
+    assert keys == {"extra.serving.ttft_p99_ms"}
+    assert {e["key"] for e in report["improvements"]} == {
+        "extra.serving.tokens_per_sec"}
+    # wall_s has no direction: configuration, never compared
+    assert not any("wall_s" in e["key"]
+                   for e in report["regressions"] + report["improvements"])
+    # a phase that went value -> error is a degraded row
+    broken = line(10.0, 100.0)
+    broken["extra"]["serving"] = {"metric": "serving_offered_load",
+                                  "unit": "summary",
+                                  "error": "phase hung"}
+    report = compare_rows(line(10.0, 100.0), broken)
+    assert report["degraded"] == [
+        "extra.serving (phase went value -> error)"]
+
+
+def test_regression_script_delegates(tmp_path):
+    """benchmarks/regression.py is the script form of the same gate:
+    same exit codes from a bare checkout."""
+    real = os.path.join(ROOT, "BENCH_r02.json")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "regression.py"),
+         real, real], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    malformed = _write_row(tmp_path, "bad.json", {"value": 1})
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks", "regression.py"),
+         real, malformed], capture_output=True, text=True, timeout=60)
+    assert out.returncode == 2, (out.stdout, out.stderr)
+
+
+def test_debug_profile_gating_and_capture(tmp_path):
+    """/debug/profile: 404 for EVERY method when the debug gate is off
+    (indistinguishable from unknown paths), a real jax.profiler capture
+    when on — the response names the logdir and the trace files exist;
+    bad durations answer 400."""
+    import asyncio
+
+    from accelerate_tpu.server.config import ServerConfig
+    from accelerate_tpu.server.http import HttpFrontDoor
+    from accelerate_tpu.server.service import InferenceService
+    from accelerate_tpu.server.tokenizer import get_tokenizer
+
+    sb = _load_serve_bench()
+    engine, cfg = sb.build_tiny_engine("gpt2", num_slots=2, max_len=32,
+                                       prefill_chunk=8)
+
+    async def req(port: int, method: str, target: str) -> tuple[int, bytes]:
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(f"{method} {target} HTTP/1.1\r\nHost: t\r\n"
+                     "Content-Length: 0\r\n\r\n".encode())
+        await writer.drain()
+        resp = await reader.read()
+        writer.close()
+        head, _, body = resp.partition(b"\r\n\r\n")
+        return int(head.split(b" ")[1]), body
+
+    async def gated_off():
+        service = InferenceService(
+            engine, get_tokenizer("auto", cfg.vocab_size),
+            ServerConfig(port=0, debug_endpoints=False))
+        door = HttpFrontDoor(service)
+        await door.start()
+        try:
+            for method in ("GET", "POST", "HEAD"):
+                status, _ = await req(door.port, method,
+                                      "/debug/profile?duration_s=0.01")
+                assert status == 404, method
+        finally:
+            await door.stop()
+
+    asyncio.run(asyncio.wait_for(gated_off(), 60))
+
+    logdir = os.path.join(str(tmp_path), "capture")
+
+    async def gated_on():
+        service = InferenceService(
+            engine, get_tokenizer("auto", cfg.vocab_size),
+            ServerConfig(port=0, debug_endpoints=True))
+        door = HttpFrontDoor(service)
+        await door.start()
+        try:
+            status, body = await req(
+                door.port, "GET", "/debug/profile?duration_s=bogus")
+            assert status == 400
+            status, body = await req(
+                door.port, "GET", "/debug/profile?duration_s=99")
+            assert status == 400
+            # HEAD must NOT start a capture (the one side-effecting
+            # debug route): 405, never GET-minus-body
+            status, _ = await req(door.port, "HEAD",
+                                  "/debug/profile?duration_s=30")
+            assert status == 405
+            status, body = await req(
+                door.port, "GET",
+                f"/debug/profile?duration_s=0.05&logdir={logdir}")
+            assert status == 200, body
+            payload = json.loads(body)["profile"]
+            assert payload["logdir"] == logdir
+        finally:
+            await door.stop()
+
+    asyncio.run(asyncio.wait_for(gated_on(), 120))
+    produced = [os.path.join(dirpath, f)
+                for dirpath, _, files in os.walk(logdir) for f in files]
+    assert produced, "profiler capture produced no trace files"

@@ -296,7 +296,7 @@ class TestEngineIntrospection:
         eng = _make_engine(gpt2_setup)
         dumps = eng.incident_dumps()
         assert set(dumps) == {"requests", "slots", "pages", "scheduler",
-                              "compile_stats"}
+                              "compile_stats", "cost_table"}
 
     def test_watchdog_stall_writes_engine_bundle(self, gpt2_setup,
                                                  tmp_path):

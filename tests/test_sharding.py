@@ -119,6 +119,23 @@ def test_shard_pytree_places_arrays():
     assert q.addressable_shards[0].data.shape == (8, 64)
 
 
+def test_shard_pytree_mixed_and_none_leaves():
+    """The batched one-call placement path must keep the per-leaf
+    semantics: non-array leaves pass through untouched, a None plan leaf
+    means default placement, structure is preserved."""
+    mesh = MeshConfig(axes={"fsdp": 8}).build()
+    tree = {"a": np.ones((8, 4)), "n": 3, "s": "tag",
+            "b": jnp.zeros((2,))}
+    plan = {"a": jax.sharding.NamedSharding(mesh, P("fsdp", None)),
+            "n": None, "s": None, "b": None}
+    out = shard_pytree(tree, plan)
+    assert out["n"] == 3 and out["s"] == "tag"
+    assert len(out["a"].sharding.device_set) == 8
+    assert isinstance(out["b"], jax.Array)
+    # an all-static tree is a no-op, not a device_put([]) crash
+    assert shard_pytree({"k": 1}, {"k": None}) == {"k": 1}
+
+
 def test_optimizer_state_sharding_adam():
     import optax
 
