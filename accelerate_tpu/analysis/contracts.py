@@ -100,6 +100,7 @@ def contract_for(name: str, flavor: str | None = None) -> CollectiveContract:
 
 def serving_program_contracts(
     paged_kernel: bool = False,
+    speculative: bool = False,
 ) -> dict[str, CollectiveContract]:
     """Default contracts for a SINGLE-DEVICE serving engine's three
     programs: admit/prefill/decode must carry NO collectives — one
@@ -117,6 +118,14 @@ def serving_program_contracts(
     no-collectives clause; the variant is named distinctly so a contract
     failure report says which decode flavor it audited.
 
+    `speculative=True` is the draft-model speculative-decoding engine
+    (`EngineConfig(speculative=...)`): the one-token decode is replaced
+    by the `draft_prefill`/`draft`/`verify` trio — all still chip-local
+    (the draft runs against its own dense slot cache, the verify is the
+    same short-sequence paged forward prefill already is), so every
+    program keeps the exhaustive no-collectives clause; they are named
+    so a contract failure says which of the five programs it audited.
+
     "No collectives" is the single-device promise only: a mesh-sharded
     engine (`EngineConfig(mesh=...)`, serving/pod) MUST communicate, and
     its strict audit defaults to `pod_program_contracts()` below —
@@ -124,13 +133,15 @@ def serving_program_contracts(
     them. Engines with bespoke sharding pass their own contracts via
     `EngineConfig(contracts=...)`."""
     variant = {"decode": ".paged-kernel" if paged_kernel else ""}
+    names = (("admit", "prefill", "draft_prefill", "draft", "verify")
+             if speculative else ("admit", "prefill", "decode"))
     return {
         name: CollectiveContract(
             name=f"serving.{name}{variant.get(name, '')}",
             forbid=CANONICAL_COLLECTIVES,
             exhaustive=True,
         )
-        for name in ("admit", "prefill", "decode")
+        for name in names
     }
 
 

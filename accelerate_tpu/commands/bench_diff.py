@@ -49,6 +49,9 @@ _HIGHER_IS_BETTER = {
     "prefix_hit_rate", "cached_token_fraction", "slo_attainment",
     "decode_mfu", "decode_hbm_bw_util", "hbm_bw_util",
     "train_mfu_measured",
+    # speculative decoding (ISSUE 12): committed tokens per decode-role
+    # step is the headline lever; the accept rate is its driver
+    "tokens_per_decode_step", "spec_accept_rate",
 }
 _LOWER_IS_BETTER = {
     "ttft_p50_ms", "ttft_p99_ms", "ttft_mean_ms",

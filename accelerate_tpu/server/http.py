@@ -66,6 +66,7 @@ from .protocol import (
     completion_chunk,
     completion_response,
     error_body,
+    logprobs_block,
     parse_chat_request,
     parse_completion_request,
     sse_event,
@@ -518,12 +519,17 @@ class HttpFrontDoor:
         return out
 
     def _rank(self, params, reqs):
-        """best_of ranking: the n best candidates by the documented
-        heuristic (longest completion, ties to lower index)."""
+        """best_of ranking by TRUE cumulative logprob (the engine emits
+        each token's model logprob — ISSUE 12): highest sum of emitted-
+        token logprobs wins, ties to the lower candidate index. A
+        candidate with no logprobs (shed before any token) ranks last."""
         if params.best_of <= params.n:
             return reqs
-        order = sorted(range(len(reqs)),
-                       key=lambda i: (-len(reqs[i].tokens), i))
+        order = sorted(
+            range(len(reqs)),
+            key=lambda i: (-(reqs[i].cumulative_logprob
+                             if reqs[i].cumulative_logprob is not None
+                             else float("-inf")), i))
         return [reqs[i] for i in order[:params.n]]
 
     async def _unary_response(self, writer, rid, model, created, params,
@@ -545,17 +551,23 @@ class HttpFrontDoor:
             text = choice.text
             if params.echo and not chat:
                 text = tokenizer.decode(list(req.prompt)) + text
+            lp_block = None
+            if params.logprobs is not None:
+                lp_block = logprobs_block(req.tokens, req.logprobs)
             if chat:
-                choices.append({
+                entry = {
                     "index": idx,
                     "message": {"role": "assistant", "content": text,
                                 "token_ids": choice.token_ids},
-                    "finish_reason": reason})
+                    "finish_reason": reason}
+                if lp_block is not None:
+                    entry["logprobs"] = lp_block
+                choices.append(entry)
             else:
                 choices.append({
                     "index": idx, "text": text,
                     "token_ids": choice.token_ids,
-                    "logprobs": None, "finish_reason": reason})
+                    "logprobs": lp_block, "finish_reason": reason})
         build = chat_response if chat else completion_response
         await self._send_json(
             writer, 200,
@@ -579,15 +591,20 @@ class HttpFrontDoor:
                    for _ in reqs]
         first = [True] * len(reqs)
         try:
-            async for idx, ids, done in self.service.stream_tokens(reqs):
+            async for idx, ids, lps, done in self.service.stream_tokens(reqs):
                 ch = choices[idx]
+                lp_block = (logprobs_block(ids, lps)
+                            if params.logprobs is not None else None)
                 if done:
                     delta = ch.finish()
                     reason = "stop" if ch.stopped \
                         else self.service.finish_reason(reqs[idx])
                     payload = make(rid, model, created, idx, delta, [],
                                    reason, **({"first": first[idx]}
-                                              if chat else {}))
+                                              if chat else {}),
+                                   **({"logprobs": logprobs_block([], [])}
+                                      if params.logprobs is not None
+                                      else {}))
                 elif ch.stopped:
                     continue  # stop string hit earlier; suppress the tail
                 else:
@@ -598,7 +615,9 @@ class HttpFrontDoor:
                         self.service.finish(reqs[idx])
                     payload = make(rid, model, created, idx, delta, ids,
                                    None, **({"first": first[idx]}
-                                            if chat else {}))
+                                            if chat else {}),
+                                   **({"logprobs": lp_block}
+                                      if lp_block is not None else {}))
                 first[idx] = False
                 writer.write(sse_event(payload))
                 # drain() is where a dead client surfaces: the

@@ -25,6 +25,7 @@ __all__ = [
     "chat_chunk",
     "chat_response",
     "error_body",
+    "logprobs_block",
     "sse_event",
     "SSE_DONE",
 ]
@@ -72,6 +73,12 @@ class CompletionParams:
     user: str | None
     seed: int | None
     chat: bool = False
+    # OpenAI `logprobs`: None = off; 0/1 = include each emitted token's
+    # model logprob (the engine computes exactly one logprob per token —
+    # the emitted one — so top-N alternatives beyond 1 are rejected at
+    # validation, not silently dropped). Chat's boolean `logprobs` maps
+    # to 0. See docs/server.md for the response-block shape.
+    logprobs: int | None = None
 
     @property
     def fan_out(self) -> int:
@@ -168,6 +175,27 @@ def _parse_common(body: dict, max_total_tokens: int,
                 user=user, seed=seed)
 
 
+def _parse_logprobs(body: dict, chat: bool) -> int | None:
+    """Completions take an int (0/1 supported — the engine has exactly
+    the emitted token's logprob, so requests for top-N alternatives are
+    a 400, not silent truncation); chat takes the OpenAI boolean."""
+    raw = body.get("logprobs")
+    if raw is None or raw is False:
+        return None
+    if chat:
+        if raw is True:
+            return 0
+        raise ProtocolError(400, "'logprobs' must be a boolean for chat")
+    if isinstance(raw, bool) or not isinstance(raw, int):
+        raise ProtocolError(400, "'logprobs' must be an integer")
+    if not 0 <= raw <= 1:
+        raise ProtocolError(
+            400, f"'logprobs' must be 0 or 1, got {raw}: the server "
+            "returns the emitted token's logprob only (top-N "
+            "alternatives are not computed)")
+    return raw
+
+
 def parse_completion_request(body: Any, max_total_tokens: int,
                              default_max_tokens: int = 16) -> CompletionParams:
     """Validate a `/v1/completions` body into CompletionParams; raises
@@ -181,6 +209,7 @@ def parse_completion_request(body: Any, max_total_tokens: int,
         raise ProtocolError(400, "'echo' must be a boolean")
     return CompletionParams(prompt_text=text, prompt_ids=ids, echo=echo,
                             chat=False,
+                            logprobs=_parse_logprobs(body, chat=False),
                             **_parse_common(body, max_total_tokens,
                                             default_max_tokens))
 
@@ -215,7 +244,9 @@ def parse_chat_request(body: Any, max_total_tokens: int,
     if common["best_of"] > common["n"]:
         raise ProtocolError(400, "'best_of' is not supported for chat")
     return CompletionParams(prompt_text=render_chat_prompt(messages),
-                            prompt_ids=None, echo=False, chat=True, **common)
+                            prompt_ids=None, echo=False, chat=True,
+                            logprobs=_parse_logprobs(body, chat=True),
+                            **common)
 
 
 # -- response envelopes ------------------------------------------------------
@@ -233,15 +264,33 @@ def completion_response(rid: str, model: str, created: int,
     return out
 
 
+def logprobs_block(token_ids: list[int],
+                   token_logprobs: list[float]) -> dict:
+    """The `logprobs` choice field: per-token model logprobs of the
+    emitted tokens (log-softmax of the raw target logits — temperature-
+    free). Deviation from OpenAI, documented in docs/server.md: tokens
+    are identified by `token_ids`, not decoded strings (the byte-level
+    tokenizer's single tokens need not be valid code points), and
+    `top_logprobs` is always null (only the emitted token's logprob is
+    computed)."""
+    return {
+        "token_ids": list(token_ids),
+        "token_logprobs": [round(float(lp), 6) for lp in token_logprobs],
+        "top_logprobs": None,
+    }
+
+
 def completion_chunk(rid: str, model: str, created: int, index: int,
                      text: str, token_ids: list[int],
-                     finish_reason: str | None) -> dict:
+                     finish_reason: str | None,
+                     logprobs: dict | None = None) -> dict:
     out = _base("text_completion", rid, model, created)
     # `token_ids` is an extension field: it makes streamed output
     # byte-auditable against Engine.stream (the acceptance contract) and
     # lets id-level clients skip detokenization entirely
     out["choices"] = [{"index": index, "text": text, "token_ids": token_ids,
-                       "logprobs": None, "finish_reason": finish_reason}]
+                       "logprobs": logprobs,
+                       "finish_reason": finish_reason}]
     return out
 
 
@@ -255,13 +304,16 @@ def chat_response(rid: str, model: str, created: int,
 
 def chat_chunk(rid: str, model: str, created: int, index: int, text: str,
                token_ids: list[int], finish_reason: str | None,
-               first: bool = False) -> dict:
+               first: bool = False, logprobs: dict | None = None) -> dict:
     out = _base("chat.completion.chunk", rid, model, created)
     delta: dict = {"content": text, "token_ids": token_ids}
     if first:
         delta["role"] = "assistant"
-    out["choices"] = [{"index": index, "delta": delta,
-                       "finish_reason": finish_reason}]
+    choice = {"index": index, "delta": delta,
+              "finish_reason": finish_reason}
+    if logprobs is not None:
+        choice["logprobs"] = logprobs
+    out["choices"] = [choice]
     return out
 
 

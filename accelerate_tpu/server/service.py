@@ -10,14 +10,15 @@ every engine step, which is what lets a client disconnect cancel ONE
 request (freeing its slot and pages immediately) without perturbing the
 others.
 
-Fan-out (`n`/`best_of`) is N engine submissions sharing one prompt — the
-paged KV cache's radix tree makes the prompt copy-on-write across
-candidates: a sibling admitted after an earlier one retires maps the
-cached prompt pages instead of re-prefilling them (and repeat calls with
-the same prompt hit outright). best_of ranks finished candidates by a
-deterministic heuristic (longest completion, ties to the lower
-candidate index): the engine exposes no per-token logprobs, and an
-honest documented heuristic beats a fake logprob.
+Fan-out (`n`/`best_of`) is ONE engine submission plus N-1 `Engine.fork`s
+(ISSUE 12): siblings share the parent's prompt pages copy-on-write
+through the radix tree — published as the parent's prefill completes
+them, so the whole fan-out pays a single prompt prefill and each sibling
+diverges at its first private page. best_of ranks finished candidates by
+TRUE cumulative logprob (the engine emits per-token model logprobs),
+ties to the lower candidate index. Engines without `fork` (the pod
+router) fall back to independent submissions — sharing then happens
+only through ordinary retirement-time prefix reuse.
 
 Graceful drain: `drain()` flips the service to draining (healthz -> 503,
 new submissions -> 503), lets in-flight requests finish inside the
@@ -303,6 +304,11 @@ class InferenceService:
         from ..telemetry.trace import head_sample
 
         sampled = head_sample(tenant)
+        # COW fan-out: candidate 0 submits normally, siblings FORK it —
+        # they share its prompt pages (published as its prefill completes
+        # them), so n=8 pays one prompt prefill. The pod router has no
+        # fork yet; it keeps the independent-submission path.
+        fork = getattr(self.engine, "fork", None)
         reqs: list[Request] = []
         for i in range(params.fan_out):
             key = None
@@ -310,13 +316,21 @@ class InferenceService:
                 # distinct deterministic stream per candidate: raw
                 # uint32[2] key data, same shape Engine._as_raw_key takes
                 key = np.array([params.seed & 0xFFFFFFFF, i], np.uint32)
-            req = self.engine.submit(
-                prompt, max_new_tokens=params.max_tokens,
-                temperature=params.temperature, key=key,
-                eos_token_id=self.tokenizer.eos_token_id, tenant=tenant,
-                trace_id=trace_id, trace_parent=trace_parent,
-                trace_sampled=sampled,
-            )
+            if reqs and fork is not None:
+                req = fork(
+                    reqs[0], max_new_tokens=params.max_tokens,
+                    temperature=params.temperature, key=key,
+                    trace_id=trace_id, trace_parent=trace_parent,
+                    trace_sampled=sampled,
+                )
+            else:
+                req = self.engine.submit(
+                    prompt, max_new_tokens=params.max_tokens,
+                    temperature=params.temperature, key=key,
+                    eos_token_id=self.tokenizer.eos_token_id, tenant=tenant,
+                    trace_id=trace_id, trace_parent=trace_parent,
+                    trace_sampled=sampled,
+                )
             if req.status is RequestStatus.REJECTED:
                 for sib in reqs:
                     self.engine.cancel(sib)
@@ -414,10 +428,12 @@ class InferenceService:
 
     async def stream_tokens(
             self, reqs: list[Request],
-    ) -> AsyncIterator[tuple[int, list[int], bool]]:
+    ) -> AsyncIterator[tuple[int, list[int], list[float], bool]]:
         """Merge N live requests into one (choice_index, new_token_ids,
-        finished) stream; `finished` fires exactly once per choice, after
-        its last tokens."""
+        new_token_logprobs, finished) stream; `finished` fires exactly
+        once per choice, after its last tokens. The logprob slice is
+        index-aligned with the token slice (both come from the same
+        engine step)."""
         sent = [0] * len(reqs)
         closed = [False] * len(reqs)
         while not all(closed):
@@ -427,12 +443,13 @@ class InferenceService:
                     continue
                 if sent[i] < len(r.tokens):
                     new = list(r.tokens[sent[i]:])
+                    lps = list(r.logprobs[sent[i]:sent[i] + len(new)])
                     sent[i] = len(r.tokens)
                     progressed = True
-                    yield i, new, False
+                    yield i, new, lps, False
                 if r.done:
                     closed[i] = True
                     progressed = True
-                    yield i, [], True
+                    yield i, [], [], True
             if not progressed:
                 await self._wait_progress()

@@ -444,6 +444,31 @@ def paged_append_batch(cache: PagedKVCache, table: jax.Array,
     return paged_append_rows(cache, table, row_k, row_v, live)
 
 
+def paged_append_window(cache: PagedKVCache, table: jax.Array,
+                        win_k: jax.Array, win_v: jax.Array,
+                        counts: jax.Array, live: jax.Array) -> PagedKVCache:
+    """Write each slot's next `counts[s]` rows from a fixed-width window
+    ([L, S, W, H, D] — view rows [length, length + W)) and advance live
+    lanes' lengths by their count. The speculative-decoding commit: the
+    verify program produces W candidate rows per slot but only the
+    accepted prefix is real, so rows at or past a slot's count (and every
+    row of a dead lane) are routed to the trash page — the scatter stays
+    fixed-shape whatever the per-slot accept counts. Every written row is
+    at or past `length`, hence in a PRIVATE page (allocator invariant),
+    so shared copy-on-write pages are untouched — the same write-safety
+    argument as `paged_append_rows`, W rows at a time."""
+    _, _, ps, _, _ = cache.k.shape
+    W = win_k.shape[2]
+    rows = cache.lengths[:, None] + jnp.arange(W, dtype=jnp.int32)  # [S, W]
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :] < counts[:, None]) \
+        & live[:, None]
+    pages = jnp.take_along_axis(table, rows // ps, axis=1)
+    pages = jnp.where(valid, pages, cache.trash_page)
+    offs = rows % ps
+    new_lengths = cache.lengths + jnp.where(live, counts, 0)
+    return _scatter_rows(cache, pages, offs, win_k, win_v, new_lengths)
+
+
 def paged_admit_slot(cache: PagedKVCache, slot: jax.Array,
                      reused_len: jax.Array) -> PagedKVCache:
     """Admit a request into `slot`: length starts at the reused prefix
@@ -603,6 +628,37 @@ class PrefixIndex:
             node = child
         return spare
 
+    def extend_path(self, prompt: np.ndarray, pages: list[int],
+                    start: int, upto: int) -> list[_RadixNode]:
+        """Walk/create nodes for chunks [start, upto) of `prompt`,
+        adopting `pages[i]` for chunks not yet cached — the mid-flight
+        half of `insert`, used by `PagedAllocator.publish_prompt` to
+        share a RUNNING request's already-prefilled prompt pages (COW
+        request forking). Stops at the first chunk already cached under
+        a DIFFERENT page: past that point the caller's pages can't back
+        the tree path, and the pages[:len(nodes)]-are-node-pages
+        invariant of `PageAllocation` must hold for the extended node
+        list. The first `start` chunks must already be the caller's
+        mapped (refcount > 0, hence unevictable) path. Returned nodes
+        are refcount-0 until the caller acquires them."""
+        node = self.root
+        for i in range(start):
+            node = node.children[self._chunk(prompt, i)]
+        out: list[_RadixNode] = []
+        for i in range(start, upto):
+            key = self._chunk(prompt, i)
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(key, pages[i], node)
+                node.children[key] = child
+                self.cached_pages += 1
+            elif child.page != pages[i]:
+                break
+            self._touch(child)
+            out.append(child)
+            node = child
+        return out
+
     def evict_lru(self, n: int) -> list[int]:
         """Free exactly `n` pages, detaching least-recently-used
         refcount-0 leaves (evicting a leaf can turn its parent into the
@@ -681,6 +737,15 @@ class PagedAllocator:
         self.index = PrefixIndex(page_size)
         self.on_evict = on_evict
         self.on_unmap = on_unmap
+        # admission-hold hook: hold_admission(request) -> True keeps the
+        # request queued even when pages ARE available. The engine uses
+        # it for COW forks: a fork child admitted before its parent's
+        # prompt pages are published would cold-prefill the very prompt
+        # it was forked to share — waiting the few steps until the
+        # parent's prefill publishes them is what makes an n-way fan-out
+        # cost ONE prefill. Same no-skip-ahead semantics as a pages-tight
+        # head: the queue waits behind it.
+        self.hold_admission: Callable[[Any], bool] | None = None
         # running totals for host-side (model-free) observability and
         # tests. The engine's registry counters are booked separately:
         # evictions reach it through on_evict, admission outcomes through
@@ -711,6 +776,8 @@ class PagedAllocator:
         None = insufficient pages even with eviction (keep queued) — and
         in that case NOTHING was evicted (evict_lru is all-or-nothing),
         so a too-big queue head can't strip the cache while it waits."""
+        if self.hold_admission is not None and self.hold_admission(request):
+            return None
         nodes = (self.index.match(request.prompt)
                  if self.prefix_cache else [])
         n_total = self.pages_needed(request.prompt_len,
@@ -753,6 +820,36 @@ class PagedAllocator:
         if alloc.nodes:
             self.hits -= 1
             self.tokens_reused -= alloc.reused_len
+
+    def publish_prompt(self, slot) -> int:
+        """Insert a RUNNING slot's already-prefilled FULL prompt pages
+        into the prefix tree NOW, instead of waiting for retirement —
+        the mechanism behind engine-level COW request forking: a fork of
+        this request admitted later maps these pages instead of
+        re-prefilling the prompt. Only pages every row of which holds
+        final real-token K/V are published (prefill writes always land
+        at or past the slot's current length, so a full page below
+        `prompt_done` is immutable from here on — the same invariant
+        retirement-inserted pages rely on). The published nodes are
+        acquired into the slot's own allocation, so they are mapped
+        (unevictable) for as long as the slot runs, and `release()` later
+        drops them exactly like an admission-time prefix hit. Returns
+        the number of prompt pages now shared. Idempotent; no-op when
+        the prefix cache is off."""
+        if not self.prefix_cache:
+            return 0
+        alloc, req = slot.alloc, slot.request
+        if alloc is None:
+            return 0
+        full = min(slot.prompt_done, req.prompt_len) // self.page_size
+        n_cached = len(alloc.nodes)
+        if full <= n_cached:
+            return n_cached
+        new_nodes = self.index.extend_path(req.prompt, alloc.pages,
+                                           n_cached, full)
+        self.index.acquire(new_nodes)
+        alloc.nodes.extend(new_nodes)
+        return len(alloc.nodes)
 
     def release(self, slot, finished: bool) -> None:
         """Return a retiring slot's pages: shared nodes drop a refcount

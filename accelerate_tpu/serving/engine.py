@@ -44,6 +44,28 @@ step ends in one small device->host read of the [S] token vector (the same
 role the per-layer device->host probe plays in
 `big_modeling.stream_layers`), which is what `stream()`/`astream()` yield
 from.
+
+Speculative decoding (`EngineConfig(speculative=(family, config, params),
+draft_k=K)`, off by default — the three-program contract above is
+unchanged when off) replaces the one-token decode step with a
+draft/verify pair: a small family member drafts K tokens per slot (K
+sequential steps of the cheap model against its own dense slot cache),
+the target verifies all K in ONE batched K-token paged forward, and the
+standard accept rule commits the agreed prefix plus one correction token
+— exact-match for greedy (byte-identical output by construction),
+rejection sampling for sampled requests (the committed distribution IS
+the target's). Five fixed-shape programs (admit/prefill/draft_prefill/
+draft/verify), each compiled once: per-slot accept counts are traced
+data, so the compile count stays flat whatever the accept pattern.
+
+Both decode flavors emit per-token LOGPROBS (log-softmax of the raw
+target logits at the emitted token): the accept rule needs target
+probabilities anyway, and the handle's `logprobs` list is what lets the
+HTTP door return OpenAI `logprobs` and rank `best_of` by true cumulative
+logprob. `fork()` clones a request COW-style: the parent's full prompt
+pages are published into the radix tree as prefill completes them, so an
+n-way fan-out pays ONE prompt prefill and siblings diverge at their
+first private page.
 """
 
 from __future__ import annotations
@@ -75,12 +97,16 @@ from ..telemetry.watchdog import StallWatchdog, resolve_stall_timeout
 from .cache import (
     PagedAllocator,
     PagedKVCache,
+    SlotKVCache,
     paged_admit_slot,
     paged_append_batch,
     paged_append_rows,
+    paged_append_window,
     paged_batch_view,
     paged_slot_view,
     paged_write_slot,
+    slot_caches,
+    write_slot,
 )
 from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler, Slot, SlotState
@@ -137,6 +163,10 @@ def close_request_trace(req: Request, end: float) -> None:
         attrs["reason"] = req.reject_reason
     if req.shed_code is not None:
         attrs["shed_code"] = req.shed_code
+    if req.parent_id is not None:
+        # fork parentage rides the root span: a COW fan-out's siblings
+        # all name the request whose prompt pages they share
+        attrs["forked_from"] = req.parent_id
     record_span("serving.request", req.submitted_at, end,
                 trace=req.trace_id, parent=req.trace_parent,
                 span_id=req.span_id, **attrs)
@@ -198,6 +228,24 @@ class EngineConfig:
     # bytes too. Accuracy is gated in tests by a logit-error bound and
     # greedy-token agreement.
     kv_dtype: Any = None
+    # draft-model speculative decoding (ISSUE 12): a (family, config,
+    # params) triple for a SMALL family member sharing the target's
+    # vocabulary (the zoo's size-matched pairs — gpt2/gptj, llama
+    # variants — or a distilled/truncated sibling). When set, decode
+    # becomes draft-k-tokens + verify-in-one-batched-forward +
+    # accept/fallback: greedy requests accept on exact match (output
+    # byte-identical to the non-speculative engine), sampled requests
+    # run standard rejection sampling (the committed distribution is
+    # exactly the target's). None (default) keeps the classic one-token
+    # decode and the exact three-program contract. Not supported on a
+    # meshed engine or with paged_attention=True (the kernel is a
+    # single-token op; "auto" resolves to the dense verify path).
+    speculative: Any = None
+    # tokens the draft proposes per speculative step (>= 1). Accepted
+    # tokens per step range [1, draft_k]; raise it when the draft agrees
+    # often (accept rate stays high), lower it when disagreement makes
+    # late proposals worthless. docs/serving.md covers tuning.
+    draft_k: int = 4
     # multi-tenant scheduling: an iterable/dict of scheduler.TenantSpec
     # (priority tiers, DRR weights, TTFT SLOs). None = the single
     # "default" tenant, i.e. plain FIFO — the pre-tenancy behavior.
@@ -265,11 +313,12 @@ def _cache_spec(config) -> tuple[int, int, int]:
     return config.num_hidden_layers, kv, config.head_dim
 
 
-def _resolve_paged_attention(setting, mesh) -> bool:
+def _resolve_paged_attention(setting, mesh, speculative=None) -> bool:
     """EngineConfig.paged_attention -> use-the-kernel bool (see the
     config field's comment for the policy)."""
     if setting == "auto":
-        return mesh is None and jax.devices()[0].platform == "tpu"
+        return (mesh is None and speculative is None
+                and jax.devices()[0].platform == "tpu")
     use = bool(setting)
     if use and mesh is not None:
         raise ValueError(
@@ -279,6 +328,13 @@ def _resolve_paged_attention(setting, mesh) -> bool:
             "kernel. Meshed engines keep the dense-gather decode path "
             "('auto' resolves to False there); single-device pod decode "
             "workers (tensor_parallel=1) can use the kernel.")
+    if use and speculative is not None:
+        raise ValueError(
+            "paged_attention=True is not supported with speculative "
+            "decoding: the Pallas kernel folds exactly ONE new token's "
+            "K/V as its final online-softmax update, but the verify step "
+            "is a draft_k-token forward. Leave paged_attention='auto' "
+            "(the speculative verify uses the dense-gather path).")
     return use
 
 
@@ -335,8 +391,33 @@ class Engine:
         if ec.strict is not None and ec.strict not in ("warn", "error"):
             raise ValueError(
                 f"strict must be None, 'warn', or 'error'; got {ec.strict!r}")
+        self._spec = ec.speculative is not None
+        if self._spec:
+            if ec.mesh is not None:
+                raise ValueError(
+                    "speculative decoding is not supported on a meshed "
+                    "engine yet: the draft would need its own placement "
+                    "and the verify program its own pod contract — run "
+                    "speculation on single-device engines (or pod decode "
+                    "workers at tensor_parallel=1, speculative unset)")
+            if ec.draft_k < 1:
+                raise ValueError(f"draft_k must be >= 1, got {ec.draft_k}")
+            try:
+                dfam, dcfg, dparams = ec.speculative
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "speculative must be a (family, config, params) triple "
+                    "for the draft model")
+            if getattr(dcfg, "vocab_size", None) != config.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size ({getattr(dcfg, 'vocab_size', None)})"
+                    f" must match the target's ({config.vocab_size}): "
+                    "drafted tokens are verified by id")
+            self._draft_forward = dfam if callable(dfam) else dfam.forward
+            self._draft_config = dcfg
+            self._draft_params = dparams
         self._use_paged_kernel = _resolve_paged_attention(
-            ec.paged_attention, ec.mesh)
+            ec.paged_attention, ec.mesh, ec.speculative)
         self._contracts = ec.contracts
         if ec.strict is not None and self._contracts is None:
             if ec.mesh is not None:
@@ -348,18 +429,35 @@ class Engine:
                 from ..analysis.contracts import serving_program_contracts
 
                 self._contracts = serving_program_contracts(
-                    paged_kernel=self._use_paged_kernel)
+                    paged_kernel=self._use_paged_kernel,
+                    speculative=self._spec)
         # name -> None (audited clean/warned) | AnalysisViolation (cached:
         # re-raised on every later use without re-counting the findings)
         self._audited: dict = {}
 
         num_layers, num_kv, head_dim = _cache_spec(config)
+        # pad_slack covers BOTH overshoot sources: chunk padding can spill
+        # chunk-1 rows past max_len, and a speculative verify can write up
+        # to draft_k candidate rows past the last budgeted token (the slot
+        # retires mid-window; the extra rows land in reserved private
+        # pages and are never attended)
+        self._pad_slack = max(ec.prefill_chunk,
+                              ec.draft_k if self._spec else 0)
         self.cache = PagedKVCache.create(
             num_layers, ec.num_slots, ec.max_len, num_kv, head_dim,
             dtype=ec.cache_dtype, page_size=ec.page_size,
-            pad_slack=ec.prefill_chunk, num_pages=ec.num_pages,
+            pad_slack=self._pad_slack, num_pages=ec.num_pages,
             kv_dtype=ec.kv_dtype,
         )
+        if self._spec:
+            dl, dkv, dhd = _cache_spec(self._draft_config)
+            # the draft's own state is a DENSE slot cache (it is small,
+            # and its K/V is a different model's — cached target pages
+            # can never seed it, which is why prefix hits run draft-only
+            # catch-up chunks)
+            self._draft_cache = SlotKVCache.create(
+                dl, ec.num_slots, ec.max_len, dkv, dhd,
+                dtype=ec.cache_dtype, pad_slack=self._pad_slack)
         # SPMD serving: place the pool + per-slot state on the mesh and
         # remember the layout — _build_programs pins it as out_shardings
         # so every step's outputs land exactly where its inputs live (the
@@ -397,11 +495,17 @@ class Engine:
         self.allocator = PagedAllocator(
             page_size=ec.page_size,
             num_pages=self.cache.num_pages,
-            pad_slack=ec.prefill_chunk,
+            pad_slack=self._pad_slack,
             prefix_cache=ec.prefix_cache,
             on_evict=lambda n: self.metrics.note_page_evictions(n),
             on_unmap=self._unmap_slot,
         )
+        # COW forking: parent_id -> parent handle, consulted by the
+        # admission hold below (entries drop as parents reach a terminal
+        # state, so the map is bounded by live fan-outs)
+        self._fork_parents: dict[int, Request] = {}
+        if ec.prefix_cache:
+            self.allocator.hold_admission = self._hold_fork_child
         self.scheduler = Scheduler(ec.num_slots, ec.max_len,
                                    max_queue=ec.max_queue, clock=clock,
                                    allocator=self.allocator,
@@ -459,28 +563,51 @@ class Engine:
         if self._mesh_shardings is not None:
             cache_sh, rep = self._mesh_shardings
             admit_out = (cache_sh, rep, rep)
-            step_out = (cache_sh, rep)
+            step_out = (cache_sh, rep, rep)  # cache, tokens, logprobs
 
         def sample_slot(logits, key_raw, position, temp):
             """One slot's next token from [V] logits: traced temperature
             selects greedy vs sampled, the step key derives from the
             request key and the token's position (deterministic under any
-            prefill/decode interleave)."""
+            prefill/decode interleave). Also returns the token's logprob
+            under the UNSCALED model distribution (temperature-free, so
+            greedy and sampled scores are comparable — the best_of
+            ranking currency)."""
             key = jax.random.fold_in(jax.random.wrap_key_data(key_raw),
                                      position)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             scaled = logits / jnp.maximum(temp, 1e-6)
             sampled = sample_token(scaled[None, None, :], key, 1.0)[0]
-            return jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
+            tok = jnp.where(temp > 0.0, sampled.astype(jnp.int32), greedy)
+            lp = jax.nn.log_softmax(logits)[tok]
+            return tok, lp
 
-        @partial(jax.jit, donate_argnums=don_admit, out_shardings=admit_out)
-        def admit(cache, slot_keys, temps, slot, key_raw, temp, reused_len):
-            # a prefix hit starts the slot's length at the reused prefix
-            # (those pages already hold its K/V); a miss starts at zero
-            cache = paged_admit_slot(cache, slot, reused_len)
-            slot_keys = slot_keys.at[slot].set(key_raw)
-            temps = temps.at[slot].set(temp)
-            return cache, slot_keys, temps
+        if self._spec:
+            @partial(jax.jit, donate_argnums=don_admit + ((3,) if don_admit
+                                                          else ()),
+                     out_shardings=None)
+            def admit(cache, slot_keys, temps, dlengths, slot, key_raw,
+                      temp, reused_len):
+                # a prefix hit starts the TARGET slot's length at the
+                # reused prefix; the draft always starts cold (its K/V is
+                # a different model's — catch-up chunks rebuild it)
+                cache = paged_admit_slot(cache, slot, reused_len)
+                slot_keys = slot_keys.at[slot].set(key_raw)
+                temps = temps.at[slot].set(temp)
+                dlengths = dlengths.at[slot].set(0)
+                return cache, slot_keys, temps, dlengths
+        else:
+            @partial(jax.jit, donate_argnums=don_admit,
+                     out_shardings=admit_out)
+            def admit(cache, slot_keys, temps, slot, key_raw, temp,
+                      reused_len):
+                # a prefix hit starts the slot's length at the reused
+                # prefix (those pages already hold its K/V); a miss
+                # starts at zero
+                cache = paged_admit_slot(cache, slot, reused_len)
+                slot_keys = slot_keys.at[slot].set(key_raw)
+                temps = temps.at[slot].set(temp)
+                return cache, slot_keys, temps
 
         @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
         def prefill(params, cache, tokens, slot_keys, temps, slot,
@@ -496,11 +623,14 @@ class Engine:
             new_len = length + real_len
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), real_len - 1, keepdims=False)
-            tok = sample_slot(last, slot_keys[slot], new_len, temps[slot])
+            tok, lp = sample_slot(last, slot_keys[slot], new_len, temps[slot])
             tokens = tokens.at[slot].set(tok)
-            return cache, tokens
+            return cache, tokens, lp
 
-        if self._use_paged_kernel:
+        decode = None
+        if self._spec:
+            pass  # draft/verify replace the one-token decode below
+        elif self._use_paged_kernel:
             from ..ops.paged_attention import PagedDecodeMeta, PagedKV
 
             rows = self.cache.rows
@@ -520,12 +650,12 @@ class Engine:
                     positions=cache.lengths[:, None], kv_caches=kvc,
                 )
                 last = logits[:, 0].astype(jnp.float32)
-                next_tok = jax.vmap(sample_slot)(
+                next_tok, lps = jax.vmap(sample_slot)(
                     last, slot_keys, cache.lengths + 1, temps)
                 tokens = jnp.where(live, next_tok, tokens)
                 cache = paged_append_rows(cache, table, row_k[:, :, 0],
                                           row_v[:, :, 0], live)
-                return cache, tokens
+                return cache, tokens, lps
         else:
             @partial(jax.jit, donate_argnums=don, out_shardings=step_out)
             def decode(params, cache, tokens, slot_keys, temps, live, table):
@@ -547,22 +677,194 @@ class Engine:
                 last, nk, nv = jax.vmap(
                     single, in_axes=(0, 0, 1, 1), out_axes=(0, 1, 1)
                 )(tokens, cache.lengths, k_all, v_all)
-                next_tok = jax.vmap(sample_slot)(
+                next_tok, lps = jax.vmap(sample_slot)(
                     last, slot_keys, cache.lengths + 1, temps)
                 tokens = jnp.where(live, next_tok, tokens)
                 cache = paged_append_batch(cache, table, nk, nv, live)
-                return cache, tokens
+                return cache, tokens, lps
 
         self._admit_p, self._prefill_p, self._decode_p = admit, prefill, decode
+        if self._spec:
+            self._build_speculative_programs(sample_slot)
+
+    def _build_speculative_programs(self, sample_slot) -> None:
+        """The speculative replacement for the decode step, as fixed-shape
+        programs (ISSUE 12):
+
+        - `draft_prefill`: one chunk of the DRAFT model's prompt prefill
+          into its dense slot cache (the draft re-reads the whole prompt,
+          including any target-side reused prefix — cached pages hold the
+          TARGET's K/V, which can't seed a different model);
+        - `draft`: K sequential one-token steps of the draft, scanned
+          inside one program — proposals + the draft's full logits ride
+          out for the accept rule;
+        - `verify`: ONE batched K-token target forward over every slot's
+          paged view (exactly PR 10's short-sequence paged forward), the
+          accept rule, and the fixed-shape commit (accepted rows scatter
+          to their pages, rejected rows to trash — per-slot counts are
+          traced data, so accept patterns never change a shape).
+
+        Sampling keys: token at absolute position p in the NON-speculative
+        engine uses fold_in(request_key, p); the speculative step needs
+        three independent draws per position (draft proposal, accept
+        uniform, residual resample), derived as fold_in(fold_in(key, p),
+        tag) with distinct tags — still slot-decorrelated and
+        schedule-independent, and independent of each other, which is
+        what the rejection-sampling correctness argument requires."""
+        forward, config = self._forward, self.config
+        dforward, dcfg = self._draft_forward, self._draft_config
+        chunk = self.engine_config.prefill_chunk
+        K = self.engine_config.draft_k
+        S = self.engine_config.num_slots
+        don = (1, 2) if self.engine_config.donate else ()
+        don_d = (1,) if self.engine_config.donate else ()
+        DRAFT_TAG, ACCEPT_TAG, RESID_TAG = 1, 2, 3
+
+        @partial(jax.jit, donate_argnums=don_d)
+        def draft_prefill(dparams, dcache, slot, ids, real_len):
+            ks, vs, length = slot_caches(dcache, slot)
+            positions = (length + jnp.arange(chunk, dtype=jnp.int32))[None, :]
+            _, (nk, nv, _) = dforward(dcfg, dparams, ids[None, :],
+                                      positions=positions,
+                                      kv_caches=(ks, vs, length))
+            return write_slot(dcache, slot, nk, nv, real_len)
+
+        @partial(jax.jit, donate_argnums=don_d)
+        def draft(dparams, dcache, tokens, slot_keys, temps):
+            def single(tok, length, ks, vs):
+                logits, (nk, nv, _) = dforward(
+                    dcfg, dparams, tok[None, None],
+                    positions=length[None, None],
+                    kv_caches=(ks[:, None], vs[:, None], length))
+                return logits[0, 0].astype(jnp.float32), nk[:, 0], nv[:, 0]
+
+            def propose(lg, key_raw, pos, temp):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.wrap_key_data(key_raw),
+                                       pos), DRAFT_TAG)
+                greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                sampled = jax.random.categorical(
+                    key, lg / jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+                return jnp.where(temp > 0.0, sampled, greedy)
+
+            def body(carry, _):
+                tok, k_all, v_all, lengths = carry
+                lg, nk, nv = jax.vmap(single, in_axes=(0, 0, 1, 1),
+                                      out_axes=(0, 1, 1))(
+                    tok, lengths, k_all, v_all)
+                nxt = jax.vmap(propose)(lg, slot_keys, lengths + 1, temps)
+                return (nxt, nk, nv, lengths + 1), (nxt, lg)
+
+            (_, nk, nv, _), (d_toks, d_logits) = jax.lax.scan(
+                body, (tokens, dcache.k, dcache.v, dcache.lengths),
+                None, length=K)
+            # scan stacks on a leading step dim: -> [S, K] / [S, K, V]
+            return (d_toks.T, jnp.moveaxis(d_logits, 0, 1),
+                    dataclasses.replace(dcache, k=nk, v=nv,
+                                        lengths=dcache.lengths + K))
+
+        @partial(jax.jit, donate_argnums=don)
+        def verify(params, cache, tokens, slot_keys, temps, live, table,
+                   d_toks, d_logits):
+            # inputs per slot: [t0, d1..d_{K-1}] at positions L..L+K-1 —
+            # row j's logits is the target distribution for the token at
+            # position L+j+1, i.e. proposal d_{j+1}'s judge
+            ids = jnp.concatenate([tokens[:, None], d_toks[:, :K - 1]],
+                                  axis=1)
+            k_all, v_all = paged_batch_view(cache, table)
+
+            def single(ids_s, length, ks, vs):
+                positions = (length
+                             + jnp.arange(K, dtype=jnp.int32))[None, :]
+                logits, (nk, nv, _) = forward(
+                    config, params, ids_s[None, :], positions=positions,
+                    kv_caches=(ks[:, None], vs[:, None], length))
+                return logits[0].astype(jnp.float32), nk[:, 0], nv[:, 0]
+
+            t_logits, nk, nv = jax.vmap(single, in_axes=(0, 0, 1, 1),
+                                        out_axes=(0, 1, 1))(
+                ids, cache.lengths, k_all, v_all)
+
+            def accept_slot(tl, dl, dt, key_raw, base, temp):
+                # tl/dl [K, V] target/draft logits; dt [K] proposals
+                key = jax.random.wrap_key_data(key_raw)
+                pos = base + 1 + jnp.arange(K, dtype=jnp.int32)
+                greedy_ok = dt == jnp.argmax(tl, axis=-1).astype(jnp.int32)
+                p = jax.nn.softmax(tl / jnp.maximum(temp, 1e-6), axis=-1)
+                q = jax.nn.softmax(dl / jnp.maximum(temp, 1e-6), axis=-1)
+                p_tok = jnp.take_along_axis(p, dt[:, None], axis=1)[:, 0]
+                q_tok = jnp.take_along_axis(q, dt[:, None], axis=1)[:, 0]
+
+                def u_at(po):
+                    return jax.random.uniform(jax.random.fold_in(
+                        jax.random.fold_in(key, po), ACCEPT_TAG))
+
+                # accept d_i with prob min(1, p(d_i)/q(d_i)) — spelled
+                # u*q < p so q=0 (a proposal the draft couldn't have
+                # sampled) auto-rejects without a division
+                samp_ok = jax.vmap(u_at)(pos) * q_tok < p_tok
+                ok = jnp.where(temp > 0.0, samp_ok, greedy_ok)
+                prefix = jnp.cumprod(ok.astype(jnp.int32))
+                n_acc = prefix.sum()
+                c = jnp.where(n_acc == K, K, n_acc + 1)
+                # correction at the first rejected position: sample the
+                # residual max(p - q, 0)/Z — together with the accepts
+                # this reproduces the target distribution exactly
+                r = jnp.minimum(n_acc, K - 1)
+                resid = jnp.maximum(p[r] - q[r], 0.0)
+                resid = jnp.where(resid.sum() > 1e-9, resid, p[r])
+                rkey = jax.random.fold_in(
+                    jax.random.fold_in(key, base + 1 + r), RESID_TAG)
+                corr_sampled = jax.random.categorical(
+                    rkey, jnp.log(resid + 1e-30)).astype(jnp.int32)
+                corr_greedy = jnp.argmax(tl[r], axis=-1).astype(jnp.int32)
+                corr = jnp.where(temp > 0.0, corr_sampled, corr_greedy)
+                j = jnp.arange(K, dtype=jnp.int32)
+                committed = jnp.where(j < n_acc, dt, corr)
+                logp = jax.nn.log_softmax(tl, axis=-1)
+                lps = jnp.take_along_axis(logp, committed[:, None],
+                                          axis=1)[:, 0]
+                return (committed, c.astype(jnp.int32),
+                        n_acc.astype(jnp.int32), lps)
+
+            committed, counts, n_acc, lps = jax.vmap(accept_slot)(
+                t_logits, d_logits, d_toks, slot_keys, cache.lengths, temps)
+            counts = jnp.where(live, counts, 0)
+            n_acc = jnp.where(live, n_acc, 0)
+            new_tok = committed[jnp.arange(S), jnp.maximum(counts, 1) - 1]
+            tokens = jnp.where(live, new_tok, tokens)
+            # keep exactly the accepted inputs' K/V rows (t0..d_{c-1});
+            # rejected candidates' rows route to trash inside the
+            # fixed-shape window scatter
+            rows = cache.lengths[:, None] + jnp.arange(K, dtype=jnp.int32)
+            idx = rows[None, :, :, None, None]
+            win_k = jnp.take_along_axis(nk, idx, axis=2)
+            win_v = jnp.take_along_axis(nv, idx, axis=2)
+            cache = paged_append_window(cache, table, win_k, win_v,
+                                        counts, live)
+            return cache, tokens, committed, counts, n_acc, lps
+
+        self._draft_prefill_p = draft_prefill
+        self._draft_p = draft
+        self._verify_p = verify
 
     def compile_stats(self) -> dict[str, int]:
         """Compiled-program counts per engine program — the recompile
-        guard: these must stay flat however the request mix changes."""
-        return {
+        guard: these must stay flat however the request mix changes.
+        Speculative engines report their five programs (the one-token
+        decode is never built); classic engines keep the exact
+        admit/prefill/decode triple."""
+        out = {
             "admit": self._admit_p._cache_size(),
             "prefill": self._prefill_p._cache_size(),
-            "decode": self._decode_p._cache_size(),
         }
+        if self._spec:
+            out["draft_prefill"] = self._draft_prefill_p._cache_size()
+            out["draft"] = self._draft_p._cache_size()
+            out["verify"] = self._verify_p._cache_size()
+        else:
+            out["decode"] = self._decode_p._cache_size()
+        return out
 
     # -- request API ---------------------------------------------------------
 
@@ -579,6 +881,7 @@ class Engine:
         trace_id=None,
         trace_parent=0,
         trace_sampled: bool | None = None,
+        parent_id: int | None = None,
     ) -> Request:
         """Queue one generation request; returns its handle immediately.
         Overload is reported on the handle (`status` REJECTED with
@@ -605,7 +908,7 @@ class Engine:
             prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), key=key,
             eos_token_id=eos_token_id, deadline_s=deadline_s,
-            tenant=tenant, slo_ttft_s=slo_ttft_s,
+            tenant=tenant, slo_ttft_s=slo_ttft_s, parent_id=parent_id,
         )
         prepare_request_tracing(req, trace_id, trace_parent, trace_sampled)
         # drain first, THEN capacity-check: a slot freed since the last
@@ -625,6 +928,65 @@ class Engine:
             # TTFT doesn't wait for the next step() call
             self._admit_pending()
         return req
+
+    def fork(
+        self,
+        parent: Request,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        key=None,
+        eos_token_id: Any = "inherit",
+        deadline_s: float | None = None,
+        slo_ttft_s: float | None = None,
+        trace_id=None,
+        trace_parent=0,
+        trace_sampled: bool | None = None,
+    ) -> Request:
+        """COW-fork `parent`: a new request on the same prompt that
+        SHARES the parent's prompt pages instead of re-prefilling them.
+
+        Mechanism: the parent is marked `share_prompt`, which publishes
+        its full prompt pages into the radix tree the moment prefill
+        completes them (`PagedAllocator.publish_prompt` — mid-flight,
+        not at retirement), plus immediately here for whatever is
+        already prefilled. The fork is then an ordinary submission whose
+        admission maps the published pages copy-on-write and diverges at
+        its first private page — an n-way `n`/`best_of` fan-out pays ONE
+        prompt prefill (each sibling still prefills the final partial
+        page: the last prompt token must produce its own first-token
+        logits). Works at any parent phase: queued (pages publish as
+        they prefill), running, or finished (pages are in the tree
+        already); a cancelled parent's published pages survive in the
+        tree, so forks keep their sharing — the COW refcounts isolate
+        every sibling. Unset generation knobs inherit the parent's;
+        `key` should differ per fork or siblings sample identical
+        streams (None derives a distinct key from the fork's request
+        id). With `prefix_cache=False` the fork still runs, it just
+        re-prefills — sharing needs the radix tree."""
+        parent.share_prompt = True
+        if not parent.done:
+            self._fork_parents[parent.request_id] = parent
+        for slot in self.scheduler.slots:
+            if slot.request is parent:
+                self.allocator.publish_prompt(slot)
+                break
+        return self.submit(
+            parent.prompt,
+            max_new_tokens=(parent.max_new_tokens if max_new_tokens is None
+                            else max_new_tokens),
+            temperature=(parent.temperature if temperature is None
+                         else temperature),
+            key=key,
+            eos_token_id=(parent.eos_token_id if eos_token_id == "inherit"
+                          else eos_token_id),
+            deadline_s=deadline_s,
+            tenant=parent.tenant,
+            slo_ttft_s=slo_ttft_s,
+            trace_id=trace_id,
+            trace_parent=trace_parent,
+            trace_sampled=trace_sampled,
+            parent_id=parent.request_id,
+        )
 
     def cancel(self, request: Request) -> bool:
         if self.scheduler.cancel(request):
@@ -820,11 +1182,17 @@ class Engine:
         length is unknown statically; max_len/2 is the documented
         approximation."""
         cfg, ec = self.config, self.engine_config
-        if self._n_params is None:
-            from ..models.common import count_params
+        from ..models.common import count_params
 
-            self._n_params = count_params(self.params)
-        n = self._n_params
+        if name in ("draft", "draft_prefill"):
+            cfg = self._draft_config
+            if getattr(self, "_n_draft_params", None) is None:
+                self._n_draft_params = count_params(self._draft_params)
+            n = self._n_draft_params
+        else:
+            if self._n_params is None:
+                self._n_params = count_params(self.params)
+            n = self._n_params
         num_layers, num_kv, head_dim = _cache_spec(cfg)
         hidden = getattr(cfg, "hidden_size", 0) or (
             getattr(cfg, "num_attention_heads", 1) * head_dim)
@@ -836,7 +1204,22 @@ class Engine:
             flops = causal_lm_infer_flops(n, tokens, num_layers, hidden,
                                           kv_len=avg_ctx)
             nbytes = n * elt + tokens * num_layers * avg_ctx * kv_row
-        elif name == "prefill":
+        elif name == "verify":
+            # one K-token forward per slot — the batched verify is
+            # decode with draft_k tokens per lane
+            tokens = ec.num_slots * ec.draft_k
+            flops = causal_lm_infer_flops(n, tokens, num_layers, hidden,
+                                          kv_len=avg_ctx)
+            nbytes = (n * elt
+                      + ec.num_slots * num_layers * avg_ctx * kv_row)
+        elif name == "draft":
+            # K sequential one-token draft steps over every slot
+            tokens = ec.num_slots * ec.draft_k
+            flops = causal_lm_infer_flops(n, tokens, num_layers, hidden,
+                                          kv_len=avg_ctx)
+            nbytes = ec.draft_k * n * elt \
+                + tokens * num_layers * avg_ctx * kv_row
+        elif name in ("prefill", "draft_prefill"):
             tokens = ec.prefill_chunk
             flops = causal_lm_infer_flops(n, tokens, num_layers, hidden,
                                           kv_len=avg_ctx)
@@ -845,6 +1228,29 @@ class Engine:
         else:  # admit: per-slot bookkeeping only, no model math
             flops, nbytes = 0.0, float(ec.num_slots * 16)
         return float(flops), float(nbytes)
+
+    def _hold_fork_child(self, req: Request) -> bool:
+        """Admission hold for COW forks: a fork child stays QUEUED until
+        its parent's full prompt pages are published (or the parent is
+        terminal — then whatever made it into the tree is all there will
+        be). Admitting earlier would cold-prefill the shared prompt and
+        forfeit the single-prefill property the fork exists for. Progress
+        is guaranteed: a live parent's prefill advances every engine
+        step, and a shed/cancelled parent releases the hold immediately."""
+        if req.parent_id is None:
+            return False
+        parent = self._fork_parents.get(req.parent_id)
+        if parent is None or parent.done:
+            return False
+        want = (req.prompt_len - 1) // self.engine_config.page_size
+        if want <= 0:
+            return False  # nothing shareable: sub-page prompts admit cold
+        for slot in self.scheduler.slots:
+            if slot.request is parent:
+                have = min(slot.prompt_done, parent.prompt_len) \
+                    // self.engine_config.page_size
+                return have < want
+        return True  # parent still queued: its prefill hasn't started
 
     def _unmap_slot(self, index: int) -> None:
         """Allocator callback at release: reset the slot's page table to
@@ -875,23 +1281,69 @@ class Engine:
             record_span("serving.queue_wait", req.submitted_at,
                         req.admitted_at, trace=req.trace_id,
                         parent=req.span_id, tenant=req.tenant)
-        args = (self.cache, self._slot_keys, self._temps,
-                jnp.int32(slot.index), key_raw, jnp.float32(req.temperature),
-                jnp.int32(alloc.reused_len))
+        tail = (jnp.int32(slot.index), key_raw,
+                jnp.float32(req.temperature), jnp.int32(alloc.reused_len))
+        if self._spec:
+            slot.draft_done = 0
+            args = (self.cache, self._slot_keys, self._temps,
+                    self._draft_cache.lengths) + tail
+        else:
+            args = (self.cache, self._slot_keys, self._temps) + tail
         self._strict_audit("admit", self._admit_p, args)
         self._ensure_cost("admit", self._admit_p, args)
         with self.cost.maybe_sample("admit", fence_in=self.cache) as sample:
             with self._request_span("serving.admit", req, slot=slot.index,
                                     reused_len=alloc.reused_len):
-                self.cache, self._slot_keys, self._temps = \
-                    self._admit_p(*args)
+                if self._spec:
+                    (self.cache, self._slot_keys, self._temps,
+                     dlengths) = self._admit_p(*args)
+                    self._draft_cache = dataclasses.replace(
+                        self._draft_cache, lengths=dlengths)
+                else:
+                    self.cache, self._slot_keys, self._temps = \
+                        self._admit_p(*args)
             sample(self.cache)
         if self.on_admit is not None:
             self.on_admit(slot, req)
 
+    def _run_draft_chunk(self, slot: Slot, upto: int) -> None:
+        """One draft-model prefill chunk over [draft_done, upto). Capped
+        at `upto` (the target's prompt_done) so a catch-up over a reused
+        prefix lands EXACTLY where the target sits and the two then
+        advance over identical windows."""
+        chunk = self.engine_config.prefill_chunk
+        req = slot.request
+        start = slot.draft_done
+        real = min(chunk, upto - start)
+        ids = np.zeros((chunk,), np.int32)
+        ids[:real] = req.prompt[start:start + real]
+        args = (self._draft_params, self._draft_cache,
+                jnp.int32(slot.index), ids, jnp.int32(real))
+        self._strict_audit("draft_prefill", self._draft_prefill_p, args)
+        self._ensure_cost("draft_prefill", self._draft_prefill_p, args)
+        with self.cost.maybe_sample(
+                "draft_prefill", fence_in=self._draft_cache) as sample:
+            with self._request_span("serving.draft_prefill", req,
+                                    slot=slot.index, chunk_start=start,
+                                    chunk_tokens=real), \
+                    self.timer.dispatch():
+                self._draft_cache = self._draft_prefill_p(*args)
+            sample(self._draft_cache)
+        slot.draft_done += real
+
     def _run_prefill_chunk(self, slot: Slot) -> None:
         chunk = self.engine_config.prefill_chunk
         req = slot.request
+        if self._spec and slot.draft_done < slot.prompt_done:
+            # the draft has no cached prefix to reuse: draft-only
+            # catch-up chunks rebuild its prompt state up to the
+            # target's reused length before the joint chunks begin.
+            # NOT counted in prefill_chunks: that counter prices TARGET
+            # prefill work (goodput multiplies it by the target prefill
+            # program's device time, and the prefix-reuse A/B compares
+            # it) — a draft-sized catch-up chunk is neither
+            self._run_draft_chunk(slot, slot.prompt_done)
+            return
         start = slot.prompt_done  # includes the reused prefix on a hit
         real = min(chunk, req.prompt_len - start)
         ids = np.zeros((chunk,), np.int32)
@@ -906,19 +1358,32 @@ class Engine:
             with self._request_span("serving.prefill", req, slot=slot.index,
                                     chunk_start=start, chunk_tokens=real), \
                     self.timer.dispatch():
-                self.cache, self._tokens = self._prefill_p(*args)
+                self.cache, self._tokens, lp = self._prefill_p(*args)
             sample(self.cache)
         self.metrics.note_prefill_chunk()
-        if self.scheduler.note_prefill_chunk(slot, real):
+        if self._spec:
+            # joint chunk: the draft processes the same window, so both
+            # prompts complete on the same engine step
+            self._run_draft_chunk(slot, start + real)
+        done = self.scheduler.note_prefill_chunk(slot, real)
+        if req.share_prompt:
+            # fork parent: every full prompt page this chunk completed
+            # becomes shareable NOW — forks queued behind us map it at
+            # admission instead of re-prefilling
+            self.allocator.publish_prompt(slot)
+        if done:
             # the chunk that completed the prompt also produced the
             # request's first token — fetch it (TTFT is measured here).
             # Index on device first: only ONE element crosses to the host,
             # not the whole [S] token vector (self-lint ATP003 class).
             tok = int(self._tokens[slot.index])
-            if self.scheduler.note_token(slot, tok):
+            if self.scheduler.note_token(slot, tok, logprob=float(lp)):
                 self._finalize_request(req)
 
     def _run_decode(self, slots: list[Slot]) -> None:
+        if self._spec:
+            self._run_spec_decode(slots)
+            return
         live = np.zeros((self.engine_config.num_slots,), bool)
         for s in slots:
             live[s.index] = True
@@ -935,16 +1400,96 @@ class Engine:
                 "decode", fence_in=(self.cache, self._tokens)) as sample:
             with span("serving.decode", links=links or None), \
                     self.timer.dispatch():
-                self.cache, self._tokens = self._decode_p(*args)
+                self.cache, self._tokens, lps = self._decode_p(*args)
             sample(self.cache)
         toks = np.asarray(self._tokens)  # the per-step host read
+        lps = np.asarray(lps)
         self.timer.tick(block_on=None)
         self.metrics.note_decode_step(
             "kernel" if self._use_paged_kernel else "dense")
         for s in slots:
             req = s.request
-            if self.scheduler.note_token(s, int(toks[s.index])):
+            if self.scheduler.note_token(s, int(toks[s.index]),
+                                         logprob=float(lps[s.index])):
                 self._finalize_request(req)
+
+    def _run_spec_decode(self, slots: list[Slot]) -> None:
+        """One speculative step for every decoding slot: draft K
+        proposals per slot, verify them in ONE batched K-token target
+        forward, commit the accepted prefix (plus the correction token)
+        — between 1 and K tokens land per slot per step. The draft's
+        cache adopts the verified lengths afterwards: by construction
+        its valid rows are exactly the target's (inputs t0..d_{c-1}), so
+        the two models stay position-synchronized without a catch-up."""
+        K = self.engine_config.draft_k
+        live = np.zeros((self.engine_config.num_slots,), bool)
+        for s in slots:
+            live[s.index] = True
+        links = [s.request.trace_id for s in slots
+                 if s.request is not None and s.request.trace_sampled]
+        dargs = (self._draft_params, self._draft_cache, self._tokens,
+                 self._slot_keys, self._temps)
+        self._strict_audit("draft", self._draft_p, dargs)
+        self._ensure_cost("draft", self._draft_p, dargs)
+        with self.cost.maybe_sample(
+                "draft", fence_in=self._draft_cache) as sample:
+            with span("serving.draft", links=links or None), \
+                    self.timer.dispatch():
+                d_toks, d_logits, new_dcache = self._draft_p(*dargs)
+            sample(new_dcache)
+        vargs = (self.params, self.cache, self._tokens, self._slot_keys,
+                 self._temps, live, self._table, d_toks, d_logits)
+        self._strict_audit("verify", self._verify_p, vargs)
+        self._ensure_cost("verify", self._verify_p, vargs)
+        with self.cost.maybe_sample(
+                "verify", fence_in=(self.cache, self._tokens)) as sample:
+            with span("serving.verify", links=links or None), \
+                    self.timer.dispatch():
+                (self.cache, self._tokens, committed, counts, n_acc,
+                 lps) = self._verify_p(*vargs)
+            sample(self.cache)
+        # the draft cache's valid rows now equal the target's: adopt the
+        # committed lengths (rejected proposals' draft rows fall past the
+        # length, masked exactly like the target's rejected rows) — but
+        # for LIVE lanes only. A non-live slot holding a request is
+        # mid-PREFILL, where the draft lags the target (prefix hits start
+        # the target at the reused length while the draft rebuilds from
+        # zero): adopting the target's length there would shift every
+        # later catch-up write onto wrong rows/positions and silently
+        # corrupt that request's draft state. Its true progress is the
+        # host-tracked draft_done; idle lanes reset at admit, so 0 is
+        # fine. The draft program advanced every lane by K regardless —
+        # dead lanes' stray rows sit at/past the restored length and are
+        # masked or overwritten. jnp.where yields a FRESH buffer, so the
+        # pool's lengths never alias into the draft cache (the next
+        # donating dispatch must not see one buffer through two args).
+        restore = np.zeros((self.engine_config.num_slots,), np.int32)
+        for s in self.scheduler.slots:
+            if s.request is not None and not live[s.index]:
+                restore[s.index] = s.draft_done
+        self._draft_cache = dataclasses.replace(
+            new_dcache, lengths=jnp.where(jnp.asarray(live),
+                                          self.cache.lengths,
+                                          jnp.asarray(restore)))
+        toks = np.asarray(committed)   # [S, K] — the per-step host read
+        cnts = np.asarray(counts)
+        accs = np.asarray(n_acc)
+        lps = np.asarray(lps)
+        self.timer.tick(block_on=None)
+        self.metrics.note_decode_step("speculative")
+        for s in slots:
+            self.metrics.note_speculation(K, int(accs[s.index]))
+            req = s.request
+            for j in range(int(cnts[s.index])):
+                if self.scheduler.note_token(
+                        s, int(toks[s.index, j]),
+                        logprob=float(lps[s.index, j])):
+                    # retired mid-window (budget or EOS): the remaining
+                    # committed tokens are discarded — their rows sit
+                    # past the slot's final length in reserved private
+                    # pages and are never attended
+                    self._finalize_request(req)
+                    break
 
     # -- request tracing -----------------------------------------------------
 
@@ -970,6 +1515,9 @@ class Engine:
     def _finalize_request(self, req: Request) -> None:
         """The one terminal path: close the request's trace, then fold it
         into the metrics (TTFT/per-token exemplars carry the trace id)."""
+        # a terminal fork parent releases any held children (the hold
+        # predicate also checks req.done — this just bounds the map)
+        self._fork_parents.pop(req.request_id, None)
         self._trace_terminal(req)
         self.metrics.observe_request(req)
 
@@ -993,6 +1541,10 @@ class Engine:
             info["slo_ttft_s"] = req.slo_ttft_s
         if req.deadline_s is not None:
             info["deadline_s"] = req.deadline_s
+        if req.parent_id is not None:
+            info["forked_from"] = req.parent_id
+        if req.share_prompt:
+            info["fork_parent"] = True
         return info
 
     def debug_requests(self) -> dict:
@@ -1093,11 +1645,15 @@ class Engine:
             return None
         wall = m.stopped_at - m.started_at
         useful = 0.0
-        dec = self.cost.mean_device_time("decode")
+        # the decode-role program: the speculative engine's verify step
+        # IS its decode (token lanes = slots x draft_k per step)
+        dec = self.cost.mean_device_time(
+            "verify" if self._spec else "decode")
         steps = m.decode_steps
+        lanes = self.engine_config.num_slots * (
+            self.engine_config.draft_k if self._spec else 1)
         if dec is not None and steps:
-            useful += dec * steps * min(
-                1.0, m.tokens_out / (steps * self.engine_config.num_slots))
+            useful += dec * steps * min(1.0, m.tokens_out / (steps * lanes))
         pre = self.cost.mean_device_time("prefill")
         if pre is not None and m.prefill_chunks and m.prefix_lookups:
             useful += pre * m.prefill_chunks * min(
@@ -1145,15 +1701,21 @@ class Engine:
             out["host_dispatch_us_mean"] = self.timer.host_dispatch_us
         # roofline attribution (ISSUE 11): measured device time per
         # program + the derived MFU / HBM-bandwidth / MXU-idle numbers
-        # for decode — what the chip was DOING, not just how long
-        for prog in ("decode", "prefill"):
+        # for decode — what the chip was DOING, not just how long. On a
+        # speculative engine the decode-role program is VERIFY (the
+        # batched K-token target forward), so the decode_* keys read it
+        # — decode_mxu_idle_fraction stays the before/after A-vs-B
+        # number ISSUE 12's acceptance quotes.
+        decode_prog = "verify" if self._spec else "decode"
+        for prog in (decode_prog, "prefill"):
             sheet = self.cost.roofline(prog) or {}
+            name = "decode" if prog == decode_prog else prog
             if "device_time_mean_s" in sheet:
-                out[f"{prog}_device_time_mean_ms"] = (
+                out[f"{name}_device_time_mean_ms"] = (
                     sheet["device_time_mean_s"] * 1e3)
-                out[f"{prog}_device_time_p99_ms"] = (
+                out[f"{name}_device_time_p99_ms"] = (
                     sheet["device_time_p99_s"] * 1e3)
-            if prog == "decode":
+            if prog == decode_prog:
                 for src, dst in (("mfu", "decode_mfu"),
                                  ("mxu_idle_fraction",
                                   "decode_mxu_idle_fraction"),

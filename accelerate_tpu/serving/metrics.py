@@ -58,6 +58,15 @@ class ServingMetrics:
         self._c_prefix_tokens = r.counter("serving_prefix_tokens_reused_total")
         self._c_prompt_tokens = r.counter("serving_prompt_tokens_total")
         self._c_evictions = r.counter("serving_page_evictions_total")
+        # speculative decoding (ISSUE 12): drafted vs accepted proposal
+        # totals per slot-step; the accept-rate gauge is their running
+        # ratio and tokens-per-decode-step is the headline lever (how
+        # many tokens one MXU-occupying step now commits)
+        self._c_spec_drafted = r.counter("serving_spec_drafted_tokens_total")
+        self._c_spec_accepted = r.counter(
+            "serving_spec_accepted_tokens_total")
+        self._g_spec_accept_rate = r.gauge("serving_spec_accept_rate")
+        self._g_tokens_per_step = r.gauge("serving_tokens_per_decode_step")
         self._g_queue_depth = r.gauge("serving_queue_depth_current")
         self._g_occupancy = r.gauge("serving_slot_occupancy_current")
         self._g_tokens_per_sec = r.gauge("serving_tokens_per_sec")
@@ -149,6 +158,23 @@ class ServingMetrics:
                 "serving_decode_path_total", path=path)
         ctr.inc()
 
+    @property
+    def spec_drafted_tokens(self) -> int:
+        return int(self._c_spec_drafted.value)
+
+    @property
+    def spec_accepted_tokens(self) -> int:
+        return int(self._c_spec_accepted.value)
+
+    def note_speculation(self, drafted: int, accepted: int) -> None:
+        """One slot's speculative-step outcome: `drafted` proposals
+        (always draft_k), `accepted` of them survived verification."""
+        self._c_spec_drafted.inc(drafted)
+        self._c_spec_accepted.inc(accepted)
+        total = self.spec_drafted_tokens
+        if total:
+            self._g_spec_accept_rate.set(self.spec_accepted_tokens / total)
+
     def note_prefill_chunk(self) -> None:
         self._c_prefill.inc()
 
@@ -180,6 +206,8 @@ class ServingMetrics:
         self.queue_depth.record(queue_depth)
         self._g_occupancy.set(occ)
         self._g_queue_depth.set(queue_depth)
+        if self.decode_steps:
+            self._g_tokens_per_step.set(self.tokens_out / self.decode_steps)
         if (self.started_at is not None and self.stopped_at is not None
                 and self.stopped_at > self.started_at):
             self._g_tokens_per_sec.set(
@@ -252,6 +280,14 @@ class ServingMetrics:
             "pages_free": float(self._g_pages_free.value),
             "kv_bytes_in_use": float(self._g_kv_bytes.value),
         }
+        if self.decode_steps:
+            out["tokens_per_decode_step"] = (
+                self.tokens_out / self.decode_steps)
+        if self.spec_drafted_tokens:
+            out["spec_drafted_tokens"] = float(self.spec_drafted_tokens)
+            out["spec_accepted_tokens"] = float(self.spec_accepted_tokens)
+            out["spec_accept_rate"] = (
+                self.spec_accepted_tokens / self.spec_drafted_tokens)
         if self.prefix_lookups:
             out["prefix_hit_rate"] = self.prefix_hits / self.prefix_lookups
         if self.prompt_tokens:

@@ -165,10 +165,14 @@ class PodRouter:
             params = shard_params(params, mesh)
         # workers own no observability side-cars: the pod facade is the
         # one exporter/watchdog surface (close() below stops the threads
-        # the Engine constructor may have started from env config)
+        # the Engine constructor may have started from env config).
+        # speculative is stripped: a spec worker's five-program surface
+        # doesn't match the pod's extract/install protocol (the install
+        # path drives the classic admit program directly) — pod +
+        # speculation is a future arc, not a silent half-configuration
         worker_ec = dataclasses.replace(
             ec, mesh=mesh, tenants=None, metrics_port=None,
-            watchdog_timeout_s=None, incident_dir=None)
+            watchdog_timeout_s=None, incident_dir=None, speculative=None)
         prefill_ec = dataclasses.replace(
             worker_ec, num_slots=pc.prefill_slots or ec.num_slots)
 
@@ -507,6 +511,8 @@ class PodRouter:
             first = int(internal.tokens[0])
             flight.pages = self._admit_pages.pop(id(internal), None)
             user.tokens.append(first)
+            if internal.logprobs:
+                user.logprobs.append(internal.logprobs[0])
             user.token_times.append(now)
             user.first_token_at = now
             done = (user.max_new_tokens <= 1
@@ -588,8 +594,11 @@ class PodRouter:
                 shipment, slot.index, alloc)
             # seed the first token into the worker's books so EOS/budget
             # accounting continues exactly where the prefill worker left
-            # off (the user already holds this token — don't re-mirror)
-            engine.scheduler.note_token(slot, shipment.first_token, now=now)
+            # off (the user already holds this token — don't re-mirror);
+            # its logprob rides the shipment so the internal's logprobs
+            # list stays index-aligned with its tokens
+            engine.scheduler.note_token(slot, shipment.first_token, now=now,
+                                        logprob=shipment.first_logprob)
             engine.metrics.note_admission(internal.prompt_len,
                                           alloc.reused_len)
             flight.phase = "decode"
@@ -613,6 +622,8 @@ class PodRouter:
         internal, user = flight.internal, flight.user
         while flight.copied < len(internal.tokens):
             user.tokens.append(internal.tokens[flight.copied])
+            if flight.copied < len(internal.logprobs):
+                user.logprobs.append(internal.logprobs[flight.copied])
             user.token_times.append(internal.token_times[flight.copied])
             flight.copied += 1
 

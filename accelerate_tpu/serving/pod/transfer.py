@@ -74,6 +74,10 @@ class KVPageShipment:
     eos_token_id: int | None
     src_worker: int = -1
     extracted_at: float = 0.0    # router clock; the page_transfer span start
+    # the first token's model logprob (models emit per-token logprobs —
+    # ISSUE 12), so the decode-side internal's logprob list stays aligned
+    # with its tokens; None only for shipments from pre-logprob senders
+    first_logprob: float | None = None
     # int8 pools ship their codes as-is plus the per-row-per-head scale
     # blocks ([L, pages_per_slot, page_size, H]) — the wire carries half
     # the bytes of a bf16 shipment; None on bf16 pools
@@ -190,6 +194,8 @@ class PageTransport:
         return KVPageShipment(
             prompt=request.prompt,
             first_token=int(request.tokens[0]),
+            first_logprob=(float(request.logprobs[0])
+                           if request.logprobs else None),
             n_prompt_pages=n_prompt,
             k_pages=np.asarray(k_pages),
             v_pages=np.asarray(v_pages),

@@ -128,7 +128,18 @@ class Request:
     trace_parent: Any = 0
     trace_sampled: bool = False
     span_id: int = 0
+    # COW forking (Engine.fork): parent_id names the request this one was
+    # forked from (None = not a fork); share_prompt marks a fork PARENT —
+    # its full prompt pages are published into the prefix tree as prefill
+    # completes them, so forks map the pages instead of re-prefilling
+    parent_id: int | None = None
+    share_prompt: bool = False
     tokens: list[int] = field(default_factory=list)
+    # per-token logprob of each emitted token under the UNSCALED target
+    # model (log-softmax of the raw logits at the token) — temperature-
+    # independent, so greedy and sampled requests are comparable and
+    # best_of can rank by true cumulative logprob
+    logprobs: list[float] = field(default_factory=list)
     submitted_at: float = 0.0
     admitted_at: float | None = None
     first_token_at: float | None = None
@@ -151,6 +162,14 @@ class Request:
         return self.first_token_at - self.submitted_at
 
     @property
+    def cumulative_logprob(self) -> float | None:
+        """Sum of the emitted tokens' model logprobs (None before any
+        token carries one) — the best_of ranking score."""
+        if not self.logprobs:
+            return None
+        return float(sum(self.logprobs))
+
+    @property
     def slo_met(self) -> bool | None:
         """True/False once an SLO verdict exists; None when no SLO applies
         (or the request is still in flight before its first token)."""
@@ -168,12 +187,18 @@ class Slot:
     request: Request | None = None
     prompt_done: int = 0   # prompt tokens prefilled so far (incl. reused)
     alloc: Any = None      # PageAllocation when a paged allocator is wired
+    # speculative decoding: prompt tokens the DRAFT model has prefilled.
+    # The draft never reuses cached pages (its K/V is a different model's),
+    # so on a prefix hit it starts at 0 while prompt_done starts at the
+    # reused length — the engine runs draft-only catch-up chunks first.
+    draft_done: int = 0
 
     def free(self) -> None:
         self.state = SlotState.IDLE
         self.request = None
         self.prompt_done = 0
         self.alloc = None
+        self.draft_done = 0
 
 
 class Scheduler:
@@ -634,12 +659,16 @@ class Scheduler:
         return False
 
     def note_token(self, slot: Slot, token: int,
-                   now: float | None = None) -> bool:
-        """Record one generated token; retire the slot when the request
-        hits max_new_tokens or its EOS. Returns True on retirement."""
+                   now: float | None = None,
+                   logprob: float | None = None) -> bool:
+        """Record one generated token (and, when the engine computed it,
+        the token's model logprob); retire the slot when the request hits
+        max_new_tokens or its EOS. Returns True on retirement."""
         now = self.clock() if now is None else now
         req = slot.request
         req.tokens.append(int(token))
+        if logprob is not None:
+            req.logprobs.append(float(logprob))
         req.token_times.append(now)
         if req.first_token_at is None:
             req.first_token_at = now

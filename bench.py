@@ -69,6 +69,7 @@ _PHASE_METRICS = {
     "serving_prefix": ("serving_prefix_reuse", "summary"),
     "server": ("server_http_load", "summary"),
     "pod": ("serving_pod_offered_load", "summary"),
+    "serving_spec": ("serving_speculative_ab", "summary"),
 }
 
 
@@ -346,6 +347,55 @@ def _server_row(num_requests: int = 12) -> dict:
     return row
 
 
+def _serving_spec_row(num_requests: int = 10, draft_k: int = 4) -> dict:
+    """Speculative-decoding A/B smoke (ISSUE 12): the SAME seeded
+    offered-load trace through the engine with speculation off
+    (baseline) and on (self-draft, accept rate ~1.0) — the row quotes
+    tokens-per-decode-step, the accept rate, and the before/after
+    `decode_mxu_idle_fraction` (PR 11's measured number this feature
+    exists to lower), plus a greedy byte-exactness verdict between the
+    two arms (committed tokens must be identical under greedy)."""
+    sb = _load_serve_bench()
+    keep = ("tokens_per_sec", "tokens_per_decode_step", "decode_steps",
+            "spec_accept_rate", "spec_drafted_tokens",
+            "spec_accepted_tokens", "decode_mxu_idle_fraction",
+            "decode_mfu", "decode_device_time_mean_ms", "ttft_p50_ms",
+            "requests_finished")
+    row: dict = {"draft_k": draft_k}
+    tokens = {}
+    for arm, spec in (("baseline", False), ("speculative", True)):
+        engine, cfg = sb.build_tiny_engine(
+            "llama", num_slots=4, max_len=128, prefill_chunk=16,
+            speculative=spec, draft_k=draft_k)
+        # lower the fence-sampling cadence so the short smoke actually
+        # measures device time (default 16 samples ~2 windows here)
+        engine.cost.sample_every = 4
+        s = sb.run_offered_load(engine, cfg.vocab_size,
+                                num_requests=num_requests, rate_hz=200.0,
+                                prompt_len=(4, 16), max_new_tokens=(6, 12))
+        row[arm] = {k: round(float(s[k]), 4) for k in keep if k in s}
+        tokens[arm] = [
+            list(r) for r in _collect_greedy_tokens(sb, spec, draft_k)]
+    row["greedy_byte_identical"] = tokens["baseline"] == tokens["speculative"]
+    return row
+
+
+def _collect_greedy_tokens(sb, speculative: bool, draft_k: int):
+    """A tiny fixed greedy trace through a fresh engine — the byte-
+    exactness probe backing the A/B row's verdict field."""
+    import numpy as np
+
+    engine, cfg = sb.build_tiny_engine(
+        "llama", num_slots=2, max_len=96, prefill_chunk=16,
+        speculative=speculative, draft_k=draft_k)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in (5, 12, 9)]
+    reqs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+    engine.run_until_idle()
+    return [r.tokens for r in reqs]
+
+
 def _pod_row(num_requests: int = 10) -> dict:
     """Disaggregated-pod offered-load smoke (ISSUE 9): one prefill + one
     decode worker with KV pages shipping between them, behind the same
@@ -381,7 +431,8 @@ def _child_main() -> None:
         from accelerate_tpu.utils.environment import force_cpu_platform
 
         force_cpu_platform()
-    if phase in ("serving", "serving_prefix", "server", "pod"):
+    if phase in ("serving", "serving_prefix", "server", "pod",
+                 "serving_spec"):
         if not on_cpu:
             # spawned on the TPU-success path: if the tunnel dropped
             # after the train child, jax would silently fall back to CPU
@@ -396,7 +447,8 @@ def _child_main() -> None:
         row = {"serving": _serving_row,
                "serving_prefix": _serving_prefix_row,
                "server": _server_row,
-               "pod": _pod_row}[phase]()
+               "pod": _pod_row,
+               "serving_spec": _serving_spec_row}[phase]()
         print(json.dumps(row))
         return
     if on_cpu:
@@ -459,6 +511,8 @@ def _emit(payload: dict, cpu: bool) -> None:
             "serving_prefix", _run_phase("serving_prefix", cpu))
         extra["server"] = _phase_row("server", _run_phase("server", cpu))
         extra["pod"] = _phase_row("pod", _run_phase("pod", cpu))
+        extra["serving_spec"] = _phase_row(
+            "serving_spec", _run_phase("serving_spec", cpu))
     _normalize_row(payload, "llama_train_tokens_per_sec_per_chip",
                    "tokens/s/chip")
     payload["schema_version"] = _SCHEMA_VERSION

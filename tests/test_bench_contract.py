@@ -159,7 +159,8 @@ def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
               if e.get("BENCH_PHASE") != "train"]
     assert len(train) == 1, "TPU child must not be spawned under a cpu pin"
     assert train[0]["BENCH_TPU_SKIPPED"] == "1"
-    assert phases == ["serving", "serving_prefix", "server", "pod"]
+    assert phases == ["serving", "serving_prefix", "server", "pod",
+                      "serving_spec"]
     assert all(e["JAX_PLATFORMS"] == "cpu" for e in calls)
     line = json.loads(capsys.readouterr().out.strip())
     assert "skipped" in line and "error" not in line
@@ -228,7 +229,8 @@ def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
     bench.main()
     line = json.loads(capsys.readouterr().out.strip())
     assert line["value"] == 123.0
-    for row in ("serving", "serving_prefix", "server", "pod"):
+    for row in ("serving", "serving_prefix", "server", "pod",
+                "serving_spec"):
         assert "no tpu visible" in line["extra"][row]["error"]
 
 
@@ -403,7 +405,8 @@ def test_schema_v2_row_normalizer():
 def _assert_schema_v2(line: dict):
     assert line["schema_version"] == 2
     rows = [line] + [line["extra"][k]
-                     for k in ("serving", "serving_prefix", "server", "pod")
+                     for k in ("serving", "serving_prefix", "server", "pod",
+                               "serving_spec")
                      if k in line.get("extra", {})]
     for row in rows:
         assert row.get("metric"), row
@@ -627,6 +630,33 @@ def test_serve_bench_kv_dtype_and_paged_attention_flags():
     assert 0.5 < ratio <= 0.6, out
 
 
+def test_serve_bench_speculative_flag_smoke():
+    """The --speculative/--draft-k A/B axis reaches the engine
+    (ISSUE 12): the self-draft run reports the speculation summary keys
+    — accept rate 1.0 (identical draft), tokens_per_decode_step above
+    the acceptance bar (> 1.5 at k=3), five flat compile counts."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench", os.path.join(ROOT, "benchmarks", "serve_bench.py"))
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    engine, cfg = sb.build_tiny_engine(
+        "gpt2", num_slots=2, max_len=32, prefill_chunk=8,
+        speculative=True, draft_k=3)
+    summary = sb.run_offered_load(
+        engine, cfg.vocab_size, num_requests=4, rate_hz=500.0,
+        prompt_len=(2, 6), max_new_tokens=(4, 6))
+    assert summary["requests_finished"] == 4
+    assert summary["spec_accept_rate"] == 1.0
+    assert summary["tokens_per_decode_step"] > 1.5
+    assert summary["spec_drafted_tokens"] == summary["spec_accepted_tokens"]
+    for prog in ("admit", "prefill", "draft_prefill", "draft", "verify"):
+        assert summary[f"compiles_{prog}"] == 1, prog
+    # the decode-role roofline keys read the VERIFY program
+    assert "decode_mxu_idle_fraction" in summary
+
+
 # ---------------------------------------------------------------------------
 # device-cost attribution & the bench regression gate (ISSUE 11)
 # ---------------------------------------------------------------------------
@@ -724,6 +754,35 @@ def test_bench_diff_phase_row_regression(tmp_path):
     report = compare_rows(line(10.0, 100.0), broken)
     assert report["degraded"] == [
         "extra.serving (phase went value -> error)"]
+
+
+def test_bench_diff_serving_spec_row_compares(tmp_path):
+    """ISSUE 12: the extra.serving_spec A/B row runs through bench-diff
+    with direction awareness — a drop in the speculative arm's
+    tokens_per_decode_step (or accept rate) is a regression; the
+    draft_k config scalar and the exactness verdict are never
+    compared."""
+    from accelerate_tpu.commands.bench_diff import compare_rows
+
+    def line(tps_step, accept):
+        return {
+            "schema_version": 2, "metric": "m", "unit": "u", "value": 1.0,
+            "extra": {"serving_spec": {
+                "metric": "serving_speculative_ab", "unit": "summary",
+                "value": {"draft_k": 4, "greedy_byte_identical": True,
+                          "baseline": {"tokens_per_decode_step": 2.0},
+                          "speculative": {"tokens_per_decode_step": tps_step,
+                                          "spec_accept_rate": accept}}}},
+        }
+
+    report = compare_rows(line(7.5, 1.0), line(1.1, 0.2))
+    keys = {e["key"] for e in report["regressions"]}
+    assert keys == {
+        "extra.serving_spec.speculative.tokens_per_decode_step",
+        "extra.serving_spec.speculative.spec_accept_rate"}
+    assert not any("draft_k" in e["key"] or "byte_identical" in e["key"]
+                   for e in report["regressions"] + report["improvements"])
+    assert not compare_rows(line(7.5, 1.0), line(7.5, 1.0))["regressions"]
 
 
 def test_regression_script_delegates(tmp_path):

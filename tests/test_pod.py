@@ -663,3 +663,20 @@ def test_contract_factories_name_paged_kernel_variant():
     assert pod_kern["decode"].name == "serving.pod.decode.paged-kernel"
     assert pod_kern["decode"].require == pod_program_contracts(
         num_layers=2)["decode"].require
+
+
+def test_pod_logprobs_ride_shipments(gpt2_setup):
+    """ISSUE 12: per-token logprobs survive disaggregation — the first
+    token's logprob rides the KVPageShipment, later ones mirror from the
+    decode worker, so the pod's user-facing handle carries the same
+    logprobs (index-aligned with its tokens) as the single engine."""
+    cfg, params = gpt2_setup
+    ref_eng = Engine(gpt2, cfg, params, _ec())
+    ref = _run_trace(ref_eng, cfg)
+    pod = PodEngine(gpt2, cfg, params, _ec(),
+                    PodConfig(prefill_workers=1, decode_workers=1))
+    reqs = _run_trace(pod, cfg)
+    for r_ref, r_pod in zip(ref, reqs):
+        assert r_pod.tokens == r_ref.tokens
+        assert len(r_pod.logprobs) == len(r_pod.tokens)
+        assert r_pod.logprobs == pytest.approx(r_ref.logprobs, abs=1e-5)

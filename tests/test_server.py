@@ -1216,3 +1216,121 @@ class TestDebugEndpoints:
             engine.cancel(queued)
 
         _run(door, scenario)
+
+
+class TestLogprobsAndForking:
+    """ISSUE 12: OpenAI `logprobs` on both doors, COW-fork fan-out (one
+    prefill for n=8), and best_of ranking by true cumulative logprob."""
+
+    def test_completions_logprobs_block(self, gpt2_setup):
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2, 3], "max_tokens": 4, "temperature": 0,
+                 "logprobs": 1})
+            assert st == 200, body
+            choice = json.loads(body)["choices"][0]
+            lp = choice["logprobs"]
+            assert lp["token_ids"] == choice["token_ids"]
+            assert len(lp["token_logprobs"]) == 4
+            assert all(v <= 0.0 for v in lp["token_logprobs"])
+            assert lp["top_logprobs"] is None
+            # without the field the block stays null (pre-ISSUE shape)
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2, 3], "max_tokens": 2, "temperature": 0})
+            assert json.loads(body)["choices"][0]["logprobs"] is None
+            # top-N alternatives are not computed: 400, not truncation
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [1, 2], "max_tokens": 2, "logprobs": 5})
+            assert st == 400 and b"must be 0 or 1" in body
+            # chat takes the OpenAI boolean
+            st, _, body = await _call(
+                port, "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 3, "temperature": 0, "logprobs": True})
+            assert st == 200, body
+            lp = json.loads(body)["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == 3
+            st, _, body = await _call(
+                port, "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 2, "logprobs": 1})
+            assert st == 400 and b"boolean" in body
+
+        _run(door, scenario)
+
+    def test_streaming_logprobs_frames(self, gpt2_setup):
+        door, engine, cfg = _stack(gpt2_setup)
+
+        async def scenario(port):
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [2, 4, 6], "max_tokens": 5, "stream": True,
+                 "temperature": 0.7, "seed": 2, "logprobs": 0})
+            assert st == 200
+            return body
+
+        body = _run(door, scenario)
+        ids, lps = [], []
+        for frame in body.split(b"\n\n"):
+            if (not frame.startswith(b"data: ")
+                    or frame.startswith(b"data: [DONE]")):
+                continue
+            choice = json.loads(frame[len(b"data: "):])["choices"][0]
+            block = choice.get("logprobs")
+            assert block is not None, choice
+            # each frame's logprob slice is index-aligned with its ids
+            assert block["token_ids"] == choice["token_ids"]
+            assert len(block["token_logprobs"]) == len(choice["token_ids"])
+            ids.extend(choice["token_ids"])
+            lps.extend(block["token_logprobs"])
+        assert len(ids) == len(lps) == 5
+
+    def test_n8_fan_out_pays_one_prefill_pinned(self, gpt2_setup):
+        """The ISSUE 12 acceptance bar at the HTTP door: an n=8 fan-out
+        on an 80-token prompt runs ONE full prompt prefill (5 chunks of
+        16) plus one final-partial-page catch-up chunk per fork sibling
+        — 12 chunks total, pinned, where independent submissions would
+        pay 40."""
+        door, engine, cfg = _stack(gpt2_setup, num_slots=4, max_len=128,
+                                   prefill_chunk=16, page_size=16)
+        prompt = list(np.random.default_rng(5).integers(
+            0, cfg.vocab_size, (80,)))
+
+        async def scenario(port):
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [int(t) for t in prompt], "max_tokens": 4,
+                 "n": 8, "temperature": 0.9, "seed": 11})
+            assert st == 200, body
+            return json.loads(body)["choices"]
+
+        choices = _run(door, scenario)
+        assert len(choices) == 8
+        assert engine.metrics.prefill_chunks == 5 + 7, \
+            engine.metrics.prefill_chunks
+        assert len({tuple(c["token_ids"]) for c in choices}) > 1
+
+    def test_best_of_ranks_by_cumulative_logprob_e2e(self, gpt2_setup):
+        """best_of=4, n=2 returns the two candidates with the highest
+        true cumulative logprob, in descending order — verified from the
+        response's own logprobs blocks."""
+        door, engine, cfg = _stack(gpt2_setup, num_slots=4)
+
+        async def scenario(port):
+            st, _, body = await _call(
+                port, "/v1/completions",
+                {"prompt": [3, 1, 4], "max_tokens": 5, "n": 2,
+                 "best_of": 4, "temperature": 0.9, "seed": 1,
+                 "logprobs": 1})
+            assert st == 200, body
+            return json.loads(body)["choices"]
+
+        choices = _run(door, scenario)
+        assert len(choices) == 2
+        sums = [sum(c["logprobs"]["token_logprobs"]) for c in choices]
+        assert sums == sorted(sums, reverse=True)

@@ -165,15 +165,18 @@ def test_slot_reuse_after_retirement(gpt2_setup):
     cfg, params = gpt2_setup
     eng = _engine(cfg, params, num_slots=2)
     rng = np.random.default_rng(4)
-    prompts = [_prompt(rng, 4 + i, cfg.vocab_size) for i in range(5)]
+    # equal-length prompts: slot reuse doesn't depend on length variety
+    # (the staggered test covers that), and one BATCHED reference
+    # generate replaces five per-length compiles (tier-1 budget)
+    prompts = [_prompt(rng, 6, cfg.vocab_size) for _ in range(5)]
     reqs = [eng.submit(p, max_new_tokens=4) for p in prompts]
     assert eng.scheduler.queue_depth == 3  # only 2 slots
     eng.run_until_idle()
-    for p, r in zip(prompts, reqs):
+    refs = np.asarray(gpt2.generate(
+        cfg, params, jnp.asarray(np.stack(prompts)), max_new_tokens=4))
+    for i, (p, r) in enumerate(zip(prompts, reqs)):
         assert r.status is RequestStatus.FINISHED
-        ref = gpt2.generate(cfg, params, jnp.asarray(p)[None, :],
-                            max_new_tokens=4)
-        assert r.tokens == np.asarray(ref)[0, len(p):].tolist()
+        assert r.tokens == refs[i, len(p):].tolist()
     # all 5 ran through 2 slots
     assert eng.metrics.finished == 5
 
@@ -717,19 +720,23 @@ def test_compile_flat_across_kernel_and_int8_mixes(gpt2_setup):
     cfg, params = gpt2_setup
     rng = np.random.default_rng(9)
     shared = _prompt(rng, 18, cfg.vocab_size)
+    # budgets/wave sizes are deliberately minimal: the guard is about
+    # SHAPE variety (lengths, temps, prefix hits), and every extra
+    # decode token costs real time on the interpret-mode kernel arms
+    # (ISSUE 12's tier-1 budget trim: 9.3s -> measured below)
     for pa in (False, True):
         for kvd in (None, "int8"):
             eng = _engine(cfg, params, num_slots=2, max_len=48,
                           page_size=8, paged_attention=pa, kv_dtype=kvd)
-            for plen, mnt, temp in ((3, 4, 0.0), (13, 2, 1.0),
-                                    ("shared", 3, 0.5)):
+            for plen, mnt, temp in ((3, 2, 0.0), (13, 1, 1.0),
+                                    ("shared", 2, 0.5)):
                 if plen == "shared":
                     prompts = [np.concatenate(
                         [shared, _prompt(rng, 2 + i, cfg.vocab_size)])
-                        for i in range(3)]
+                        for i in range(2)]
                 else:
                     prompts = [_prompt(rng, plen, cfg.vocab_size)
-                               for _ in range(3)]
+                               for _ in range(2)]
                 reqs = [eng.submit(p, max_new_tokens=mnt, temperature=temp)
                         for p in prompts]
                 eng.run_until_idle()
