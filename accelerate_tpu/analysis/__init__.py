@@ -29,6 +29,13 @@ from .findings import (  # noqa: F401
     save_baseline,
 )
 from .source import lint_source, lint_text  # noqa: F401
+from .lifecycle import (  # noqa: F401
+    PAIRING_TABLE,
+    REQUEST_FSM,
+    RequestFSM,
+    ResourcePair,
+    lint_lifecycle,
+)
 from .program import (  # noqa: F401
     CANONICAL_COLLECTIVES,
     CollectiveContract,
@@ -68,6 +75,11 @@ __all__ = [
     "lowering_flavor",
     "serving_program_contracts",
     "shard_map_contracts",
+    "PAIRING_TABLE",
+    "REQUEST_FSM",
+    "RequestFSM",
+    "ResourcePair",
+    "lint_lifecycle",
     "lint_source",
     "lint_text",
     "lint_file",

@@ -68,6 +68,25 @@ RULES: dict[str, Rule] = {
         Rule("ATP008", "donation-aliasing", "source",
              "pytree literal reaches the same object through multiple paths "
              "in donation context ('donate the same buffer twice')"),
+        Rule("ATP201", "lifecycle-leak-on-path", "source",
+             "paired resource (page alloc / refcount acquire / slot claim) "
+             "reaches a function exit — early return, fall-through, or "
+             "exception — without its matching release"),
+        Rule("ATP202", "lifecycle-double-release", "source",
+             "a locally-acquired resource is released twice on one path"),
+        Rule("ATP203", "lifecycle-release-without-acquire", "source",
+             "a release runs on a path where the local acquire never "
+             "happened (asymmetric branch protocol)"),
+        Rule("ATP211", "terminal-bypasses-finalizer", "source",
+             "a request reaches a terminal state (or sheds are drained) "
+             "without routing through the finalizer that books "
+             "metrics/trace closure"),
+        Rule("ATP212", "shed-without-bookkeeping", "source",
+             "a REJECTED/EXPIRED transition never sets the machine-"
+             "readable shed_code (sheds become uncountable)"),
+        Rule("ATP221", "cross-thread-state-mutation", "source",
+             "state mutated both from a thread/handler context and from "
+             "drive-loop code without a lock or the drive task"),
         Rule("ATP101", "collective-contract", "program",
              "lowered program's collective counts violate its declared "
              "CollectiveContract"),
@@ -93,6 +112,12 @@ class Finding:
     line: int = 0
     col: int = 0
     source: str = ""
+    # structured machine-readable detail (JSON-safe dict): the lifecycle
+    # passes put the resource/state name and the offending path's line
+    # span here so `lint --format json` consumers can act on a finding
+    # without re-reading the pass. Excluded from equality/fingerprint —
+    # spans drift with unrelated edits, fingerprints must not.
+    data: dict | None = dataclasses.field(default=None, compare=False)
 
     @property
     def fingerprint(self) -> str:

@@ -1,0 +1,1305 @@
+"""ATP2xx lifecycle passes: paired resources, the request FSM, and
+thread confinement — this repo's OWN host-side invariants, checked the
+same way the ATP0xx passes check TPU hazards: statically, before anything
+runs.
+
+Every review round since PR 5 caught the same bug classes by hand in the
+serving stack: a refcount acquire without a release on one exit path, a
+terminal request path that bypasses `_finalize_request` (metrics silently
+undercount), engine state touched from a watchdog thread. These passes
+encode those protocols declaratively so a new shed site, a new resource,
+or a new background thread is audited the day it is written:
+
+- **ATP201/202/203 — paired resources** (`PAIRING_TABLE`). A per-function
+  control-flow graph tracks every acquire (``pool.alloc``,
+  ``index.acquire``, ``allocator.allocate``, ``scheduler.adopt_running``)
+  to every function exit — early returns, fall-through, AND exception
+  edges — and demands the matching release unless ownership visibly
+  escapes (returned as a value, stored into an attribute/container, or
+  handed to another call). New resources register in one
+  :class:`ResourcePair` line.
+- **ATP211/212 — request-FSM exhaustiveness** (`REQUEST_FSM`). In classes
+  that own a finalizer (`_finalize_request` / `_finalize`), every
+  terminal-status transition must reach the finalizer on every following
+  path; calls that may shed internally (``scheduler.submit``,
+  ``shed_expired``) must be drained (``drain_shed``), drained sheds must
+  be finalized, and every REJECTED/EXPIRED transition must set the
+  machine-readable ``shed_code`` (ATP212) — the exact PR 6/PR 8
+  undercount classes, now unwritable.
+- **ATP221 — thread confinement** (`THREAD_ENTRIES`). Functions reachable
+  from a thread registration (``Thread(target=...)``,
+  ``StallWatchdog(dumps=...)``) must not assign attributes that
+  drive-loop methods of the same class also assign, unless the
+  assignment is under a ``with <...lock...>:`` block (``__init__`` runs
+  happens-before the thread and is exempt).
+
+All passes are pure AST (no jax, no imports executed) and emit the same
+:class:`~.findings.Finding` currency as every other rule — suppressions,
+baselines, the CLI, and the tier-1 self-lint gate apply unchanged.
+Findings carry a structured ``data`` dict (resource/state name + the
+offending path's line span) so ``lint --format json`` is actionable
+without rereading the pass.
+
+Known limits (deliberate): the analysis is function-local — protocols
+whose acquire and release live in different functions (e.g.
+``PagedAllocator.allocate`` paired with ``release`` at retirement) are
+the *caller's* obligation and are audited where the caller holds both
+ends; dynamic dispatch through subscripts (``self.workers[i].cancel``)
+is out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any
+
+from .findings import Finding
+
+__all__ = [
+    "ResourcePair",
+    "PAIRING_TABLE",
+    "RequestFSM",
+    "REQUEST_FSM",
+    "ThreadEntries",
+    "THREAD_ENTRIES",
+    "lint_lifecycle",
+]
+
+
+# ---------------------------------------------------------------------------
+# declarative tables
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourcePair:
+    """One acquire/release protocol. `acquire`/`release` are method-name
+    tails; `receivers` scope both to attribute chains ending in one of
+    these names (``self.index.acquire`` -> receiver "index"), so a
+    generic name like ``release`` never matches the wrong object.
+    ``returns_handle=True`` means the acquire RETURNS the tracked handle
+    (``pages = pool.alloc(n)``); ``False`` means the handle is the
+    acquire's first argument (``index.acquire(nodes)``)."""
+
+    name: str
+    acquire: tuple
+    release: tuple
+    receivers: tuple
+    returns_handle: bool = True
+
+
+# The repo's paired resources. Adding a resource (a future shipment
+# buffer, an adapter-store lease) is ONE line here — the CFG machinery
+# below picks it up everywhere, including the self-lint gate.
+PAIRING_TABLE: tuple = (
+    ResourcePair("page-pool-pages", acquire=("alloc",),
+                 release=("release",), receivers=("pool",)),
+    ResourcePair("prefix-refcount", acquire=("acquire",),
+                 release=("release",), receivers=("index",),
+                 returns_handle=False),
+    ResourcePair("page-allocation", acquire=("allocate",),
+                 release=("release", "rollback"), receivers=("allocator",)),
+    ResourcePair("slot-claim", acquire=("adopt_running",),
+                 release=("free", "rollback"), receivers=("scheduler",)),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestFSM:
+    """The serving Request lifecycle, declaratively: QUEUED -> RUNNING ->
+    {FINISHED, CANCELLED} plus the shed terminals {REJECTED, EXPIRED}
+    (which carry the ``shed_code`` vocabulary). `finalizers` are the
+    methods that book metrics + close traces; classes defining one are
+    "finalizer-owning" and get the strict ATP211 treatment."""
+
+    status_enum: str = "RequestStatus"
+    terminal: tuple = ("FINISHED", "CANCELLED", "REJECTED", "EXPIRED")
+    shed: tuple = ("REJECTED", "EXPIRED")
+    finalizers: tuple = ("_finalize_request", "_finalize")
+    shed_log: str = "shed_log"
+    drain: str = "drain_shed"
+    shed_code_attr: str = "shed_code"
+    # calls that may shed requests internally: the caller must drain
+    shedding_calls: tuple = ("shed_expired",)
+    shedding_scheduler_calls: tuple = ("submit",)   # receiver tail "scheduler"
+    # terminal-transition calls on the scheduler: `if sched.cancel(r):`
+    # obliges the true branch to finalize r
+    transition_calls: tuple = ("cancel", "finish_early")
+
+
+REQUEST_FSM = RequestFSM()
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadEntries:
+    """Where thread/handler contexts are born: constructor-call name
+    tails whose listed keyword arguments register a callable that runs
+    off the drive loop."""
+
+    constructors: tuple = ("Thread", "Timer", "StallWatchdog")
+    kwargs: tuple = ("target", "dumps", "on_stall")
+
+
+THREAD_ENTRIES = ThreadEntries()
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _attr_chain(node: ast.AST) -> list:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return []
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_none_const(node: ast.AST | None) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _own_exprs(stmt: ast.stmt) -> list:
+    """The expressions a statement evaluates ITSELF — compound statements
+    exclude their child statements (those have their own CFG nodes)."""
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        out = [stmt.value] if stmt.value is not None else []
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        out.extend(targets)
+        return out
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Raise):
+        return [x for x in (stmt.exc, stmt.cause) if x is not None]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    return []
+
+
+def _own_calls(stmt: ast.stmt) -> list:
+    out = []
+    for root in _own_exprs(stmt):
+        out.extend(c for c in ast.walk(root) if isinstance(c, ast.Call))
+    return out
+
+
+# calls that cannot realistically raise mid-protocol: without this
+# whitelist every `len()` between an acquire and its release would grow
+# an exception edge and drown the signal
+_NORAISE_CALLS = {
+    "len", "min", "max", "abs", "round", "isinstance", "id", "repr",
+    "sorted", "list", "tuple", "dict", "set", "range", "enumerate",
+    "zip", "bool", "float", "int", "str", "print", "getattr", "hasattr",
+}
+
+
+def _may_raise(stmt: ast.stmt, table=None) -> bool:
+    if isinstance(stmt, ast.Return):
+        # a value-return is the ownership-transfer point; modeling its
+        # expression as raising would contradict the transfer
+        return False
+    for c in _own_calls(stmt):
+        if isinstance(c.func, ast.Name) and c.func.id in _NORAISE_CALLS:
+            continue
+        # release primitives are trusted not to raise mid-protocol —
+        # otherwise no except/finally handler could ever discharge an
+        # obligation (its own release would re-raise in the model)
+        if table is not None and _match_pair_call(c, table)[1] == "release":
+            continue
+        return True
+    return False
+
+
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _outer_walk(fn: ast.AST) -> list:
+    """ast.walk over `fn` excluding the bodies of nested functions."""
+    skip: set = set()
+    for inner in ast.walk(fn):
+        if isinstance(inner, _FN_NODES) and inner is not fn:
+            skip |= {id(x) for x in ast.walk(inner)}
+    return [n for n in ast.walk(fn) if id(n) not in skip]
+
+
+# ---------------------------------------------------------------------------
+# the per-function CFG
+# ---------------------------------------------------------------------------
+#
+# Nodes are simple statements (or branch tests, or empty joins); edges
+# carry an optional label: ("cond", test_expr, True|False) on branch
+# arms (so a pass can refine state on `if x is None:`), "exc" on
+# exception edges, "iter"/"end" on for-loop arms. Exception edges leave
+# every statement that contains a plausible-raise call and land on the
+# innermost enclosing handlers (continuing outward when no handler is a
+# catch-all, inlining `finally` bodies), ultimately on REXIT — the
+# exceptional function exit. Inlined finally/return plumbing duplicates
+# nodes; passes dedupe findings by (rule, line, subject).
+
+
+class _Node:
+    __slots__ = ("idx", "kind", "payload", "succ", "line")
+
+    def __init__(self, idx: int, kind: str, payload: Any, line: int):
+        self.idx = idx
+        self.kind = kind          # "stmt" | "branch" | entry/exit/rexit
+        self.payload = payload    # the ast stmt (branch: the test expr)
+        self.succ: list = []      # [(node_idx, label)]
+        self.line = line
+
+
+class _CFG:
+    def __init__(self):
+        self.nodes: list = []
+        self.entry = self._new("entry", None, 0)
+        self.exit = self._new("exit", None, 0)
+        self.rexit = self._new("rexit", None, 0)
+
+    def _new(self, kind: str, payload: Any, line: int) -> int:
+        n = _Node(len(self.nodes), kind, payload, line)
+        self.nodes.append(n)
+        return n.idx
+
+    def edge(self, a: int, b: int, label: Any = None) -> None:
+        self.nodes[a].succ.append((b, label))
+
+
+@dataclasses.dataclass
+class _TryFrame:
+    handler_entries: list
+    catch_all: bool
+    finally_body: list
+    exc_finally_entry: int | None   # pre-built exceptional finally copy
+
+
+class _CFGBuilder:
+    """Builds a :class:`_CFG` for one function body (nested defs are
+    opaque — they are analyzed as functions in their own right)."""
+
+    def __init__(self, table=PAIRING_TABLE):
+        self.cfg = _CFG()
+        self.table = table
+        self.frames: list = []          # innermost-last _TryFrame stack
+        self.loop_stack: list = []      # (head_idx, break_targets list)
+
+    def build(self, fn: ast.AST) -> _CFG:
+        cur = self._seq(list(fn.body), self.cfg.entry)
+        if cur is not None:
+            self.cfg.edge(cur, self.cfg.exit)
+        return self.cfg
+
+    # -- exception / finally plumbing ---------------------------------------
+
+    def _exc_targets(self, frames: list | None = None) -> list:
+        """Where an exception raised here can land, given the enclosing
+        `frames` (default: the current stack)."""
+        frames = self.frames if frames is None else frames
+        targets: list = []
+        for i in range(len(frames) - 1, -1, -1):
+            frame = frames[i]
+            if frame.handler_entries:
+                targets.extend(frame.handler_entries)
+                if frame.catch_all:
+                    return targets
+            if frame.exc_finally_entry is not None:
+                targets.append(frame.exc_finally_entry)
+                return targets       # the copy continues outward itself
+        targets.append(self.cfg.rexit)
+        return targets
+
+    def _inline(self, body: list, outer_frames: list):
+        """Build a detached copy of `body` (a finally suite) under
+        `outer_frames`; returns (entry, tail|None)."""
+        entry = self.cfg._new("stmt", None, 0)
+        saved_frames, saved_loops = self.frames, self.loop_stack
+        self.frames, self.loop_stack = list(outer_frames), []
+        tail = self._seq(list(body), entry)
+        self.frames, self.loop_stack = saved_frames, saved_loops
+        return entry, tail
+
+    def _route_return(self, cur: int) -> None:
+        """Route a return through every enclosing finally, then EXIT."""
+        for i in range(len(self.frames) - 1, -1, -1):
+            frame = self.frames[i]
+            if not frame.finally_body:
+                continue
+            entry, tail = self._inline(frame.finally_body, self.frames[:i])
+            self.cfg.edge(cur, entry)
+            if tail is None:
+                return
+            cur = tail
+        self.cfg.edge(cur, self.cfg.exit)
+
+    # -- statement sequencing ------------------------------------------------
+
+    def _seq(self, stmts: list, cur):
+        for stmt in stmts:
+            if cur is None:
+                return None
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _simple(self, stmt: ast.stmt, cur: int) -> int:
+        n = self.cfg._new("stmt", stmt, getattr(stmt, "lineno", 0))
+        self.cfg.edge(cur, n)
+        if _may_raise(stmt, self.table):
+            for t in self._exc_targets():
+                self.cfg.edge(n, t, "exc")
+        return n
+
+    def _branch_node(self, test, lineno: int, cur: int) -> int:
+        n = self.cfg._new("branch", test, lineno)
+        self.cfg.edge(cur, n)
+        if test is not None:
+            has_call = any(
+                not (isinstance(c.func, ast.Name)
+                     and c.func.id in _NORAISE_CALLS)
+                for c in ast.walk(test) if isinstance(c, ast.Call))
+            if has_call:
+                for t in self._exc_targets():
+                    self.cfg.edge(n, t, "exc")
+        return n
+
+    def _stmt(self, stmt: ast.stmt, cur: int):
+        cfg = self.cfg
+        if isinstance(stmt, _FN_NODES + (ast.ClassDef,)):
+            return cur                      # opaque: analyzed separately
+        if isinstance(stmt, ast.Return):
+            n = self._simple(stmt, cur)
+            self._route_return(n)
+            return None
+        if isinstance(stmt, ast.Raise):
+            n = cfg._new("stmt", stmt, stmt.lineno)
+            cfg.edge(cur, n)
+            for t in self._exc_targets():
+                cfg.edge(n, t, "exc")
+            return None
+        if isinstance(stmt, (ast.Break, ast.Continue)):
+            n = cfg._new("stmt", stmt, stmt.lineno)
+            cfg.edge(cur, n)
+            if self.loop_stack:
+                head, breaks = self.loop_stack[-1]
+                if isinstance(stmt, ast.Break):
+                    breaks.append(n)
+                else:
+                    cfg.edge(n, head)
+            return None
+        if isinstance(stmt, ast.If):
+            test = self._branch_node(stmt.test, stmt.lineno, cur)
+            join = cfg._new("stmt", None, 0)
+            live = False
+            body_entry = cfg._new("stmt", None, 0)
+            cfg.edge(test, body_entry, ("cond", stmt.test, True))
+            tail = self._seq(stmt.body, body_entry)
+            if tail is not None:
+                cfg.edge(tail, join)
+                live = True
+            else_entry = cfg._new("stmt", None, 0)
+            cfg.edge(test, else_entry, ("cond", stmt.test, False))
+            tail = self._seq(stmt.orelse, else_entry)
+            if tail is not None:
+                cfg.edge(tail, join)
+                live = True
+            return join if live else None
+        if isinstance(stmt, ast.While):
+            head = self._branch_node(stmt.test, stmt.lineno, cur)
+            after = cfg._new("stmt", None, 0)
+            breaks: list = []
+            body_entry = cfg._new("stmt", None, 0)
+            cfg.edge(head, body_entry, ("cond", stmt.test, True))
+            self.loop_stack.append((head, breaks))
+            tail = self._seq(stmt.body, body_entry)
+            self.loop_stack.pop()
+            if tail is not None:
+                cfg.edge(tail, head)
+            cfg.edge(head, after, ("cond", stmt.test, False))
+            for b in breaks:
+                cfg.edge(b, after)
+            return self._seq(stmt.orelse, after) if stmt.orelse else after
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            it = self._simple(stmt, cur)       # iter eval + target kill
+            head = cfg._new("branch", None, stmt.lineno)
+            cfg.edge(it, head)
+            after = cfg._new("stmt", None, 0)
+            breaks = []
+            body_entry = cfg._new("stmt", None, 0)
+            cfg.edge(head, body_entry, "iter")
+            self.loop_stack.append((head, breaks))
+            tail = self._seq(stmt.body, body_entry)
+            self.loop_stack.pop()
+            if tail is not None:
+                cfg.edge(tail, head)
+            cfg.edge(head, after, "end")
+            for b in breaks:
+                cfg.edge(b, after)
+            return self._seq(stmt.orelse, after) if stmt.orelse else after
+        if isinstance(stmt, ast.Try):
+            catch_all = any(
+                h.type is None
+                or (isinstance(h.type, ast.Name)
+                    and h.type.id in ("Exception", "BaseException"))
+                for h in stmt.handlers)
+            handler_entries = [cfg._new("stmt", None, h.lineno)
+                               for h in stmt.handlers]
+            exc_fin = None
+            if stmt.finalbody and not catch_all:
+                # the exception path through finally, continuing outward
+                entry, tail = self._inline(stmt.finalbody, self.frames)
+                if tail is not None:
+                    for t in self._exc_targets():
+                        cfg.edge(tail, t)
+                exc_fin = entry
+            frame = _TryFrame(handler_entries, catch_all,
+                              list(stmt.finalbody), exc_fin)
+            self.frames.append(frame)
+            body_entry = cfg._new("stmt", None, 0)
+            cfg.edge(cur, body_entry)
+            body_tail = self._seq(stmt.body, body_entry)
+            if body_tail is not None and stmt.orelse:
+                body_tail = self._seq(stmt.orelse, body_tail)
+            self.frames.pop()
+            # handler bodies run OUTSIDE this frame (their raises escape
+            # outward) but still inside enclosing frames
+            handler_exits = []
+            for h, entry in zip(stmt.handlers, handler_entries):
+                tail = self._seq(h.body, entry)
+                if tail is not None:
+                    handler_exits.append(tail)
+            after = cfg._new("stmt", None, 0)
+            tails = ([body_tail] if body_tail is not None else []) \
+                + handler_exits
+            if not tails:
+                return None
+            if stmt.finalbody:
+                for t in tails:
+                    entry, ftail = self._inline(stmt.finalbody, self.frames)
+                    cfg.edge(t, entry)
+                    if ftail is not None:
+                        cfg.edge(ftail, after)
+            else:
+                for t in tails:
+                    cfg.edge(t, after)
+            return after
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = self._simple(stmt, cur)
+            return self._seq(stmt.body, n)
+        return self._simple(stmt, cur)
+
+
+# ---------------------------------------------------------------------------
+# ATP201/202/203: the paired-resource dataflow
+# ---------------------------------------------------------------------------
+
+_OUT = "out"
+_REL = "rel"
+_ESC = "esc"     # ownership may have transferred; later releases are legal
+_MAX_WORLDS = 200
+
+
+def _match_pair_call(call: ast.Call, table) -> tuple:
+    """(pair, role) for a call matching a pairing-table entry, where role
+    is "acquire" | "release" — or (None, None)."""
+    chain = _attr_chain(call.func)
+    if len(chain) < 2:
+        return None, None
+    method, receiver = chain[-1], chain[-2]
+    for pair in table:
+        if receiver in pair.receivers:
+            if method in pair.acquire:
+                return pair, "acquire"
+            if method in pair.release:
+                return pair, "release"
+    return None, None
+
+
+class _PairingPass:
+    """Runs the acquire/release dataflow over one function's CFG.
+
+    State: a frozenset of WORLDS (path summaries); each world is a
+    frozenset of (var, status, acquire_line, pair_name). A var absent
+    from a world is untracked on that path. Worlds keep enough path
+    sensitivity to tell "released on the other branch" from "released
+    twice" — the difference between ATP203 and ATP202."""
+
+    def __init__(self, fn, cfg: _CFG, path: str, lines: list,
+                 findings: list, table=PAIRING_TABLE):
+        self.fn = fn
+        self.cfg = cfg
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self.table = table
+        self._reported: set = set()
+        self.acquired_vars: set = set()
+        for node in _outer_walk(fn):
+            if isinstance(node, ast.Call):
+                pair, role = _match_pair_call(node, self.table)
+                if role == "acquire" and not pair.returns_handle \
+                        and node.args and isinstance(node.args[0], ast.Name):
+                    self.acquired_vars.add(node.args[0].id)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                pair, role = _match_pair_call(node.value, self.table)
+                if role == "acquire" and pair.returns_handle \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    self.acquired_vars.add(node.targets[0].id)
+
+    # -- event extraction ----------------------------------------------------
+
+    def _events(self, node: _Node) -> list:
+        """Ordered events for one CFG node:
+        ("release", pair, var|None, line) -> ("escape", var) ->
+        ("kill", var) -> ("acquire", pair, var, line)."""
+        stmt = node.payload
+        events: list = []
+        if node.kind != "stmt" or not isinstance(stmt, ast.stmt):
+            return events
+        calls = _own_calls(stmt)
+        pair_calls = {}
+        for c in calls:
+            pair, role = _match_pair_call(c, self.table)
+            if pair is not None:
+                pair_calls[id(c)] = (c, pair, role)
+        for c, pair, role in pair_calls.values():
+            if role == "release":
+                var = c.args[0].id \
+                    if (c.args and isinstance(c.args[0], ast.Name)) else None
+                events.append(("release", pair, var, c.lineno))
+        # escapes: tracked names in ownership-transferring positions
+        escape_names: set = set()
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None and not _is_none_const(stmt.value):
+                escape_names |= _names_in(stmt.value)
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, (ast.Attribute, ast.Subscript)):
+                    escape_names |= _names_in(stmt.value)
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, (ast.Yield, ast.YieldFrom,
+                                            ast.Await)):
+            escape_names |= _names_in(stmt.value)
+        for c in calls:
+            skip_args: set = set()
+            if id(c) in pair_calls:
+                _, pair, role = pair_calls[id(c)]
+                if c.args and (role == "release"
+                               or (role == "acquire"
+                                   and not pair.returns_handle)):
+                    # the handle argument itself: releasing is not an
+                    # escape, and a void-acquire's handle must stay
+                    # tracked
+                    skip_args = {id(c.args[0])}
+            for a in list(c.args) + [kw.value for kw in c.keywords]:
+                if id(a) in skip_args:
+                    continue
+                escape_names |= _names_in(a)
+        for name in escape_names:
+            events.append(("escape", name))
+        # assignment kills (rebinds); a handle-returning acquire then
+        # re-tracks its target
+        acquire_assign = None
+        if isinstance(stmt, ast.Assign):
+            if isinstance(stmt.value, ast.Call) \
+                    and id(stmt.value) in pair_calls:
+                c, pair, role = pair_calls[id(stmt.value)]
+                if role == "acquire" and pair.returns_handle \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    acquire_assign = (pair, stmt.targets[0].id, stmt.lineno)
+            for target in stmt.targets:
+                for t in ast.walk(target):
+                    if isinstance(t, ast.Name) and isinstance(
+                            getattr(t, "ctx", None), ast.Store):
+                        events.append(("kill", t.id))
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for t in _names_in(stmt.target):
+                events.append(("kill", t))
+        if acquire_assign is not None:
+            events.append(("acquire",) + acquire_assign)
+        for c, pair, role in pair_calls.values():
+            if role == "acquire" and not pair.returns_handle \
+                    and c.args and isinstance(c.args[0], ast.Name):
+                events.append(("acquire", pair, c.args[0].id, c.lineno))
+        return events
+
+    # -- transfer ------------------------------------------------------------
+
+    def _apply(self, world: frozenset, events: list) -> frozenset:
+        items = {var: (s, line, p) for var, s, line, p in world}
+        for ev in events:
+            if ev[0] == "release":
+                _, pair, var, line = ev
+                if var is None:
+                    continue
+                cur = items.get(var)
+                if cur is None:
+                    if var in self.acquired_vars:
+                        self._report(
+                            "ATP203", line,
+                            f"release of {var!r} ({pair.name}) on a path "
+                            "where the matching acquire never ran — the "
+                            "acquire is conditional, the release is not",
+                            data={"resource": pair.name, "variable": var,
+                                  "release_line": line,
+                                  "span": [line, self._fn_end()]})
+                elif cur[0] == _REL:
+                    self._report(
+                        "ATP202", line,
+                        f"{var!r} ({pair.name}) released twice on one path "
+                        f"(the acquire at line {cur[1]} was already "
+                        "balanced)",
+                        data={"resource": pair.name, "variable": var,
+                              "acquire_line": cur[1], "release_line": line,
+                              "span": [cur[1], line]})
+                else:
+                    # out -> rel; esc -> rel too (the consumer may have
+                    # REFUSED ownership — `rollback(alloc)` after a
+                    # failed adopt is the legitimate idiom)
+                    items[var] = (_REL, cur[1], cur[2])
+            elif ev[0] == "escape":
+                cur = items.get(ev[1])
+                if cur is not None:
+                    items[ev[1]] = (_ESC, cur[1], cur[2])
+            elif ev[0] == "kill":
+                items.pop(ev[1], None)
+            elif ev[0] == "acquire":
+                _, pair, var, line = ev
+                items[var] = (_OUT, line, pair.name)
+        return frozenset((v, s, line, p)
+                         for v, (s, line, p) in items.items())
+
+    def _escape_only(self, world: frozenset, events: list) -> frozenset:
+        """The pre-effect state an exception edge carries: the raising
+        call never completed its acquire/release, but an escape on the
+        same statement (the very call that raised may be the consumer)
+        still transfers ownership — flagging `adopt_running(alloc)`
+        raising as a leak of `alloc` would demand impossible code."""
+        items = {var: (s, line, p) for var, s, line, p in world}
+        for ev in events:
+            if ev[0] == "escape" and ev[1] in items:
+                cur = items[ev[1]]
+                items[ev[1]] = (_ESC, cur[1], cur[2])
+        return frozenset((v, s, line, p)
+                         for v, (s, line, p) in items.items())
+
+    @staticmethod
+    def _strip_not(test: ast.AST, branch: bool) -> tuple:
+        t = test
+        flip = False
+        while isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+            t = t.operand
+            flip = not flip
+        return t, (branch if not flip else not branch)
+
+    @classmethod
+    def _cond_kill(cls, test: ast.AST, branch: bool) -> tuple:
+        """(var, kills): `if x is None:` kills x's tracking on the True
+        branch (the acquire returned None — nothing was acquired);
+        `if x:` kills on the False branch, `not` flips."""
+        t, b = cls._strip_not(test, branch)
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 \
+                and isinstance(t.comparators[0], ast.Constant) \
+                and t.comparators[0].value is None \
+                and isinstance(t.left, ast.Name):
+            if isinstance(t.ops[0], (ast.Is, ast.Eq)):
+                return t.left.id, b
+            if isinstance(t.ops[0], (ast.IsNot, ast.NotEq)):
+                return t.left.id, not b
+        if isinstance(t, ast.Name):
+            return t.id, not b
+        return None, False
+
+    @classmethod
+    def _cond_fact(cls, test: ast.AST, branch: bool) -> tuple:
+        """A path fact for simple repeated tests (`if cached:` ... `if
+        cached:` later must correlate — the mirrored-condition idiom).
+        Returns (key, truth) for pure Name/attribute tests, else None."""
+        t, b = cls._strip_not(test, branch)
+        chain = _attr_chain(t)
+        if chain:
+            return "?" + ".".join(chain), b
+        return None
+
+    def _edge_state(self, state: frozenset, label: Any) -> frozenset:
+        if not (isinstance(label, tuple) and label and label[0] == "cond"):
+            return state
+        _, test, branch = label
+        var, kills = self._cond_kill(test, branch)
+        fact = self._cond_fact(test, branch)
+        out = []
+        for world in state:
+            if fact is not None and (fact[0], "fact", 0,
+                                     not fact[1]) in world:
+                continue          # this path contradicts the fact
+            w = world
+            if var is not None and kills:
+                w = frozenset(item for item in w if item[0] != var)
+            if fact is not None:
+                w = w | {(fact[0], "fact", 0, fact[1])}
+            out.append(w)
+        return frozenset(out)
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> None:
+        if not self.acquired_vars:
+            return
+        cfg = self.cfg
+        in_states: dict = {cfg.entry: frozenset([frozenset()])}
+        events_cache: dict = {}
+        work = [cfg.entry]
+        while work:
+            idx = work.pop()
+            node = cfg.nodes[idx]
+            state = in_states.get(idx, frozenset())
+            if idx not in events_cache:
+                events_cache[idx] = self._events(node)
+            events = events_cache[idx]
+            out = frozenset(self._apply(w, events) for w in state)
+            exc = frozenset(self._escape_only(w, events) for w in state)
+            for succ, label in node.succ:
+                nxt = exc if label == "exc" \
+                    else self._edge_state(out, label)
+                if succ not in in_states:
+                    in_states[succ] = nxt
+                    work.append(succ)
+                    continue
+                prev = in_states[succ]
+                merged = prev | nxt
+                if len(merged) > _MAX_WORLDS:
+                    merged = prev       # stop growing: best-effort cap
+                if merged != prev:
+                    in_states[succ] = merged
+                    work.append(succ)
+        # ATP202/203 were emitted at their release sites during _apply
+        # (re-runs of _apply dedupe via _reported); leaks are exit facts:
+        for exit_idx, flavor in ((cfg.exit, "function exit"),
+                                 (cfg.rexit, "exception path")):
+            for world in in_states.get(exit_idx, frozenset()):
+                for var, status, line, pname in world:
+                    if status != _OUT:
+                        continue
+                    self._report(
+                        "ATP201", line,
+                        f"{var!r} ({pname}) acquired at line {line} can "
+                        f"reach a {flavor} without release or ownership "
+                        "transfer"
+                        + (" — release in an except/finally before "
+                           "re-raising" if flavor == "exception path"
+                           else ""),
+                        data={"resource": pname, "variable": var,
+                              "acquire_line": line, "path": flavor,
+                              "span": [line, self._fn_end()]})
+
+    def _fn_end(self) -> int:
+        return getattr(self.fn, "end_lineno", getattr(self.fn, "lineno", 0))
+
+    def _report(self, rule: str, line: int, message: str,
+                data: dict | None = None) -> None:
+        key = (rule, line, (data or {}).get("variable"),
+               (data or {}).get("resource"), (data or {}).get("path"))
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        src = self.lines[line - 1].strip() \
+            if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            rule=rule, message=message, path=self.path, line=line,
+            source=src, data=data))
+
+
+# ---------------------------------------------------------------------------
+# ATP211/212: request-FSM exhaustiveness
+# ---------------------------------------------------------------------------
+
+
+def _terminal_assign(stmt: ast.stmt, fsm: RequestFSM) -> tuple:
+    """(target_root_name, STATUS) for `x.status = RequestStatus.T` with
+    T terminal, else (None, None)."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None, None
+    t = stmt.targets[0]
+    if not (isinstance(t, ast.Attribute) and t.attr == "status"
+            and isinstance(t.value, ast.Name)):
+        return None, None
+    chain = _attr_chain(stmt.value)
+    if len(chain) >= 2 and chain[-2] == fsm.status_enum \
+            and chain[-1] in fsm.terminal:
+        return t.value.id, chain[-1]
+    return None, None
+
+
+def _shed_code_assign(stmt: ast.stmt, fsm: RequestFSM) -> str | None:
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        t = stmt.targets[0]
+        if isinstance(t, ast.Attribute) and t.attr == fsm.shed_code_attr \
+                and isinstance(t.value, ast.Name):
+            return t.value.id
+    return None
+
+
+class _FSMPass:
+    """ATP211/212 over one function. Obligation kinds (4-tuples of
+    (kind, target, line, status)):
+
+    - ("finalize", t, ...): a terminal transition — an assignment or a
+      scheduler transition call in an if-test — must reach a finalizer
+      call naming `t` before the function exits;
+    - ("drain", ...): a call that may shed internally must be followed
+      by `drain_shed()`;
+    - ("shedB", t, ...): a scheduler-side shed must reach
+      `shed_log.append` or return the handle to a finalizing caller;
+    - ("code", t, ...): a REJECTED/EXPIRED transition must set
+      `t.shed_code` (ATP212).
+
+    Union-merged set state: an obligation alive at the NORMAL exit on
+    any path is a finding. Exception exits are exempt — a raise is its
+    own failure mode, not a silent undercount."""
+
+    def __init__(self, fn, cfg: _CFG, path: str, lines: list,
+                 findings: list, owns_finalizer: bool,
+                 fsm: RequestFSM = REQUEST_FSM):
+        self.fn = fn
+        self.cfg = cfg
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self.owns = owns_finalizer
+        self.fsm = fsm
+        self._reported: set = set()
+
+    # -- classification ------------------------------------------------------
+
+    def _is_finalizer(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        return bool(chain) and chain[-1] in self.fsm.finalizers
+
+    def _is_drain(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        return bool(chain) and chain[-1] == self.fsm.drain
+
+    def _is_shedding(self, call: ast.Call) -> bool:
+        chain = _attr_chain(call.func)
+        if not chain:
+            return False
+        if chain[-1] in self.fsm.shedding_calls:
+            return True
+        return (chain[-1] in self.fsm.shedding_scheduler_calls
+                and len(chain) >= 2 and chain[-2] == "scheduler")
+
+    def _is_transition(self, call: ast.Call) -> tuple:
+        chain = _attr_chain(call.func)
+        if (len(chain) >= 2 and chain[-1] in self.fsm.transition_calls
+                and chain[-2] == "scheduler"):
+            target = call.args[0].id if (
+                call.args and isinstance(call.args[0], ast.Name)) else None
+            return True, target
+        return False, None
+
+    # -- transfer ------------------------------------------------------------
+
+    def _apply(self, state: frozenset, node: _Node) -> frozenset:
+        stmt = node.payload
+        if node.kind != "stmt" or not isinstance(stmt, ast.stmt):
+            return state
+        obs = set(state)
+        calls = _own_calls(stmt)
+        for c in calls:
+            if self._is_finalizer(c):
+                args: set = set()
+                for a in c.args:
+                    args |= _names_in(a)
+                obs = {o for o in obs
+                       if not (o[0] in ("finalize", "shedB")
+                               and (not args or o[1] in args
+                                    or o[1] is None))}
+            if self._is_drain(c):
+                obs = {o for o in obs if o[0] != "drain"}
+            chain = _attr_chain(c.func)
+            if len(chain) >= 2 and chain[-1] == "append" \
+                    and chain[-2] == self.fsm.shed_log:
+                obs = {o for o in obs if o[0] != "shedB"}
+        code_target = _shed_code_assign(stmt, self.fsm)
+        if code_target is not None:
+            obs = {o for o in obs
+                   if not (o[0] == "code" and o[1] == code_target)}
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            names = _names_in(stmt.value)
+            obs = {o for o in obs
+                   if not (o[0] == "shedB" and o[1] in names)}
+        # new obligations AFTER discharges (same-statement protocols are
+        # not real code; ordering keeps `x.shed_code = c` before
+        # `x.status = EXPIRED` working via the source-order heuristic)
+        target, status = _terminal_assign(stmt, self.fsm)
+        if target is not None:
+            if self.owns:
+                obs.add(("finalize", target, stmt.lineno, status))
+            elif status in self.fsm.shed:
+                obs.add(("shedB", target, stmt.lineno, status))
+            if status in self.fsm.shed \
+                    and not self._code_set_before(target, stmt.lineno):
+                obs.add(("code", target, stmt.lineno, status))
+        if self.owns:
+            for c in calls:
+                if self._is_shedding(c):
+                    obs.add(("drain", None, c.lineno, "shed"))
+                ok, t = self._is_transition(c)
+                if ok:
+                    obs.add(("finalize", t, c.lineno, "transition"))
+        return frozenset(obs)
+
+    def _code_set_before(self, target: str, line: int) -> bool:
+        """Source-order heuristic for 'shed_code was already set': real
+        code sets it adjacent to the status; a dominating earlier
+        assignment is accepted without path analysis."""
+        for n in _outer_walk(self.fn):
+            if isinstance(n, ast.Assign) \
+                    and getattr(n, "lineno", 1 << 30) < line \
+                    and _shed_code_assign(n, self.fsm) == target:
+                return True
+        return False
+
+    def _branch_state(self, state: frozenset, label: Any) -> frozenset:
+        """Attach `if scheduler.cancel(r):`-style obligations to the
+        branch where the transition actually happened — and REMOVE the
+        node-level copy from the other branch."""
+        if not (isinstance(label, tuple) and label and label[0] == "cond"
+                and self.owns):
+            return state
+        _, test, branch = label
+        if test is None:
+            return state
+        t = test
+        flip = False
+        while isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+            t = t.operand
+            flip = not flip
+        want = branch if not flip else not branch
+        lines_here = set()
+        adds = set()
+        for c in (x for x in ast.walk(t) if isinstance(x, ast.Call)):
+            ok, target = self._is_transition(c)
+            if ok:
+                lines_here.add(c.lineno)
+                adds.add(("finalize", target, c.lineno, "transition"))
+        if not adds:
+            return state
+        pruned = {o for o in state
+                  if not (o[0] == "finalize" and o[3] == "transition"
+                          and o[2] in lines_here)}
+        return frozenset(pruned | adds) if want else frozenset(pruned)
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> None:
+        relevant = self.owns
+        for n in _outer_walk(self.fn):
+            if isinstance(n, ast.Name) and n.id == self.fsm.status_enum:
+                relevant = True
+                break
+        if not relevant:
+            return
+        cfg = self.cfg
+        in_states: dict = {cfg.entry: frozenset()}
+        work = [cfg.entry]
+        while work:
+            idx = work.pop()
+            node = cfg.nodes[idx]
+            out = self._apply(in_states.get(idx, frozenset()), node)
+            for succ, label in node.succ:
+                if label == "exc":
+                    continue
+                nxt = self._branch_state(out, label) \
+                    if node.kind == "branch" else out
+                if succ not in in_states:
+                    in_states[succ] = nxt
+                    work.append(succ)
+                    continue
+                prev = in_states[succ]
+                merged = prev | nxt
+                if merged != prev:
+                    in_states[succ] = merged
+                    work.append(succ)
+        self._check_drain_loops()
+        for kind, target, line, status in in_states.get(cfg.exit,
+                                                        frozenset()):
+            if kind in ("finalize", "shedB"):
+                what = (f"scheduler transition call on {target!r}"
+                        if status == "transition"
+                        else f"terminal transition ({status}) on {target!r}")
+                where = ("a finalizer ("
+                         + " / ".join(self.fsm.finalizers) + ")"
+                         if kind == "finalize"
+                         else f"{self.fsm.shed_log}.append or returning "
+                              "the handle")
+                self._report("ATP211", line,
+                             f"{what} at line {line} can reach the function "
+                             f"exit without {where} — metrics/trace closure "
+                             "silently skipped on that path",
+                             data={"state": status, "target": target,
+                                   "span": [line, self._fn_end()]})
+            elif kind == "drain":
+                self._report("ATP211", line,
+                             "a call that may shed requests internally "
+                             f"(line {line}) is never followed by "
+                             f"{self.fsm.drain}() — sheds on that path "
+                             "never reach metrics (the PR 6 undercount "
+                             "class)",
+                             data={"state": "shed",
+                                   "span": [line, self._fn_end()]})
+            elif kind == "code":
+                self._report("ATP212", line,
+                             f"{status} transition on {target!r} never sets "
+                             f"`{target}.{self.fsm.shed_code_attr}` — the "
+                             "shed is invisible to machine-readable shed "
+                             "accounting",
+                             data={"state": status, "target": target,
+                                   "span": [line, self._fn_end()]})
+
+    def _check_drain_loops(self) -> None:
+        for n in _outer_walk(self.fn):
+            if isinstance(n, (ast.For, ast.AsyncFor)) and any(
+                    self._is_drain(c) for c in ast.walk(n.iter)
+                    if isinstance(c, ast.Call)):
+                if not any(self._is_finalizer(c)
+                           for b in n.body for c in ast.walk(b)
+                           if isinstance(c, ast.Call)):
+                    self._report(
+                        "ATP211", n.lineno,
+                        f"loop over {self.fsm.drain}() whose body never "
+                        "calls a finalizer — drained sheds are dropped "
+                        "without metrics/trace closure",
+                        data={"state": "drain-loop",
+                              "span": [n.lineno,
+                                       getattr(n, "end_lineno", n.lineno)]})
+            if isinstance(n, ast.Expr) and isinstance(n.value, ast.Call) \
+                    and self._is_drain(n.value):
+                self._report(
+                    "ATP211", n.lineno,
+                    f"{self.fsm.drain}() result discarded — the drained "
+                    "sheds never reach a finalizer",
+                    data={"state": "drain-discard",
+                          "span": [n.lineno, n.lineno]})
+
+    def _fn_end(self) -> int:
+        return getattr(self.fn, "end_lineno", getattr(self.fn, "lineno", 0))
+
+    def _report(self, rule: str, line: int, message: str,
+                data: dict | None = None) -> None:
+        key = (rule, line, message)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        src = self.lines[line - 1].strip() \
+            if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(
+            rule=rule, message=message, path=self.path, line=line,
+            source=src, data=data))
+
+
+# ---------------------------------------------------------------------------
+# ATP221: thread confinement
+# ---------------------------------------------------------------------------
+
+
+def _lint_thread_confinement(tree: ast.Module, path: str, lines: list,
+                             findings: list,
+                             entries: ThreadEntries = THREAD_ENTRIES) -> None:
+    """Per class: functions reachable from a thread registration must not
+    assign `self.<attr>`s that non-thread methods also assign, unless the
+    assignment sits under a `with <...lock...>:`. `__init__` and
+    `__post_init__` run happens-before the thread and are exempt on the
+    drive side."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        fns: dict = {}
+
+        def collect(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    fns.setdefault(child.name, []).append(child)
+                    collect(child)
+                elif not isinstance(child, ast.ClassDef):
+                    collect(child)
+
+        collect(cls)
+        if not fns:
+            continue
+        entry_names: set = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if not chain or chain[-1] not in entries.constructors:
+                continue
+            for kw in node.keywords:
+                if kw.arg in entries.kwargs:
+                    vchain = _attr_chain(kw.value)
+                    if vchain:
+                        entry_names.add(vchain[-1])
+        entry_names &= set(fns)
+        if not entry_names:
+            continue
+        # closure over same-class references — calls OR bare references
+        # (`dumps=self.build` style indirection counts)
+        thread_fns: set = set(entry_names)
+        changed = True
+        while changed:
+            changed = False
+            for name in list(thread_fns):
+                for fn in fns.get(name, []):
+                    for node in ast.walk(fn):
+                        ref = None
+                        if isinstance(node, ast.Attribute) \
+                                and isinstance(node.value, ast.Name) \
+                                and node.value.id == "self":
+                            ref = node.attr
+                        elif isinstance(node, ast.Name):
+                            ref = node.id
+                        if ref in fns and ref not in thread_fns:
+                            thread_fns.add(ref)
+                            changed = True
+
+        def self_assigns(fn) -> list:
+            """[(attr, line, locked)] for direct `self.x = ...` /
+            `self.x += ...` in fn (nested defs excluded — they are their
+            own context)."""
+            out = []
+            locked_ranges = []
+            nodes = _outer_walk(fn)
+            for node in nodes:
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    expr_txt = " ".join(
+                        ".".join(_attr_chain(i.context_expr)) or ""
+                        for i in node.items).lower()
+                    if "lock" in expr_txt:
+                        locked_ranges.append(
+                            (node.lineno,
+                             getattr(node, "end_lineno", node.lineno)))
+            for node in nodes:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        line = node.lineno
+                        locked = any(a <= line <= b
+                                     for a, b in locked_ranges)
+                        out.append((t.attr, line, locked))
+            return out
+
+        drive_attrs: dict = {}
+        for name, defs in fns.items():
+            if name in thread_fns or name in ("__init__", "__post_init__"):
+                continue
+            for fn in defs:
+                for attr, line, locked in self_assigns(fn):
+                    if not locked:
+                        drive_attrs.setdefault(attr, (name, line))
+        reported: set = set()
+        for name in sorted(thread_fns):
+            for fn in fns.get(name, []):
+                for attr, line, locked in self_assigns(fn):
+                    if locked or attr not in drive_attrs \
+                            or (attr, line) in reported:
+                        continue
+                    reported.add((attr, line))
+                    other = drive_attrs[attr]
+                    src = lines[line - 1].strip() \
+                        if 0 < line <= len(lines) else ""
+                    findings.append(Finding(
+                        rule="ATP221",
+                        message=(
+                            f"`self.{attr}` is assigned from thread context "
+                            f"`{name}` AND from drive-loop code "
+                            f"(`{other[0]}`, line {other[1]}) with no lock "
+                            "— route the mutation through the drive task "
+                            "or guard both sides with one lock"),
+                        path=path, line=line, source=src,
+                        data={"attribute": attr, "thread_fn": name,
+                              "drive_fn": other[0],
+                              "span": [line, other[1]]}))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def _functions_with_owners(tree: ast.Module) -> list:
+    """[(fn_node, enclosing ClassDef|None)] for every function/method."""
+    out: list = []
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, cls))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    walk(tree, None)
+    return out
+
+
+def lint_lifecycle(tree: ast.Module, text: str, path: str,
+                   lines: list, findings: list,
+                   fsm: RequestFSM = REQUEST_FSM,
+                   table=PAIRING_TABLE) -> None:
+    """Run the ATP2xx passes over one parsed module. Text pre-gates keep
+    the cost near zero on modules that mention none of the protocols."""
+    run_pairing = any(m in text for pair in table for m in pair.acquire)
+    run_fsm = fsm.status_enum in text \
+        or any(name in text for name in fsm.finalizers)
+    run_threads = any(c + "(" in text for c in THREAD_ENTRIES.constructors)
+    if not (run_pairing or run_fsm or run_threads):
+        return
+    fns = _functions_with_owners(tree)
+    finalizer_classes = {cls for fn, cls in fns
+                         if cls is not None and fn.name in fsm.finalizers}
+    for fn, cls in fns:
+        needs_pairing = run_pairing and any(
+            isinstance(c, ast.Call)
+            and _match_pair_call(c, table)[0] is not None
+            for c in _outer_walk(fn))
+        owns = cls in finalizer_classes
+        needs_fsm = (run_fsm or owns) and fn.name not in fsm.finalizers
+        if not (needs_pairing or needs_fsm):
+            continue
+        cfg = _CFGBuilder(table=table).build(fn)
+        if needs_pairing:
+            _PairingPass(fn, cfg, path, lines, findings, table=table).run()
+        if needs_fsm:
+            _FSMPass(fn, cfg, path, lines, findings,
+                     owns_finalizer=owns, fsm=fsm).run()
+    if run_threads:
+        _lint_thread_confinement(tree, path, lines, findings)

@@ -560,6 +560,11 @@ def lint_text(text: str, path: str = "<string>") -> list[Finding]:
         _TracedFunctionLinter(
             fn, statics, path, lines, findings, direct=direct).run()
     _lint_donation_aliasing(tree, text, path, lines, findings)
+    # ATP2xx: host-side lifecycle passes (paired resources, request FSM,
+    # thread confinement) — same Finding currency, same pipeline
+    from .lifecycle import lint_lifecycle
+
+    lint_lifecycle(tree, text, path, lines, findings)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 

@@ -43,7 +43,9 @@ def register_subcommand(subparsers) -> None:
              "exit 0")
     parser.add_argument(
         "--rules", default=None, metavar="IDS",
-        help="comma-separated rule IDs to run (default: all source rules)")
+        help="comma-separated rule IDs or group prefixes to run — e.g. "
+             "'ATP001,ATP006' or 'atp2' for the whole ATP2xx lifecycle "
+             "family (default: all source rules)")
     parser.add_argument(
         "--root", default=None,
         help="directory findings paths are reported relative to "
@@ -58,8 +60,21 @@ def run_lint(args: argparse.Namespace) -> int:
     try:
         rules = None
         if args.rules:
-            rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-            unknown = rules - set(RULES)
+            rules = set()
+            unknown = set()
+            for token in (r.strip() for r in args.rules.split(",")):
+                if not token:
+                    continue
+                tok = token.upper()
+                if tok in RULES:
+                    rules.add(tok)
+                    continue
+                # group prefix: 'atp2' -> every ATP2xx rule
+                group = {rid for rid in RULES if rid.startswith(tok)}
+                if group:
+                    rules |= group
+                else:
+                    unknown.add(token)
             if unknown:
                 print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
                       file=sys.stderr)

@@ -27,6 +27,7 @@ from .cache import (
 )
 from .engine import Engine, EngineConfig
 from .metrics import ServingMetrics
+from .sanitizer import SanitizerViolation
 from .scheduler import (
     Request,
     RequestStatus,
@@ -68,6 +69,7 @@ __all__ = [
     "PagePool",
     "PrefixIndex",
     "ServingMetrics",
+    "SanitizerViolation",
     "Scheduler",
     "Request",
     "RequestStatus",

@@ -786,15 +786,24 @@ class PagedAllocator:
         # acquire BEFORE evicting: matched nodes are refcount-0 until
         # mapped, and eviction must never free a page we are about to use
         self.index.acquire(nodes)
-        private = self.pool.alloc(n_private)
-        if private is None:
-            freed = self.index.evict_lru(n_private - self.pool.free_count)
-            if freed:
-                self.evictions += len(freed)
-                self.pool.release(freed)
-                if self.on_evict is not None:
-                    self.on_evict(len(freed))
+        try:
             private = self.pool.alloc(n_private)
+            if private is None:
+                freed = self.index.evict_lru(
+                    n_private - self.pool.free_count)
+                if freed:
+                    self.evictions += len(freed)
+                    self.pool.release(freed)
+                    if self.on_evict is not None:
+                        self.on_evict(len(freed))
+                private = self.pool.alloc(n_private)
+        except BaseException:
+            # on_evict is a caller-supplied callback: if it raises
+            # mid-allocate the matched nodes' refcounts must not leak
+            # (they would pin their whole root paths unevictable forever
+            # — the ATP201 self-lint finding this handler exists for)
+            self.index.release(nodes)
+            raise
         if private is None:
             self.index.release(nodes)
             return None
@@ -859,11 +868,20 @@ class PagedAllocator:
         rest — generation pages, the partial last prompt page, and pages
         whose chunks a concurrent request cached first — go back to the
         free list. `finished=False` (cancel) caches nothing: a
-        mid-prefill page may hold garbage."""
+        mid-prefill page may hold garbage.
+
+        The insertable range is additionally capped at the slot's
+        PREFILLED prompt, not the whole prompt: `finish_early` can
+        retire a slot whose prefill is still mid-flight (a server-side
+        stop decision), and inserting pages past `prompt_done` would
+        cache never-written garbage KV that a later prefix hit serves
+        as real prompt state — silent corruption, surfaced while
+        building the ATP2xx/sanitizer audit and pinned model-free in
+        test_paged_cache."""
         alloc, req = slot.alloc, slot.request
         self.index.release(alloc.nodes)
         n_cached = len(alloc.nodes)
-        full = req.prompt_len // self.page_size \
+        full = min(req.prompt_len, slot.prompt_done) // self.page_size \
             if (finished and self.prefix_cache) else n_cached
         spare = (self.index.insert(req.prompt, alloc.pages, full)
                  if full > n_cached else [])

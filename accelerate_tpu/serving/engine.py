@@ -109,6 +109,7 @@ from .cache import (
     write_slot,
 )
 from .metrics import ServingMetrics
+from .sanitizer import SanitizerViolation, check_engine, resolve_sanitize
 from .scheduler import Request, Scheduler, Slot, SlotState
 
 __all__ = ["Engine", "EngineConfig"]
@@ -287,6 +288,20 @@ class EngineConfig:
     # analysis_findings_total{rule=...}.
     strict: str | None = None
     contracts: Any = None
+    # serving-state sanitizer (the runtime half of the ATP2xx lifecycle
+    # audit, serving/sanitizer.py): after every engine step validate the
+    # cross-structure invariants static analysis can't see — page
+    # conservation across free list / radix tree / slot allocations,
+    # refcounts vs live mappings (downward-closed along root paths),
+    # device page-table discipline, length bounds, scheduler books.
+    # Host-side only: programs and compile counts are untouched (pinned
+    # by test). A violation raises SanitizerViolation with the broken
+    # invariant named, after writing an incident bundle when
+    # `incident_dir` is configured. None defers to the
+    # ACCELERATE_TPU_SANITIZE env var (the test suite turns it on for
+    # every tier-1 engine); default off in production — the checks walk
+    # the whole tree each step.
+    sanitize: Any = None
     # SPMD serving (serving/pod layer 1): a `jax.sharding.Mesh` with a
     # "model" axis. The engine then places its KV pool (sharded over KV
     # heads when they divide the axis, replicated otherwise) and its
@@ -434,6 +449,7 @@ class Engine:
         # name -> None (audited clean/warned) | AnalysisViolation (cached:
         # re-raised on every later use without re-counting the findings)
         self._audited: dict = {}
+        self._sanitize = resolve_sanitize(ec.sanitize)
 
         num_layers, num_kv, head_dim = _cache_spec(config)
         # pad_slack covers BOTH overshoot sources: chunk padding can spill
@@ -1042,6 +1058,8 @@ class Engine:
         action = self.scheduler.next_action()
         if action is None:
             self.metrics.stopped_at = self._clock()
+            if self._sanitize:
+                self._sanity_check()
             return False
         t0 = self._clock()
         if action[0] == "prefill":
@@ -1059,7 +1077,41 @@ class Engine:
         # host float ops — the device never sees it)
         self._goodput()
         self._maybe_log()
+        if self._sanitize:
+            self._sanity_check()
         return True
+
+    def _sanity_check(self) -> None:
+        """Run the serving-state sanitizer (EngineConfig(sanitize=True)):
+        cross-structure invariants after this step. On a violation the
+        incident-bundle machinery captures the engine's debug state
+        before the structured SanitizerViolation propagates."""
+        try:
+            check_engine(self)
+        except SanitizerViolation as e:
+            self._write_sanitizer_incident(e)
+            raise
+
+    def _write_sanitizer_incident(self, e: SanitizerViolation) -> None:
+        from ..telemetry.watchdog import (
+            build_exception_report,
+            resolve_incident_dir,
+            write_incident_bundle,
+        )
+
+        incident_dir = resolve_incident_dir(
+            self.engine_config.incident_dir)
+        if incident_dir is None:
+            return
+        try:
+            report = build_exception_report(e, name="sanitizer")
+            report["check"] = e.check
+            report["details"] = e.details
+            write_incident_bundle(
+                incident_dir, report, registry=self.registry,
+                dumps=self.incident_dumps(), name="sanitizer")
+        except Exception:
+            pass  # the violation itself must still propagate
 
     def run_until_idle(self) -> None:
         while self.step():

@@ -60,7 +60,14 @@ from ..engine import (
     prepare_request_tracing,
 )
 from ..metrics import ServingMetrics
-from ..scheduler import Request, RequestStatus, Scheduler, SlotState
+from ..sanitizer import check_router, resolve_sanitize
+from ..scheduler import (
+    Request,
+    RequestStatus,
+    Scheduler,
+    SHED_WORKER_DROP,
+    SlotState,
+)
 from .mesh import shard_params, tensor_mesh
 from .transfer import PageTransport
 
@@ -196,6 +203,7 @@ class PodRouter:
         self._transports_p = [PageTransport(w) for w in self.prefill_workers]
         self._transports_d = [PageTransport(w) for w in self.decode_workers]
 
+        self._sanitize = resolve_sanitize(ec.sanitize)
         self._flights: dict[int, _Flight] = {}   # id(user) -> flight
         # id(internal) -> page list, written by the admit hook the moment
         # a prefill worker maps the request (popped at harvest/cancel)
@@ -389,6 +397,11 @@ class PodRouter:
             live = sum(w.scheduler.live_slots for w in self.decode_workers)
             cap = sum(len(w.scheduler.slots) for w in self.decode_workers)
             self.metrics.observe_step(live, cap, self.scheduler.queue_depth)
+        if self._sanitize:
+            # router-level joins (flights vs pending vs admit snapshots
+            # vs front queue); worker engines sanitize themselves inside
+            # their own step()
+            check_router(self)
         return worked
 
     def run_until_idle(self) -> None:
@@ -505,6 +518,11 @@ class PodRouter:
                 user.reject_reason = (
                     f"prefill worker {widx} dropped the request "
                     f"({internal.status.value})")
+                # every shed carries the machine-readable vocabulary +
+                # a backoff hint — this path undercounted both (the
+                # ATP212 self-lint finding)
+                user.shed_code = SHED_WORKER_DROP
+                user.retry_after_s = self.scheduler.retry_after_estimate()
                 user.finished_at = now
                 self._finalize(user)
                 continue
@@ -579,10 +597,13 @@ class PodRouter:
                 key=shipment.key_raw,
                 eos_token_id=user.eos_token_id,
             )
+            # clock BEFORE the page reservation: nothing that can raise
+            # may sit between allocate and the adopt/rollback pair that
+            # owns its outcome (the ATP201 exception-window class)
+            now = self._clock()
             alloc = engine.allocator.allocate(internal)
             if alloc is None:
                 continue
-            now = self._clock()
             internal.submitted_at = now
             slot = engine.scheduler.adopt_running(internal, alloc, now=now)
             if slot is None:               # raced: give the pages back

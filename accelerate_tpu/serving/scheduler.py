@@ -81,6 +81,7 @@ SHED_DEADLINE = "deadline"                  # caller's queue-wait deadline
 SHED_CERTAIN_MISS = "certain_miss"          # TTFT SLO unreachable even now
 SHED_PRESSURE_VICTIM = "pressure_victim"    # worst-slack victim under pressure
 SHED_DISPLACED = "displaced_by_tier"        # bumped by a higher-tier arrival
+SHED_WORKER_DROP = "worker_drop"            # a pod worker dropped the request
 
 
 class SlotState(enum.Enum):
@@ -585,12 +586,16 @@ class Scheduler:
                 alloc = self.allocator.allocate(self._queues[name][0])
                 if alloc is None:
                     break
+                # attach the reservation to its slot IMMEDIATELY: any
+                # raise between allocate and attachment would strand the
+                # pages outside both the slot table and the free list
+                # (the ATP201 exception-window class)
+                slot.alloc = alloc
             req = self._pop_selected(name)
             req.status = RequestStatus.RUNNING
             req.admitted_at = now
             slot.request = req
             slot.state = SlotState.PREFILL
-            slot.alloc = alloc
             slot.prompt_done = alloc.reused_len if alloc is not None else 0
             admitted.append((slot, req))
         return admitted

@@ -1,0 +1,44 @@
+"""ATP201 negative: every path balances, escapes ownership, or releases
+in a handler — the idioms the pass must accept."""
+
+
+class CleanAdmission:
+    def balanced(self, request):
+        pages = self.pool.alloc(4)
+        if pages is None:
+            return None
+        self.pool.release(pages)
+        return True
+
+    def ownership_returned(self, request, nodes):
+        self.index.acquire(nodes)
+        return self.build(nodes)    # ownership transfers out immediately
+
+    def handler_releases(self, request):
+        nodes = self.index.match(request.prompt)
+        self.index.acquire(nodes)
+        try:
+            self.record(request)
+        except BaseException:
+            self.index.release(nodes)
+            raise
+        self.index.release(nodes)
+
+    def attached_to_slot(self, slot, request):
+        alloc = self.allocator.allocate(request)
+        if alloc is None:
+            return False
+        slot.alloc = alloc                  # escape: the slot owns it now
+        self.pop(request)
+        return True
+
+    def rollback_after_refused_adopt(self, engine, internal):
+        alloc = engine.allocator.allocate(internal)
+        if alloc is None:
+            return False
+        slot = engine.scheduler.adopt_running(internal, alloc)
+        if slot is None:
+            engine.allocator.rollback(alloc)   # consumer refused: legal
+            return False
+        self.install(slot)                     # the slot is put to work
+        return True

@@ -1,0 +1,29 @@
+"""ATP201 positive: paired-resource leaks on early-return and exception
+paths (acceptance fixture). Three shapes, all real bug classes:
+an early return skipping the release, an exception between acquire and
+release with no handler, and a void acquire (refcount) leaking at
+fall-through."""
+
+
+class LeakyAdmission:
+    def early_return_leak(self, request):
+        pages = self.pool.alloc(4)
+        if pages is None:
+            return None
+        if request.cancelled:
+            return False          # leak: pages never released/attached
+        self.pool.release(pages)
+        return True
+
+    def exception_window_leak(self, request):
+        nodes = self.index.match(request.prompt)
+        self.index.acquire(nodes)
+        self.record(request)      # may raise: refcounts leak
+        self.index.release(nodes)
+
+    def fall_through_leak(self, request):
+        alloc = self.allocator.allocate(request)
+        if alloc is None:
+            return
+        self.note(len(alloc.pages))   # len() is no-raise: not the leak
+        # falls off the end holding the allocation

@@ -1,0 +1,21 @@
+"""ATP202 negative: one release per acquire per path — including the
+branchy shape where each arm releases once, and a loop that re-acquires
+each iteration."""
+
+
+class SingleRelease:
+    def one_arm_each(self, request):
+        pages = self.pool.alloc(2)
+        if pages is None:
+            return
+        if request.cancelled:
+            self.pool.release(pages)
+            return
+        self.pool.release(pages)
+
+    def loop_reacquires(self, requests):
+        for request in requests:
+            pages = self.pool.alloc(1)
+            if pages is None:
+                break
+            self.pool.release(pages)

@@ -1,0 +1,20 @@
+"""ATP202 positive: the same locally-acquired handle released twice on
+one path — the refcount-underflow / double-free class."""
+
+
+class DoubleRelease:
+    def release_twice(self, request):
+        nodes = self.index.match(request.prompt)
+        self.index.acquire(nodes)
+        self.index.release(nodes)
+        self.index.release(nodes)      # underflow: already balanced
+
+    def release_in_both_arms_then_again(self, request):
+        pages = self.pool.alloc(2)
+        if pages is None:
+            return
+        if request.cancelled:
+            self.pool.release(pages)
+        else:
+            self.pool.release(pages)
+        self.pool.release(pages)       # double on every path

@@ -1,0 +1,20 @@
+"""ATP203 negative: release mirrors the acquire's condition, and
+release-only functions (ownership passed IN from the caller) are the
+other end of a cross-function protocol — not a finding."""
+
+
+class SymmetricProtocol:
+    def mirrored_condition(self, request, cached):
+        nodes = self.index.match(request.prompt)
+        if cached:
+            self.index.acquire(nodes)
+        count = len(request.prompt)   # no-raise work between the arms
+        if cached:
+            self.index.release(nodes)
+        return count
+
+    def release_only(self, slot):
+        # the acquire happened at admission, in another function: the
+        # caller handed us ownership, releasing it here is the protocol
+        self.index.release(slot.nodes)
+        self.pool.release(slot.pages)

@@ -1,0 +1,11 @@
+"""ATP203 positive: the acquire is conditional but the release is not —
+on the no-acquire path the release underflows someone else's refcount."""
+
+
+class AsymmetricProtocol:
+    def conditional_acquire(self, request, cached):
+        nodes = self.index.match(request.prompt)
+        if cached:
+            self.index.acquire(nodes)
+        self.warm(request)
+        self.index.release(nodes)      # no acquire on the not-cached path

@@ -1,0 +1,46 @@
+"""ATP211 negative: every terminal path routes through the finalizer,
+sheds are drained into it, and scheduler-side sheds reach the shed_log
+or return the handle to the finalizing caller."""
+class RequestStatus:
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    EXPIRED = "expired"
+
+
+class CleanEngine:
+    def _finalize_request(self, req):
+        self.metrics.observe_request(req)
+
+    def drop_with_finalize(self, req):
+        req.status = RequestStatus.CANCELLED
+        req.finished_at = self.clock()
+        self._finalize_request(req)
+
+    def cancel_finalizes(self, request):
+        if self.scheduler.cancel(request):
+            self._finalize_request(request)
+            return True
+        return False
+
+    def submit_drains(self, req):
+        self.scheduler.submit(req)
+        for victim in self.scheduler.drain_shed():
+            self._finalize_request(victim)
+        if req.done:
+            self._finalize_request(req)
+        return req
+
+
+class CleanScheduler:
+    # no finalizer here: the scheduler's contract is to LOG the shed (or
+    # return the handle) so the engine finalizes it
+    def shed(self, req, now):
+        req.status = RequestStatus.EXPIRED
+        req.shed_code = "deadline"
+        self.shed_log.append(req)
+
+    def reject(self, request):
+        request.status = RequestStatus.REJECTED
+        request.shed_code = "queue_full"
+        return request

@@ -1,0 +1,35 @@
+"""ATP211 positive: terminal transitions that bypass the finalizer —
+the metrics/trace undercount class (PR 6 shed_log, PR 8
+_finalize_request). Four shapes: a terminal assignment with no finalize,
+a conditional scheduler transition whose success arm forgets to
+finalize, a shedding call never drained, and a drain loop that drops its
+victims."""
+class RequestStatus:
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    EXPIRED = "expired"
+
+
+class LeakyEngine:
+    def _finalize_request(self, req):
+        self.metrics.observe_request(req)
+
+    def drop_without_finalize(self, req):
+        req.status = RequestStatus.CANCELLED
+        req.finished_at = self.clock()      # never reaches the finalizer
+
+    def cancel_forgets_finalize(self, request):
+        if self.scheduler.cancel(request):
+            return True                     # transition done, no finalize
+        return False
+
+    def submit_never_drains(self, req):
+        self.scheduler.submit(req)
+        if req.done:
+            self._finalize_request(req)     # the newcomer, yes...
+        return req                          # ...but victims never drained
+
+    def drain_drops_victims(self):
+        for victim in self.scheduler.drain_shed():
+            self.log.append(victim.request_id)   # logged, not finalized

@@ -1,0 +1,29 @@
+"""ATP212 negative: shed transitions carry their shed_code (before or
+after the status line), and non-shed terminals need none."""
+class RequestStatus:
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    EXPIRED = "expired"
+
+
+class CodedShed:
+    def _finalize(self, req):
+        self.metrics.observe_request(req)
+
+    def worker_drop(self, user, now):
+        user.status = RequestStatus.EXPIRED
+        user.reject_reason = "worker dropped the request"
+        user.shed_code = "worker_drop"
+        user.finished_at = now
+        self._finalize(user)
+
+    def code_set_first(self, user, now):
+        user.shed_code = "deadline"
+        user.status = RequestStatus.EXPIRED
+        self._finalize(user)
+
+    def finished_needs_no_code(self, user, now):
+        user.status = RequestStatus.FINISHED
+        user.finished_at = now
+        self._finalize(user)

@@ -1,0 +1,19 @@
+"""ATP212 positive: a shed transition (REJECTED/EXPIRED) that never sets
+the machine-readable shed_code — this shed is invisible to the shed
+vocabulary, clients get no structured reason, dashboards undercount."""
+class RequestStatus:
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    REJECTED = "rejected"
+    EXPIRED = "expired"
+
+
+class UncodedShed:
+    def _finalize(self, req):
+        self.metrics.observe_request(req)
+
+    def worker_drop(self, user, now):
+        user.status = RequestStatus.EXPIRED
+        user.reject_reason = "worker dropped the request"   # prose only
+        user.finished_at = now
+        self._finalize(user)

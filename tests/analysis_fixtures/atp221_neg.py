@@ -1,0 +1,26 @@
+"""ATP221 negative: the accepted idioms — thread-side reads with
+drive-side writes, mutations guarded by one lock on both sides, and a
+read-only dumps callback handed to the watchdog."""
+import threading
+
+
+class ConfinedServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue_depth = 0           # __init__ happens-before the thread
+        self.watchdog = StallWatchdog(5.0, dumps=self.snapshot)
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+
+    def _poll(self):
+        while not self._stop:
+            with self._lock:
+                self.queue_depth = self.backlog()   # locked: fine
+
+    def step(self):
+        with self._lock:
+            self.queue_depth = len(self.scheduler.queue)
+        return self.queue_depth
+
+    def snapshot(self):
+        # read-only view from the watchdog thread: no writes, no finding
+        return {"depth": self.queue_depth, "slots": list(self.slots)}

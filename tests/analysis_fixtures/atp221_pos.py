@@ -1,0 +1,19 @@
+"""ATP221 positive: engine state mutated BOTH from a registered thread
+context (Thread target / watchdog dumps callback) and from drive-loop
+methods, with no lock — a data race the event-loop confinement rule
+exists to catch."""
+import threading
+
+
+class RacyServer:
+    def start(self):
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self):
+        while not self._stop:
+            self.queue_depth = self.backlog()   # thread-side write
+
+    def step(self):
+        self.queue_depth = len(self.scheduler.queue)   # drive-side write
+        return self.queue_depth

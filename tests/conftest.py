@@ -45,6 +45,13 @@ os.environ.setdefault(
 )
 configure_compilation_cache()
 
+# Serving-state sanitizer (ISSUE 13): every engine the suite builds
+# validates its cross-structure invariants (page conservation, refcount
+# closure, table discipline, scheduler books) after each step — the
+# whole serving/speculative/pod surface runs sanitized in tier-1.
+# Host-side only; compile counts are pinned flat with this on.
+os.environ.setdefault("ACCELERATE_TPU_SANITIZE", "1")
+
 
 def pytest_collection_modifyitems(config, items):
     """Gate @pytest.mark.slow behind RUN_SLOW=1 (ref testing.py slow

@@ -49,6 +49,10 @@ FIXTURES = os.path.join(TESTS_DIR, "analysis_fixtures")
 BASELINE = os.path.join(TESTS_DIR, "analysis_baseline.json")
 
 ALL_RULE_IDS = [f"ATP00{i}" for i in range(1, 9)]
+# ATP2xx (ISSUE 13): the lifecycle auditor — paired resources, request
+# FSM, thread confinement — same fixture scheme, same pipeline
+LIFECYCLE_RULE_IDS = ["ATP201", "ATP202", "ATP203",
+                      "ATP211", "ATP212", "ATP221"]
 
 
 # ---------------------------------------------------------------------------
@@ -57,13 +61,13 @@ ALL_RULE_IDS = [f"ATP00{i}" for i in range(1, 9)]
 
 
 class TestSourceRules:
-    @pytest.mark.parametrize("rule", ALL_RULE_IDS)
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS)
     def test_positive_fixture_fires(self, rule):
         path = os.path.join(FIXTURES, f"{rule.lower()}_pos.py")
         got = {f.rule for f in lint_file(path)}
         assert rule in got, f"{path} did not produce {rule} (got {got})"
 
-    @pytest.mark.parametrize("rule", ALL_RULE_IDS)
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS)
     def test_negative_fixture_is_clean(self, rule):
         path = os.path.join(FIXTURES, f"{rule.lower()}_neg.py")
         found = [f for f in lint_file(path) if f.rule == rule]
@@ -314,6 +318,31 @@ class TestSelfLint:
             "accelerate_tpu/server must be inside the self-lint tree"
         assert any(f.endswith("service.py") for f in server_files)
 
+    def test_self_lint_gate_covers_the_pod_package(self):
+        """ISSUE 13: serving/pod/ is where the lifecycle passes found
+        their genuine bugs — a tree-walk exclusion that silently dropped
+        it would un-audit exactly the router code the ATP2xx family
+        exists for."""
+        from accelerate_tpu.analysis.runner import iter_python_files
+
+        files = iter_python_files(os.path.join(REPO, "accelerate_tpu"))
+        pod_files = [f for f in files
+                     if (os.sep + "serving" + os.sep + "pod" + os.sep) in f]
+        for name in ("router.py", "transfer.py", "mesh.py"):
+            assert any(f.endswith(name) for f in pod_files), \
+                f"serving/pod/{name} must be inside the self-lint tree"
+
+    def test_self_lint_gate_runs_the_lifecycle_rules(self):
+        """The gate runs with NO rule restriction, so the ATP2xx passes
+        are part of it by construction — pinned by planting a
+        known-leaky file next to the tree and asserting lint_target's
+        pipeline reports its ATP201."""
+        for rid in LIFECYCLE_RULE_IDS:
+            assert rid in RULES, rid
+        findings = lint_paths(
+            [os.path.join(FIXTURES, "atp201_pos.py")], root=REPO)
+        assert any(f.rule == "ATP201" for f in findings)
+
     def test_examples_are_clean(self):
         """False-positive guard: examples/ is idiomatic user code — the
         linter flagging any of it means a rule is too aggressive."""
@@ -323,6 +352,169 @@ class TestSelfLint:
     def test_render_json_on_empty(self):
         payload = json.loads(render_json([]))
         assert payload["summary"]["count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ATP2xx lifecycle passes (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+class TestLifecyclePasses:
+    def test_rule_catalog_is_stable(self):
+        assert RULES["ATP201"].name == "lifecycle-leak-on-path"
+        assert RULES["ATP211"].name == "terminal-bypasses-finalizer"
+        assert RULES["ATP221"].name == "cross-thread-state-mutation"
+
+    def test_pairing_table_one_line_extension(self):
+        """The declarative recipe: a NEW resource registers in one
+        ResourcePair line and the whole CFG machinery audits it."""
+        import ast as ast_mod
+
+        from accelerate_tpu.analysis.lifecycle import (
+            PAIRING_TABLE,
+            ResourcePair,
+            lint_lifecycle,
+        )
+
+        table = PAIRING_TABLE + (ResourcePair(
+            "shipment-buffer", acquire=("checkout",),
+            release=("checkin",), receivers=("shipments",)),)
+        src = (
+            "class Router:\n"
+            "    def leaky(self, req):\n"
+            "        buf = self.shipments.checkout(req)\n"
+            "        if buf is None:\n"
+            "            return None\n"
+            "        if req.cancelled:\n"
+            "            return False   # leak\n"
+            "        self.shipments.checkin(buf)\n"
+            "        return True\n"
+        )
+        findings = []
+        lint_lifecycle(ast_mod.parse(src), src, "t.py", src.splitlines(),
+                       findings, table=table)
+        assert [f.rule for f in findings] == ["ATP201"]
+        assert findings[0].data["resource"] == "shipment-buffer"
+        # without the extra row the same code is silent
+        findings2 = []
+        lint_lifecycle(ast_mod.parse(src), src, "t.py", src.splitlines(),
+                       findings2)
+        assert findings2 == []
+
+    def test_findings_carry_structured_data(self):
+        """The JSON satellite: ATP2xx findings name the resource/state
+        and the offending path's line span — actionable without
+        rereading the pass."""
+        fs = [f for f in lint_file(os.path.join(FIXTURES, "atp201_pos.py"))
+              if f.rule == "ATP201"]
+        assert fs
+        for f in fs:
+            assert f.data["resource"]
+            assert f.data["acquire_line"] >= 1
+            lo, hi = f.data["span"]
+            assert lo <= hi
+        fs = [f for f in lint_file(os.path.join(FIXTURES, "atp212_pos.py"))
+              if f.rule == "ATP212"]
+        assert fs and fs[0].data["state"] == "EXPIRED"
+        assert fs[0].data["target"] == "user"
+        # every lifecycle rule keeps the span contract (a consumer may
+        # read data["span"] unconditionally)
+        for fixture, rule in (("atp202_pos.py", "ATP202"),
+                              ("atp203_pos.py", "ATP203"),
+                              ("atp211_pos.py", "ATP211"),
+                              ("atp221_pos.py", "ATP221")):
+            fs = [f for f in lint_file(os.path.join(FIXTURES, fixture))
+                  if f.rule == rule]
+            assert fs and all(len(f.data["span"]) == 2 for f in fs), rule
+
+    def test_json_output_includes_data(self, capsys):
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp201_pos.py"),
+                       "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        rows = [f for f in payload["findings"] if f["rule"] == "ATP201"]
+        assert rows and all(r["data"]["resource"] for r in rows)
+        assert all("span" in r["data"] for r in rows)
+
+    def test_rules_group_alias(self, capsys):
+        """`--rules atp2` selects the whole lifecycle family: the ATP001
+        fixture is clean under it, the ATP201 fixture is not, and a bad
+        token still exits 2."""
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp001_pos.py"),
+                       "--rules", "atp2"])
+        capsys.readouterr()
+        assert rc == 0
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp201_pos.py"),
+                       "--rules", "atp2"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "ATP201" in out
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp201_pos.py"),
+                       "--rules", "atp9"])
+        assert rc == 2
+
+    def test_regression_shapes_of_the_fixed_bugs(self):
+        """The three genuine serving/ findings this PR fixed, as inline
+        shapes: reverting any fix re-creates code the self-lint gate
+        rejects."""
+        # (1) cache.PagedAllocator.allocate pre-fix: a raising on_evict
+        # callback between acquire and release leaked the refcounts
+        src = (
+            "class A:\n"
+            "    def allocate(self, request, nodes):\n"
+            "        self.index.acquire(nodes)\n"
+            "        private = self.pool.alloc(2)\n"
+            "        if private is None:\n"
+            "            self.on_evict(3)\n"
+            "            private = self.pool.alloc(2)\n"
+            "        if private is None:\n"
+            "            self.index.release(nodes)\n"
+            "            return None\n"
+            "        return self.build(nodes, private)\n"
+        )
+        assert "ATP201" in {f.rule for f in lint_text(src, "t.py")}
+        # (2) pod router._harvest pre-fix: EXPIRED without shed_code
+        src = (
+            "class R:\n"
+            "    def _finalize(self, r):\n"
+            "        self.metrics.observe_request(r)\n"
+            "    def harvest(self, user, now):\n"
+            "        user.status = RequestStatus.EXPIRED\n"
+            "        user.reject_reason = 'worker dropped'\n"
+            "        user.finished_at = now\n"
+            "        self._finalize(user)\n"
+        )
+        assert "ATP212" in {f.rule for f in lint_text(src, "t.py")}
+        # (3) the PR 6 class: scheduler.submit without a drain
+        src = (
+            "class E:\n"
+            "    def _finalize_request(self, r):\n"
+            "        self.metrics.observe_request(r)\n"
+            "    def submit(self, req):\n"
+            "        self.scheduler.submit(req)\n"
+            "        if req.done:\n"
+            "            self._finalize_request(req)\n"
+            "        return req\n"
+        )
+        assert "ATP211" in {f.rule for f in lint_text(src, "t.py")}
+
+    def test_suppression_and_baseline_apply_to_lifecycle_rules(self,
+                                                               tmp_path):
+        """ATP2xx rides the whole existing pipeline: line suppressions
+        disarm a finding, baselines accept it."""
+        pos = os.path.join(FIXTURES, "atp212_pos.py")
+        findings = lint_file(pos, root=REPO)
+        assert any(f.rule == "ATP212" for f in findings)
+        src = open(pos).read()
+        suppressed = src.replace(
+            "user.status = RequestStatus.EXPIRED",
+            "user.status = RequestStatus.EXPIRED  # atp: disable=ATP212")
+        from accelerate_tpu.analysis import apply_suppressions
+
+        left = apply_suppressions(lint_text(suppressed, "t.py"), suppressed)
+        assert not any(f.rule == "ATP212" for f in left)
+        bl = tmp_path / "bl.json"
+        save_baseline(str(bl), findings)
+        assert new_findings(findings, json.loads(bl.read_text())) == []
 
 
 # ---------------------------------------------------------------------------

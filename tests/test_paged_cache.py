@@ -67,9 +67,13 @@ def _req(tokens, mnt=4):
     return Request(prompt=np.asarray(tokens, np.int32), max_new_tokens=mnt)
 
 
-def _slot(alloc, req, index=0):
+def _slot(alloc, req, index=0, prompt_done=None):
     s = Slot(index)
     s.alloc, s.request = alloc, req
+    # a finished slot has prefilled its whole prompt; release() caps the
+    # insertable range at prompt_done (a finish_early mid-prefill must
+    # not cache garbage pages — see its regression test below)
+    s.prompt_done = req.prompt_len if prompt_done is None else prompt_done
     return s
 
 
@@ -137,6 +141,51 @@ def test_allocator_defers_admission_until_pages_free():
     al.release(_slot(d, D), finished=True)    # retire -> pages cached
     e = al.allocate(E)                        # now evictable
     assert e is not None and al.evictions == 3
+
+
+def test_allocate_releases_refcounts_when_on_evict_raises():
+    """ATP201 regression (ISSUE 13 self-lint finding): on_evict is a
+    caller-supplied callback running MID-allocate; if it raises, the
+    matched prefix nodes' refcounts must not leak (a leaked refcount
+    pins its whole root path unevictable forever)."""
+    def boom(n):
+        raise RuntimeError("exporter fell over")
+
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0,
+                        on_evict=boom)
+    A = _req(list(range(100, 108)), mnt=0)    # 2 pages
+    C = _req(list(range(200, 208)), mnt=0)    # 2 pages
+    for r in (A, C):
+        al.release(_slot(al.allocate(r), r), finished=True)
+    assert al.index.cached_pages == 4 and al.pages_free == 4
+    # B reuses A's prefix (2 acquired nodes) and needs 5 private pages:
+    # eviction fires, on_evict raises mid-protocol
+    B = _req(list(range(100, 108)) + list(range(300, 304)), mnt=16)
+    with pytest.raises(RuntimeError, match="exporter fell over"):
+        al.allocate(B)
+    assert al.index.mapped_pages == 0         # the refcounts came back
+    # the allocator still works once the callback behaves
+    al.on_evict = None
+    b = al.allocate(B)
+    assert b is not None and b.reused_len == 8
+
+
+def test_release_after_early_finish_caches_only_prefilled_pages():
+    """finish_early can retire a slot whose prefill is still mid-flight;
+    release(finished=True) must cap the cached range at prompt_done —
+    pages past it were never written and caching them would serve
+    garbage KV to the next prefix hit (ISSUE 13 lifecycle-audit fix)."""
+    al = PagedAllocator(page_size=4, num_pages=8, pad_slack=0)
+    A = _req(list(range(100, 116)), mnt=0)    # 16 tokens, 4 pages
+    a = al.allocate(A)
+    slot = _slot(a, A, prompt_done=6)         # prefill stopped mid-page 2
+    al.release(slot, finished=True)
+    assert al.index.cached_pages == 1         # only the COMPLETED page
+    b = al.allocate(_req(list(range(100, 116)), mnt=0))
+    assert b.reused_len == 4                  # and reuse stops there
+    # all other pages went back to the free list, nothing leaked:
+    # 8 total - 1 cached+remapped - 3 private for b
+    assert al.pages_free == 4
 
 
 def test_failed_admission_evicts_nothing():

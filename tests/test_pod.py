@@ -279,6 +279,37 @@ def test_pod_budget_one_and_eos_finish_at_prefill(gpt2_setup):
     assert pod.metrics_summary()["pod_shipments"] == 0.0
 
 
+def test_pod_worker_drop_carries_shed_code(gpt2_setup):
+    """ATP212 regression (ISSUE 13 self-lint finding): when a prefill
+    worker drops an internal (the defensive wedge path), the user's
+    EXPIRED terminal must carry the machine-readable shed_code and a
+    retry hint — this path previously shipped prose only, invisible to
+    shed accounting."""
+    from accelerate_tpu.serving.scheduler import SHED_WORKER_DROP
+
+    cfg, params = gpt2_setup
+    pod = PodEngine(gpt2, cfg, params, _ec(prefill_chunk=4))
+    rng = np.random.default_rng(11)
+    p = rng.integers(0, cfg.vocab_size, (17,)).astype(np.int32)
+    user = pod.submit(p, max_new_tokens=6)
+    flight = pod._flights[id(user)]
+    assert flight.phase == "prefill"
+    # simulate a worker-side wedge: the internal dies mid-prefill (the
+    # router's harvest must also clean up the admit-hook page snapshot
+    # — the step-end sanitizer validates that)
+    assert pod.prefill_workers[flight.worker].cancel(flight.internal)
+    pod.step()
+    assert user.status is RequestStatus.EXPIRED
+    assert user.shed_code == SHED_WORKER_DROP
+    assert user.retry_after_s is not None
+    assert pod.metrics_summary()["requests_expired"] == 1.0
+    # the flight is gone and the pod keeps serving
+    assert id(user) not in pod._flights
+    r2 = pod.submit(p, max_new_tokens=3)
+    pod.run_until_idle()
+    assert r2.status is RequestStatus.FINISHED
+
+
 def test_pod_backpressure_stalls_router_not_prefill(gpt2_setup):
     """With a single decode slot and a shipment buffer of one, a burst
     of prompts must (a) still finish token-exact, (b) record

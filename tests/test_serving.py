@@ -355,6 +355,35 @@ def test_eos_token_finishes_early(gpt2_setup):
     assert r.status is RequestStatus.FINISHED
 
 
+def test_finish_mid_prefill_never_poisons_the_prefix_cache(gpt2_setup):
+    """ISSUE 13 lifecycle-audit regression: `Engine.finish` on a request
+    whose prefill is still mid-flight retires it FINISHED — but only the
+    pages its prefill actually completed may enter the prefix tree.
+    Pre-fix, the full prompt range was inserted and a later identical
+    prompt reused never-written garbage KV; pinned by token-exactness
+    against a fresh engine."""
+    cfg, params = gpt2_setup
+    rng = np.random.default_rng(23)
+    p = _prompt(rng, 30, cfg.vocab_size)
+    eng = _engine(cfg, params, prefill_chunk=8, page_size=8, max_len=96)
+    r1 = eng.submit(p, max_new_tokens=4)
+    eng.step()                      # one chunk: 8 of 30 prompt tokens
+    slot = next(s for s in eng.scheduler.slots if s.request is r1)
+    assert 0 < slot.prompt_done < r1.prompt_len
+    assert eng.finish(r1)           # server-side early finish
+    assert r1.status is RequestStatus.FINISHED
+    # the same prompt again: whatever it reuses must be REAL prefilled
+    # state, so its tokens match a fresh engine's cold run exactly
+    r2 = eng.submit(p, max_new_tokens=6)
+    eng.run_until_idle()
+    fresh = _engine(cfg, params, prefill_chunk=8, page_size=8, max_len=96)
+    ref = fresh.submit(p, max_new_tokens=6)
+    fresh.run_until_idle()
+    assert r2.tokens == ref.tokens
+    # and the reuse really was capped at the completed pages
+    assert eng.allocator.tokens_reused <= 8
+
+
 def test_per_slot_sampling_decorrelates_streams(gpt2_setup):
     """Two identical prompts at temperature>0 in different slots draw from
     different PRNG streams (the sample_token batched-keys satellite, wired
