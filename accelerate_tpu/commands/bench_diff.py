@@ -52,6 +52,11 @@ _HIGHER_IS_BETTER = {
     # speculative decoding (ISSUE 12): committed tokens per decode-role
     # step is the headline lever; the accept rate is its driver
     "tokens_per_decode_step", "spec_accept_rate",
+    # hierarchical KV (ISSUE 16): prefix hits served from the host tier
+    # are re-prefills avoided; dedup hits are whole prefills avoided;
+    # the A/B row's chunk ratio is the headline (no-tier chunks over
+    # with-tier chunks, >= 2x on the churn workload)
+    "prefix_hits_host", "prefix_dedup_hits", "prefill_chunk_ratio",
 }
 _LOWER_IS_BETTER = {
     "ttft_p50_ms", "ttft_p99_ms", "ttft_mean_ms",
@@ -64,6 +69,8 @@ _LOWER_IS_BETTER = {
     "prefill_device_time_mean_ms", "prefill_device_time_p99_ms",
     "train_device_time_sampled_ms",
     "mxu_idle_fraction", "decode_mxu_idle_fraction",
+    # hierarchical KV: PCIe round-trip cost per swapped-in prefix page
+    "swap_in_p50_ms", "swap_in_p99_ms", "swap_in_mean_ms",
 }
 
 

@@ -211,7 +211,14 @@ def build_generate(forward, init_caches):
                  temperature: float = 0.0, key=None):
         b, prompt_len = input_ids.shape
         total = prompt_len + max_new_tokens
-        caches = init_caches(config, b, total)
+        # bucket the cache length so nearby (prompt, budget) pairs share one
+        # compiled decode scan: rows past `total` are never written and sit
+        # at positions the causal mask always hides, so tokens are
+        # unchanged while distinct prompt lengths stop forcing a fresh
+        # decode_all compile each (position tables cap the bucket)
+        limit = getattr(config, "max_position_embeddings", None) or total
+        caches = init_caches(config, b, min(max(-(-total // 32) * 32, total),
+                                            max(limit, total)))
         if key is None:
             key = jax.random.key(0)
         prefill, decode_all = _programs(config, float(temperature))
@@ -225,6 +232,10 @@ def build_generate(forward, init_caches):
         new_tokens = decode_all(params, last, caches, steps, keys)
         return jnp.concatenate([input_ids, new_tokens], axis=1)
 
+    # introspection hook: tests pin the bucketing contract (two prompt
+    # lengths in one bucket -> ONE compiled decode scan) via
+    # generate._programs(config, temp)[1]._cache_size()
+    generate._programs = _programs
     return generate
 
 

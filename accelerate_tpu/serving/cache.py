@@ -537,9 +537,20 @@ class _RadixNode:
     """One cached page: `key` is the page's token chunk (bytes of
     page_size int32 tokens), `page` its physical index. `refcount` counts
     live slots currently mapping the page; 0 means cached-but-unmapped
-    (evictable once it is a leaf)."""
+    (evictable once it is a leaf).
 
-    __slots__ = ("key", "page", "children", "refcount", "last_used", "parent")
+    `residency` is the hierarchical-KV state: "hbm" means `page` is a
+    live pool page holding the chunk's K/V; "host" means the chunk's
+    bytes were swapped out to the host tier (serving/host_tier.py) —
+    `page` is -1, the node stays in the tree so the prefix still
+    matches, and a later admission swaps the bytes back into a freshly
+    reserved pool page. A host-resident node is always refcount-0 (a
+    mapped node's page is pinned in HBM) and all of its children are
+    host-resident too: eviction drains leaf-first, so residency along
+    any root path is an HBM prefix followed by a host suffix."""
+
+    __slots__ = ("key", "page", "children", "refcount", "last_used",
+                 "parent", "residency")
 
     def __init__(self, key: bytes, page: int, parent: "_RadixNode | None"):
         self.key = key
@@ -548,6 +559,7 @@ class _RadixNode:
         self.refcount = 0
         self.last_used = 0
         self.parent = parent
+        self.residency = "hbm"
 
 
 class PrefixIndex:
@@ -567,8 +579,14 @@ class PrefixIndex:
         self.page_size = page_size
         self.root = _RadixNode(b"", -1, None)
         self._tick = 0
-        self.cached_pages = 0
-        self.mapped_pages = 0   # nodes with refcount > 0
+        self.cached_pages = 0   # HBM-resident nodes (pool pages in the tree)
+        self.mapped_pages = 0   # nodes with refcount > 0 (always HBM)
+        self.host_pages = 0     # host-resident nodes (bytes in the host tier)
+        # drop_host(node): the host tier forgets `node`'s swapped-out
+        # bytes. Fired when a host-resident chunk is re-homed in HBM by a
+        # fresh insert (adoption) or its naming path is destructively
+        # evicted. None when no host tier is attached.
+        self.drop_host: Callable[[Any], None] | None = None
 
     def _touch(self, node: _RadixNode) -> None:
         self._tick += 1
@@ -622,11 +640,26 @@ class PrefixIndex:
                 child = _RadixNode(key, pages[i], node)
                 node.children[key] = child
                 self.cached_pages += 1
+            elif child.residency == "host":
+                # the chunk was swapped out while this request prefilled
+                # its own copy — adopt the fresh HBM page (value-identical
+                # bytes) and let the host tier drop the stale mirror
+                self._adopt_host(child, pages[i])
             elif child.page != pages[i]:
                 spare.append(pages[i])
             self._touch(child)
             node = child
         return spare
+
+    def _adopt_host(self, node: _RadixNode, page: int) -> None:
+        """Re-home a host-resident node in HBM at `page` (whose bytes
+        must already hold the chunk's K/V) and drop the host mirror."""
+        node.page = page
+        node.residency = "hbm"
+        self.host_pages -= 1
+        self.cached_pages += 1
+        if self.drop_host is not None:
+            self.drop_host(node)
 
     def extend_path(self, prompt: np.ndarray, pages: list[int],
                     start: int, upto: int) -> list[_RadixNode]:
@@ -652,6 +685,10 @@ class PrefixIndex:
                 child = _RadixNode(key, pages[i], node)
                 node.children[key] = child
                 self.cached_pages += 1
+            elif child.residency == "host":
+                # same adoption as `insert`: the publisher's freshly
+                # prefilled page re-homes the swapped-out chunk in HBM
+                self._adopt_host(child, pages[i])
             elif child.page != pages[i]:
                 break
             self._touch(child)
@@ -659,30 +696,48 @@ class PrefixIndex:
             node = child
         return out
 
-    def evict_lru(self, n: int) -> list[int]:
-        """Free exactly `n` pages, detaching least-recently-used
-        refcount-0 leaves (evicting a leaf can turn its parent into the
-        next candidate). Mapped pages (refcount > 0) are never touched.
-        ALL-OR-NOTHING: if fewer than `n` pages are evictable the tree is
-        left intact and [] returned — a failed admission must not cost
-        the cache its reusable prefixes, and (key for a queue head that
-        stays blocked for many engine steps) that case bails in O(1).
+    def evict_lru(self, n: int,
+                  swap_out: "Callable[[Any], bool] | None" = None
+                  ) -> list[int]:
+        """Free exactly `n` pages, draining least-recently-used
+        refcount-0 effective leaves (an effective leaf is an HBM node
+        with no HBM descendant — host-resident children don't pin their
+        parent, or a host tier would freeze eviction; draining one can
+        turn its parent into the next candidate). Mapped pages
+        (refcount > 0) are never touched. ALL-OR-NOTHING: if fewer than
+        `n` pages are evictable the tree is left intact and [] returned
+        — a failed admission must not cost the cache its reusable
+        prefixes, and (key for a queue head that stays blocked for many
+        engine steps) that case bails in O(1).
+
+        `swap_out(node)` (the host tier's offer, while `node.page` still
+        names the bytes) decides each victim's fate: True keeps the node
+        in the tree as host-resident (page freed, bytes mirrored to host
+        DRAM); False/None is the classic destructive eviction — the node
+        detaches, and any host-resident subtree hanging under it loses
+        its naming path, so those mirrors are dropped via `drop_host`.
+        Either way exactly one HBM page per victim is freed.
 
         Why `cached - mapped` IS the evictable total: acquire/release
         always ref whole root-paths (`match` returns contiguous paths
         from the root), so refcounts are downward-closed — a refcount-0
         node can never have a mapped descendant, and every refcount-0
-        subtree drains leaf-first. The sufficient case pays one DFS plus
-        a min-heap of candidate leaves: O(tree + n log tree), once per
-        actual eviction burst, never per blocked step."""
+        subtree drains leaf-first (host-resident nodes are refcount-0 by
+        construction and hold no HBM page, so they count in neither
+        term). The sufficient case pays one DFS plus a min-heap of
+        candidate leaves: O(tree + n log tree), once per actual eviction
+        burst, never per blocked step."""
         if n <= 0 or self.cached_pages - self.mapped_pages < n:
             return []
         heap = []
-        stack = list(self.root.children.values())
+        stack = [c for c in self.root.children.values()
+                 if c.residency == "hbm"]
         while stack:
             node = stack.pop()
-            if node.children:
-                stack.extend(node.children.values())
+            hbm_children = [c for c in node.children.values()
+                            if c.residency == "hbm"]
+            if hbm_children:
+                stack.extend(hbm_children)
             elif node.refcount == 0:
                 heap.append((node.last_used, node.page, node))
         heapq.heapify(heap)
@@ -690,25 +745,66 @@ class PrefixIndex:
         while len(freed) < n:
             _, _, victim = heapq.heappop(heap)
             parent = victim.parent
-            del parent.children[victim.key]
-            victim.parent = None
-            self.cached_pages -= 1
             freed.append(victim.page)
-            if parent is not self.root and not parent.children \
-                    and parent.refcount == 0:
+            self.cached_pages -= 1
+            if swap_out is not None and swap_out(victim):
+                victim.page = -1
+                victim.residency = "host"
+                self.host_pages += 1
+            else:
+                del parent.children[victim.key]
+                victim.parent = None
+                # every descendant of an effective leaf is host-resident;
+                # their mirrors die with the path that named them
+                drop_stack = list(victim.children.values())
+                while drop_stack:
+                    orphan = drop_stack.pop()
+                    drop_stack.extend(orphan.children.values())
+                    self.host_pages -= 1
+                    if self.drop_host is not None:
+                        self.drop_host(orphan)
+            if parent is not self.root and parent.refcount == 0 \
+                    and parent.residency == "hbm" \
+                    and not any(c.residency == "hbm"
+                                for c in parent.children.values()):
                 heapq.heappush(heap, (parent.last_used, parent.page, parent))
         return freed
+
+    def residency_probe(self, prompt: np.ndarray) -> tuple[int, int]:
+        """(hbm_pages, host_pages) along the longest cached prefix of
+        `prompt`, WITHOUT touching LRU stamps — the pod router's
+        placement probe (scoring a worker must not make its cache look
+        hot)."""
+        limit = (int(prompt.shape[0]) - 1) // self.page_size
+        node, hbm, host = self.root, 0, 0
+        for i in range(limit):
+            child = node.children.get(self._chunk(prompt, i))
+            if child is None:
+                break
+            if child.residency == "hbm":
+                hbm += 1
+            else:
+                host += 1
+            node = child
+        return hbm, host
 
 
 @dataclasses.dataclass
 class PageAllocation:
     """One admitted request's page mapping: `pages` is the ordered table
     row prefix (cached prefix pages first, then private pages); `nodes`
-    are the mapped radix nodes backing pages[:len(nodes)]."""
+    are the mapped radix nodes backing pages[:len(nodes)].
+
+    `swap_ins` lists (node, page) pairs whose chunks matched
+    host-resident: the allocator already reserved `page` and re-homed
+    the node, but the BYTES are still in the host tier — the engine must
+    install them (jitted PageTransport install) before the slot's admit
+    program runs, or the reused prefix serves garbage."""
 
     reused_len: int
     nodes: list
     pages: list[int]
+    swap_ins: list | None = None
 
 
 class PagedAllocator:
@@ -746,6 +842,19 @@ class PagedAllocator:
         # cost ONE prefill. Same no-skip-ahead semantics as a pages-tight
         # head: the queue waits behind it.
         self.hold_admission: Callable[[Any], bool] | None = None
+        # host-tier hooks (engine-wired when EngineConfig.host_tier_bytes
+        # > 0, else None and eviction stays destructive):
+        #   swap_out(node) -> bool — offer an eviction victim to the host
+        #     tier while node.page still names its bytes; True = accepted
+        #     (node goes host-resident), False = tier full, evict
+        #     destructively.
+        #   swap_stall(need) -> bool — True when the tier WOULD accept
+        #     victims but its bounded swap-out queue can't absorb `need`
+        #     more pages right now: the admission stalls (request stays
+        #     queued, decode never blocks) instead of either blocking on
+        #     the queue or destroying prefixes the tier has room for.
+        self.swap_out: Callable[[Any], bool] | None = None
+        self.swap_stall: Callable[[int], bool] | None = None
         # running totals for host-side (model-free) observability and
         # tests. The engine's registry counters are booked separately:
         # evictions reach it through on_evict, admission outcomes through
@@ -778,43 +887,74 @@ class PagedAllocator:
         so a too-big queue head can't strip the cache while it waits."""
         if self.hold_admission is not None and self.hold_admission(request):
             return None
-        nodes = (self.index.match(request.prompt)
-                 if self.prefix_cache else [])
+        path = (self.index.match(request.prompt)
+                if self.prefix_cache else [])
+        # residency along a matched path is an HBM prefix then a host
+        # suffix (leaf-first eviction — see _RadixNode); the host suffix
+        # needs fresh pool pages to swap back into, reserved here with
+        # the same worst-case discipline as private pages
+        n_hbm = 0
+        while n_hbm < len(path) and path[n_hbm].residency == "hbm":
+            n_hbm += 1
+        hbm_nodes, host_nodes = path[:n_hbm], path[n_hbm:]
         n_total = self.pages_needed(request.prompt_len,
                                     request.max_new_tokens)
-        n_private = n_total - len(nodes)
+        n_extra = n_total - n_hbm   # swap-in pages + private pages
         # acquire BEFORE evicting: matched nodes are refcount-0 until
-        # mapped, and eviction must never free a page we are about to use
-        self.index.acquire(nodes)
+        # mapped, and eviction must never free a page we are about to
+        # use. Host nodes can't be acquired yet (mapped_pages counts HBM
+        # pages) but are eviction-proof anyway: eviction only drops a
+        # host subtree under a destructively evicted HBM ancestor, and
+        # every HBM ancestor of `host_nodes` is in `hbm_nodes` — pinned.
+        self.index.acquire(hbm_nodes)
         try:
-            private = self.pool.alloc(n_private)
-            if private is None:
-                freed = self.index.evict_lru(
-                    n_private - self.pool.free_count)
+            extra = self.pool.alloc(n_extra)
+            if extra is None:
+                need = n_extra - self.pool.free_count
+                if self.swap_stall is not None and self.swap_stall(need):
+                    self.index.release(hbm_nodes)
+                    return None
+                freed = self.index.evict_lru(need, swap_out=self.swap_out)
                 if freed:
                     self.evictions += len(freed)
                     self.pool.release(freed)
                     if self.on_evict is not None:
                         self.on_evict(len(freed))
-                private = self.pool.alloc(n_private)
+                extra = self.pool.alloc(n_extra)
+            if extra is None:
+                self.index.release(hbm_nodes)
+                return None
+            # re-home the host suffix: each node takes a reserved page
+            # NOW (bookkeeping only — the caller installs the bytes
+            # before the slot's first device program reads them)
+            swap_ins = []
+            for node, page in zip(host_nodes, extra):
+                node.page = page
+                node.residency = "hbm"
+                self.index.host_pages -= 1
+                self.index.cached_pages += 1
+                swap_ins.append((node, page))
+            self.index.acquire(host_nodes)
         except BaseException:
-            # on_evict is a caller-supplied callback: if it raises
-            # mid-allocate the matched nodes' refcounts must not leak
-            # (they would pin their whole root paths unevictable forever
-            # — the ATP201 self-lint finding this handler exists for)
-            self.index.release(nodes)
+            # on_evict / swap_stall are caller-supplied callbacks: if
+            # one raises mid-allocate the matched nodes' refcounts must
+            # not leak (they would pin their whole root paths
+            # unevictable forever — the ATP201 self-lint finding this
+            # handler exists for)
+            self.index.release(hbm_nodes)
             raise
-        if private is None:
-            self.index.release(nodes)
-            return None
+        private = extra[len(host_nodes):]
         self.lookups += 1
-        if nodes:
+        if path:
             self.hits += 1
-            self.tokens_reused += len(nodes) * self.page_size
+            self.tokens_reused += len(path) * self.page_size
+        # ownership of the acquired refcounts transfers to the returned
+        # allocation here (hbm prefix + re-homed host suffix == path)
         return PageAllocation(
-            reused_len=len(nodes) * self.page_size,
-            nodes=nodes,
-            pages=[n.page for n in nodes] + private,
+            reused_len=len(path) * self.page_size,
+            nodes=hbm_nodes + host_nodes,
+            pages=[n.page for n in hbm_nodes + host_nodes] + private,
+            swap_ins=swap_ins or None,
         )
 
     def rollback(self, alloc: PageAllocation) -> None:
@@ -822,9 +962,18 @@ class PagedAllocator:
         pod router's adopt race): shared nodes drop their refcount,
         private pages return to the free list, nothing is cached. The
         inverse of allocate lives HERE so the [node pages | private]
-        layout of PageAllocation.pages stays a single-module invariant."""
+        layout of PageAllocation.pages stays a single-module invariant.
+        Pending swap-ins revert to host residency — their bytes were
+        never installed, so the reserved pages return to the pool and the
+        host tier keeps the mirror."""
         self.index.release(alloc.nodes)
         self.pool.release(alloc.pages[len(alloc.nodes):])
+        for node, page in (alloc.swap_ins or ()):
+            node.page = -1
+            node.residency = "host"
+            self.index.host_pages += 1
+            self.index.cached_pages -= 1
+            self.pool.release([page])
         self.lookups -= 1
         if alloc.nodes:
             self.hits -= 1

@@ -206,6 +206,18 @@ class EngineConfig:
     page_size: int = 16
     num_pages: int | None = None
     prefix_cache: bool = True
+    # hierarchical KV (ISSUE 16): byte budget for the host-DRAM overflow
+    # tier (serving/host_tier.py). > 0 turns eviction from destruction
+    # into demotion — refcount-0 prefixes falling out of the HBM pool
+    # swap OUT to pinned host numpy (async, off the engine step), and a
+    # later radix hit on a host-resident prefix swaps back IN through
+    # the jitted PageTransport pair before admission, so the effective
+    # prefix cache is host-memory-sized while compile counts stay flat.
+    # 0 (default) = off, eviction destroys (the pre-ISSUE-16 behavior).
+    # Sizing: capacity_pages = host_tier_bytes // cache.page_nbytes;
+    # with kv_dtype="int8" each page is ~half the bf16 bytes, so the
+    # same budget holds ~2x the prefix tokens.
+    host_tier_bytes: int = 0
     # decode attention op. True: the Pallas paged-attention kernel
     # (ops/paged_attention.py) walks the page table INSIDE attention —
     # pages are read once, in place, only live pages per slot, GQA
@@ -520,8 +532,27 @@ class Engine:
         # admission hold below (entries drop as parents reach a terminal
         # state, so the map is bounded by live fan-outs)
         self._fork_parents: dict[int, Request] = {}
+        # in-flight prefill dedup (ISSUE 16): request_ids currently held
+        # behind a leader's prefill, so each follower counts exactly one
+        # dedup hit however many steps it waits
+        self._dedup_held: set[int] = set()
         if ec.prefix_cache:
-            self.allocator.hold_admission = self._hold_fork_child
+            self.allocator.hold_admission = self._hold_admission
+        # hierarchical KV: host-DRAM overflow tier + its jitted swap
+        # transport (the pod PageTransport pair — extract on swap-out,
+        # install on swap-in — compiles once each, so swap mixes never
+        # move the compile count)
+        self._host_tier = None
+        self._swap_transport = None
+        if ec.host_tier_bytes > 0:
+            from .host_tier import HostTier
+            from .pod.transfer import PageTransport
+
+            self._swap_transport = PageTransport(self)
+            self._host_tier = HostTier(self, ec.host_tier_bytes)
+            self.allocator.swap_out = self._host_tier.offer
+            self.allocator.swap_stall = self._host_tier.would_stall
+            self.allocator.index.drop_host = self._host_tier.discard
         self.scheduler = Scheduler(ec.num_slots, ec.max_len,
                                    max_queue=ec.max_queue, clock=clock,
                                    allocator=self.allocator,
@@ -880,6 +911,10 @@ class Engine:
             out["verify"] = self._verify_p._cache_size()
         else:
             out["decode"] = self._decode_p._cache_size()
+        if self._swap_transport is not None:
+            # host tier on: the swap pair must stay flat too, whatever
+            # the swap-out/swap-in mix (keys match the pod transport's)
+            out.update(self._swap_transport.compile_stats())
         return out
 
     # -- request API ---------------------------------------------------------
@@ -1304,6 +1339,60 @@ class Engine:
                 return have < want
         return True  # parent still queued: its prefill hasn't started
 
+    def _hold_admission(self, req: Request) -> bool:
+        """The allocator's admission-hold hook: COW fork children wait
+        for their parent's publish (above), and — cache-aware scheduling,
+        ISSUE 16 — any queued request whose full shareable prefix is
+        currently being prefilled by another request waits for that
+        leader's pages instead of duplicating the prefill."""
+        return self._hold_fork_child(req) or self._hold_for_dedup(req)
+
+    def _hold_for_dedup(self, req: Request) -> bool:
+        """In-flight prefill dedup. If a PREFILL-state slot's prompt
+        covers `req`'s full shareable prefix, flag that leader to
+        publish its prompt pages mid-flight (`publish_prompt`, the COW
+        fork machinery) and hold `req` until the published pages cover
+        it — N concurrent identical prompts then cost ONE full prefill
+        (each follower still prefills its private sub-page tail).
+
+        Bounded by policy: a request never waits on a LOWER-priority
+        tier's leader (a gold request never waits on a bronze leader),
+        and the hold re-evaluates every admission attempt, so a leader
+        that is cancelled, shed, or finished early simply stops
+        matching and the follower re-prefills cold — waits are bounded
+        by the leader's own prefill progress, which advances every
+        step."""
+        want = (req.prompt_len - 1) // self.engine_config.page_size
+        if want <= 0:
+            return False
+        if len(self.allocator.index.match(req.prompt)) >= want:
+            # the tree already covers us (HBM or host) — admit now
+            self._dedup_held.discard(req.request_id)
+            return False
+        k = want * self.engine_config.page_size
+        my_tier = self.scheduler.tenant_priority(req.tenant)
+        head = req.prompt[:k]
+        for slot in self.scheduler.slots:
+            leader = slot.request
+            if (slot.state is not SlotState.PREFILL or leader is None
+                    or leader is req):
+                continue
+            if leader.prompt_len < k \
+                    or self.scheduler.tenant_priority(leader.tenant) > my_tier:
+                continue
+            if not np.array_equal(np.asarray(leader.prompt[:k]), head):
+                continue
+            leader.share_prompt = True  # publish from the next chunk on
+            if self.allocator.publish_prompt(slot) >= want:
+                self._dedup_held.discard(req.request_id)
+                return False
+            if req.request_id not in self._dedup_held:
+                self._dedup_held.add(req.request_id)
+                self.metrics.note_dedup_hit()
+            return True
+        self._dedup_held.discard(req.request_id)
+        return False
+
     def _unmap_slot(self, index: int) -> None:
         """Allocator callback at release: reset the slot's page table to
         all-trash BEFORE its pages can be reallocated, so the retired
@@ -1314,6 +1403,50 @@ class Engine:
             self.allocator.pages_in_use, self.allocator.pages_free,
             self.allocator.pages_in_use * self.cache.page_nbytes)
 
+    def _run_swap_in(self, slot: Slot, req: Request, alloc) -> None:
+        """Install a host-resident prefix's bytes into the pages the
+        allocator reserved for it, through the jitted transport install
+        (fixed [pages_per_slot] block, trash-padded — every swap mix
+        hits the one compiled program). int8 pools land codes + scales
+        verbatim: byte-identical to what swap-out extracted, the same
+        bit-stability COW sharing relies on. One install covers the
+        whole admission: a matched prefix is at most pages_per_slot - 1
+        pages (the last prompt token always prefills)."""
+        t0 = self._clock()
+        cache, tp = self.cache, self._swap_transport
+        P = cache.pages_per_slot
+        rows = np.full((P,), cache.trash_page, np.int32)
+        k_blk = np.zeros((cache.k.shape[0], P) + cache.k.shape[2:],
+                         cache.k.dtype)
+        v_blk = np.zeros_like(k_blk)
+        ks_blk = vs_blk = None
+        if cache.quantized:
+            ks_blk = np.zeros(
+                (cache.k_scale.shape[0], P) + cache.k_scale.shape[2:],
+                cache.k_scale.dtype)
+            vs_blk = np.zeros_like(ks_blk)
+        for i, (node, page) in enumerate(alloc.swap_ins):
+            data = self._host_tier.fetch(node)
+            rows[i] = page
+            k_blk[:, i] = data["k"]
+            v_blk[:, i] = data["v"]
+            if cache.quantized:
+                ks_blk[:, i] = data["k_scale"]
+                vs_blk[:, i] = data["v_scale"]
+        # first_tok=0 rides along into the slot's last-token register —
+        # dead state until prefill overwrites it (same masking argument
+        # as the trash-page dead writes)
+        args = (cache, self._tokens, jnp.int32(slot.index), rows,
+                k_blk, v_blk, jnp.int32(0))
+        if cache.quantized:
+            args += (ks_blk, vs_blk)
+        self._strict_audit("install", tp._install_p, args)
+        with self._request_span("serving.swap_in", req, slot=slot.index,
+                                pages=len(alloc.swap_ins)):
+            self.cache, self._tokens = tp._install_p(*args)
+        self.metrics.note_swap_in(len(alloc.swap_ins),
+                                  self._clock() - t0)
+
     def _run_admit(self, slot: Slot, req: Request) -> None:
         key_raw = _as_raw_key(req.key)
         if key_raw is None:
@@ -1323,7 +1456,13 @@ class Engine:
         row = self._table[slot.index]
         row[:] = self.cache.trash_page
         row[:len(alloc.pages)] = alloc.pages
-        self.metrics.note_admission(req.prompt_len, alloc.reused_len)
+        if alloc.swap_ins:
+            # host-resident prefix: land the swapped-out bytes in the
+            # freshly reserved pages BEFORE the admit program publishes
+            # the reused length (nothing reads the pages in between)
+            self._run_swap_in(slot, req, alloc)
+        self.metrics.note_admission(req.prompt_len, alloc.reused_len,
+                                    host_pages=len(alloc.swap_ins or ()))
         self.metrics.set_page_gauges(
             self.allocator.pages_in_use, self.allocator.pages_free,
             self.allocator.pages_in_use * self.cache.page_nbytes)
@@ -1650,6 +1789,9 @@ class Engine:
             "prefix_hits": alloc.hits,
             "tokens_reused": alloc.tokens_reused,
             "evictions": alloc.evictions,
+            "host_pages": alloc.index.host_pages,
+            **({"host_tier": self._host_tier.stats()}
+               if self._host_tier is not None else {}),
         }
 
     def debug_scheduler(self) -> dict:
@@ -1734,6 +1876,9 @@ class Engine:
         self.metrics.set_page_gauges(
             self.allocator.pages_in_use, self.allocator.pages_free,
             self.allocator.pages_in_use * self.cache.page_nbytes)
+        if self._host_tier is not None:
+            self.metrics.set_host_tier_gauges(self._host_tier.pages_in_use,
+                                              self._host_tier.bytes_in_use)
         # decode_steps restarts from 0, so the log guard must too — a stale
         # value would swallow the first post-reset log point
         self._last_logged = 0
@@ -1792,6 +1937,8 @@ class Engine:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self._host_tier is not None:
+            self._host_tier.close()
 
     def _maybe_log(self) -> None:
         if not self._tracker or not self._log_every:

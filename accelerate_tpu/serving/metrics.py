@@ -58,6 +58,21 @@ class ServingMetrics:
         self._c_prefix_tokens = r.counter("serving_prefix_tokens_reused_total")
         self._c_prompt_tokens = r.counter("serving_prompt_tokens_total")
         self._c_evictions = r.counter("serving_page_evictions_total")
+        # hierarchical KV (ISSUE 16): prefix hits split by the tier that
+        # served them (an hbm hit mapped pages in place, a host hit paid
+        # a swap-in), swap traffic in pages both directions, the host
+        # tier's occupancy, and the swap-in latency the admission paid
+        self._c_prefix_hits_hbm = r.counter("serving_prefix_hits_hbm_total")
+        self._c_prefix_hits_host = r.counter(
+            "serving_prefix_hits_host_total")
+        self._c_swap_in = r.counter("serving_swap_in_pages_total")
+        self._c_swap_out = r.counter("serving_swap_out_pages_total")
+        # in-flight prefill dedup (cache-aware scheduling): followers
+        # that waited on a leader's publish instead of duplicating it
+        self._c_dedup = r.counter("serving_prefix_dedup_hits_total")
+        self.swap_in_s = r.histogram("serving_swap_in_seconds")
+        self._g_host_pages = r.gauge("serving_host_tier_pages_in_use")
+        self._g_host_bytes = r.gauge("serving_host_tier_bytes_in_use")
         # speculative decoding (ISSUE 12): drafted vs accepted proposal
         # totals per slot-step; the accept-rate gauge is their running
         # ratio and tokens-per-decode-step is the headline lever (how
@@ -144,6 +159,26 @@ class ServingMetrics:
     def page_evictions(self) -> int:
         return int(self._c_evictions.value)
 
+    @property
+    def prefix_hits_hbm(self) -> int:
+        return int(self._c_prefix_hits_hbm.value)
+
+    @property
+    def prefix_hits_host(self) -> int:
+        return int(self._c_prefix_hits_host.value)
+
+    @property
+    def swap_in_pages(self) -> int:
+        return int(self._c_swap_in.value)
+
+    @property
+    def swap_out_pages(self) -> int:
+        return int(self._c_swap_out.value)
+
+    @property
+    def prefix_dedup_hits(self) -> int:
+        return int(self._c_dedup.value)
+
     def note_decode_step(self, path: str = "dense") -> None:
         """`path` is which decode attention op served the step —
         "kernel" (Pallas paged attention) or "dense" (gather reference)
@@ -178,16 +213,38 @@ class ServingMetrics:
     def note_prefill_chunk(self) -> None:
         self._c_prefill.inc()
 
-    def note_admission(self, prompt_len: int, reused_len: int) -> None:
-        """One admitted request's prefix-cache outcome."""
+    def note_admission(self, prompt_len: int, reused_len: int,
+                       host_pages: int = 0) -> None:
+        """One admitted request's prefix-cache outcome. `host_pages` is
+        how many of the reused pages were swapped in from the host tier
+        — any makes this a host-tier hit (the admission paid a swap-in),
+        else an HBM hit."""
         self._c_prefix_lookups.inc()
         self._c_prompt_tokens.inc(prompt_len)
         if reused_len > 0:
             self._c_prefix_hits.inc()
             self._c_prefix_tokens.inc(reused_len)
+            if host_pages > 0:
+                self._c_prefix_hits_host.inc()
+            else:
+                self._c_prefix_hits_hbm.inc()
 
     def note_page_evictions(self, n: int) -> None:
         self._c_evictions.inc(n)
+
+    def note_swap_out(self, n: int) -> None:
+        self._c_swap_out.inc(n)
+
+    def note_swap_in(self, n: int, seconds: float) -> None:
+        self._c_swap_in.inc(n)
+        self.swap_in_s.record(seconds)
+
+    def note_dedup_hit(self) -> None:
+        self._c_dedup.inc()
+
+    def set_host_tier_gauges(self, pages: int, bytes_in_use: int) -> None:
+        self._g_host_pages.set(pages)
+        self._g_host_bytes.set(bytes_in_use)
 
     def set_goodput(self, value: float) -> None:
         self._g_goodput.set(value)
@@ -290,6 +347,17 @@ class ServingMetrics:
                 self.spec_accepted_tokens / self.spec_drafted_tokens)
         if self.prefix_lookups:
             out["prefix_hit_rate"] = self.prefix_hits / self.prefix_lookups
+        if self.prefix_hits:
+            out["prefix_hits_hbm"] = float(self.prefix_hits_hbm)
+            out["prefix_hits_host"] = float(self.prefix_hits_host)
+        if self.prefix_dedup_hits:
+            out["prefix_dedup_hits"] = float(self.prefix_dedup_hits)
+        if self.swap_out_pages or self.swap_in_pages:
+            out["swap_out_pages"] = float(self.swap_out_pages)
+            out["swap_in_pages"] = float(self.swap_in_pages)
+            out["host_tier_pages_in_use"] = float(self._g_host_pages.value)
+            out["host_tier_bytes_in_use"] = float(self._g_host_bytes.value)
+            out.update(_percentiles(self.swap_in_s, "swap_in"))
         if self.prompt_tokens:
             out["cached_token_fraction"] = (
                 self.prefix_tokens_reused / self.prompt_tokens)

@@ -224,6 +224,8 @@ class PodRouter:
             "serving_pod_pages_shipped_total")
         self._c_stalls = self.registry.counter(
             "serving_pod_backpressure_stalls_total")
+        self._c_affinity = self.registry.counter(
+            "serving_pod_affinity_hits_total")
         self._g_pending = self.registry.gauge(
             "serving_pod_pending_shipments")
         self._g_occupancy = {
@@ -583,9 +585,25 @@ class PodRouter:
 
     def _try_install(self, flight: _Flight) -> bool:
         user, shipment = flight.user, flight.shipment
+        # prefix affinity: a worker whose radix tree already holds this
+        # prompt's prefix turns the shipment's leading pages into a local
+        # hit (HBM: free; host tier: one swap-in's worth of reserve, and
+        # the shipment bytes overwrite the reserved pages value-exactly,
+        # so the mirror is just dropped). HBM residency outranks host,
+        # residency outranks emptiness; ties fall back to least-loaded.
+        # residency_probe never touches LRU order — probing every worker
+        # must not manufacture recency for the losers.
+        scores = []
+        for w in self.decode_workers:
+            hbm = host = 0
+            if w.allocator.index is not None:
+                hbm, host = w.allocator.index.residency_probe(
+                    shipment.prompt)
+            scores.append(2 * hbm + host)
         order = sorted(
             range(len(self.decode_workers)),
-            key=lambda i: -self.decode_workers[i].allocator.pages_free)
+            key=lambda i: (-scores[i],
+                           -self.decode_workers[i].allocator.pages_free))
         for widx in order:
             engine = self.decode_workers[widx]
             if engine.scheduler.live_slots >= len(engine.scheduler.slots):
@@ -613,6 +631,16 @@ class PodRouter:
             engine._table[slot.index, :len(alloc.pages)] = alloc.pages
             self._transports_d[widx].install_shipment(
                 shipment, slot.index, alloc)
+            # host-resident prefix chunks were re-homed to fresh pages
+            # by allocate(); the shipment just wrote those pages with
+            # the exact same bytes the mirror holds, so the mirror is
+            # dead — drop it instead of fetching (skips a host->device
+            # copy). After install on purpose: the slot claim must
+            # complete before any non-essential bookkeeping call could
+            # raise (the ATP201 exception-window discipline).
+            if alloc.swap_ins:
+                for node, _page in alloc.swap_ins:
+                    engine._host_tier.discard(node)
             # seed the first token into the worker's books so EOS/budget
             # accounting continues exactly where the prefill worker left
             # off (the user already holds this token — don't re-mirror);
@@ -620,8 +648,11 @@ class PodRouter:
             # list stays index-aligned with its tokens
             engine.scheduler.note_token(slot, shipment.first_token, now=now,
                                         logprob=shipment.first_logprob)
-            engine.metrics.note_admission(internal.prompt_len,
-                                          alloc.reused_len)
+            engine.metrics.note_admission(
+                internal.prompt_len, alloc.reused_len,
+                host_pages=len(alloc.swap_ins or ()))
+            if scores[widx] > 0:
+                self._c_affinity.inc()
             flight.phase = "decode"
             flight.worker = widx
             flight.internal = internal
@@ -723,6 +754,19 @@ class PodRouter:
         out["pod_shipments"] = float(self._c_shipments.value)
         out["pod_pages_shipped"] = float(self._c_pages_shipped.value)
         out["pod_backpressure_stalls"] = float(self._c_stalls.value)
+        out["pod_affinity_hits"] = float(self._c_affinity.value)
+        workers = self.prefill_workers + self.decode_workers
+        swap_out = sum(w.metrics.swap_out_pages for w in workers)
+        swap_in = sum(w.metrics.swap_in_pages for w in workers)
+        if swap_out or swap_in:
+            out["swap_out_pages"] = float(swap_out)
+            out["swap_in_pages"] = float(swap_in)
+            out["host_tier_pages_in_use"] = float(sum(
+                w._host_tier.pages_in_use for w in workers
+                if w._host_tier is not None))
+        dedup = sum(w.metrics.prefix_dedup_hits for w in workers)
+        if dedup:
+            out["prefix_dedup_hits"] = float(dedup)
         return out
 
     def reset_metrics(self) -> None:

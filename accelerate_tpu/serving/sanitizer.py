@@ -9,7 +9,12 @@ validates exactly those joins after every engine step:
 
 - **page conservation**: every allocatable page is in exactly one place
   — the free list, the radix tree, or some slot's private allocation;
-  the trash page is in none of them; nothing is double-owned;
+  the trash page is in none of them; nothing is double-owned; with a
+  host tier on, host-resident radix nodes hold no HBM page at all but
+  must each have a live mirror entry in the tier (and vice versa), host
+  residency is downward-closed (a host node's children are all host),
+  the tier stays inside its byte budget, and its drain queue inside its
+  bound;
 - **refcount correctness**: each radix node's refcount equals the number
   of live slot allocations mapping it, refcounts are downward-closed
   along root paths (a refcount-0 node never has a mapped descendant —
@@ -116,7 +121,32 @@ def check_engine(engine) -> None:
               "never be allocatable)", pages=sorted(bad), trash=trash)
     tree_nodes = _walk_tree(index)
     tree_pages: dict = {}
-    for node, _parent in tree_nodes:
+    host_nodes = []
+    for node, parent in tree_nodes:
+        if getattr(node, "residency", "hbm") == "host":
+            host_nodes.append(node)
+            if node.page != -1:
+                _fail("page-conservation",
+                      "a host-resident radix node still names an HBM page",
+                      page=node.page)
+            if node.refcount != 0:
+                _fail("page-conservation",
+                      "a host-resident radix node is mapped by a slot "
+                      "(swap-in must re-home before acquire)",
+                      refcount=node.refcount)
+            if any(getattr(c, "residency", "hbm") == "hbm"
+                   for c in node.children.values()):
+                _fail("page-conservation",
+                      "a host-resident radix node has an HBM child "
+                      "(residency must be a suffix property — eviction "
+                      "drains leaf-first)")
+            continue
+        if parent is not index.root and \
+                getattr(parent, "residency", "hbm") == "host":
+            _fail("page-conservation",
+                  "an HBM radix node hangs under a host-resident parent "
+                  "(residency must be a suffix property)",
+                  page=node.page)
         if node.page in tree_pages:
             _fail("page-conservation",
                   "one physical page backs two radix nodes",
@@ -125,6 +155,40 @@ def check_engine(engine) -> None:
             _fail("page-conservation", "radix node holds an out-of-range "
                   "page", page=node.page)
         tree_pages[node.page] = node
+    host_tier = getattr(engine, "_host_tier", None)
+    if host_nodes and host_tier is None:
+        _fail("page-conservation",
+              "host-resident radix nodes exist but the engine has no "
+              "host tier", host_nodes=len(host_nodes))
+    if host_tier is not None:
+        if index.host_pages != len(host_nodes):
+            _fail("page-conservation",
+                  "host_pages counter disagrees with the tree",
+                  counter=index.host_pages, tree=len(host_nodes))
+        entry_nodes = set(map(id, host_tier._entries))
+        missing = [n for n in host_nodes if id(n) not in entry_nodes]
+        if missing:
+            _fail("page-conservation",
+                  "host-resident radix nodes lack a host-tier mirror "
+                  "entry (their bytes are gone — a hit would install "
+                  "garbage)", nodes=len(missing))
+        if len(host_tier._entries) != len(host_nodes):
+            _fail("page-conservation",
+                  "host-tier mirror entries outlive their radix nodes "
+                  "(the tier's budget leaks)",
+                  entries=len(host_tier._entries),
+                  host_nodes=len(host_nodes))
+        if host_tier.pages_in_use > host_tier.capacity_pages:
+            _fail("page-conservation",
+                  "host tier exceeded its byte budget",
+                  pages_in_use=host_tier.pages_in_use,
+                  capacity_pages=host_tier.capacity_pages)
+        if host_tier.queue_len() > host_tier.queue_bound:
+            _fail("page-conservation",
+                  "host-tier drain queue exceeded its bound "
+                  "(backpressure is not reaching admission)",
+                  queue_len=host_tier.queue_len(),
+                  bound=host_tier.queue_bound)
     slot_allocs = [(s, s.alloc) for s in sched.slots if s.alloc is not None]
     private_owner: dict = {}
     for slot, a in slot_allocs:

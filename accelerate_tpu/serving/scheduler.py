@@ -566,6 +566,44 @@ class Scheduler:
             ring.rotate(-1)
         return req
 
+    def tenant_priority(self, name: str) -> int:
+        """A tenant's strict tier (lower = more important) — policy
+        input for the engine's cache-aware admission hold (a request
+        never waits on a lower-tier leader's prefill)."""
+        return self._spec(name).priority
+
+    def _group_prefix_sharers(self, name: str, head: Request) -> None:
+        """Cache-aware admission ordering (ISSUE 16): when `head` is
+        admitted, stable-promote the queued requests of the SAME tenant
+        that share its full shareable prefix to the queue front, so the
+        wave admits while the pages are hottest (held a few steps by
+        the engine's dedup hold, then mapped — one prefill or one
+        swap-in serves all of them). Bounded on purpose: reordering
+        never crosses a tenant (tiers, DRR deficits, and per-tenant
+        caps are untouched — DRR charges costs per pop regardless of
+        intra-tenant order) and is skipped entirely without a
+        prefix-caching allocator."""
+        alloc = self.allocator
+        if alloc is None or not getattr(alloc, "prefix_cache", False):
+            return
+        k = ((head.prompt_len - 1) // alloc.page_size) * alloc.page_size
+        q = self._queues.get(name)
+        if q is None or k <= 0 or len(q) < 2:
+            return
+        key = np.ascontiguousarray(head.prompt[:k], np.int32).tobytes()
+        sharers = [
+            r for r in q
+            if r.prompt_len > k
+            and np.ascontiguousarray(r.prompt[:k], np.int32).tobytes() == key
+        ]
+        if not sharers:
+            return
+        sharer_ids = {id(r) for r in sharers}
+        rest = [r for r in q if id(r) not in sharer_ids]
+        q.clear()
+        q.extend(sharers)
+        q.extend(rest)
+
     def admissions(self, now: float | None = None) -> list[tuple[Slot, Request]]:
         """Pop queued requests into free slots in policy order (tiers,
         then DRR). With a paged allocator, admission also reserves the
@@ -574,6 +612,15 @@ class Scheduler:
         A prefix hit starts `prompt_done` at the reused length — prefill
         covers only the uncached suffix."""
         now = self.clock() if now is None else now
+        # in-flight grouping: a request prefilling RIGHT NOW is the
+        # hottest possible head (its pages publish as it goes) — promote
+        # its queued same-tenant sharers so they admit behind it and
+        # ride the engine's dedup hold, instead of behind unrelated
+        # traffic whose admission could evict the shared pages.
+        # Idempotent: once the sharers lead the queue this is a no-op.
+        for slot in self.slots:
+            if slot.state is SlotState.PREFILL and slot.request is not None:
+                self._group_prefix_sharers(slot.request.tenant, slot.request)
         admitted = []
         for slot in self.slots:
             if slot.state is not SlotState.IDLE:
@@ -592,6 +639,7 @@ class Scheduler:
                 # (the ATP201 exception-window class)
                 slot.alloc = alloc
             req = self._pop_selected(name)
+            self._group_prefix_sharers(name, req)
             req.status = RequestStatus.RUNNING
             req.admitted_at = now
             slot.request = req

@@ -131,6 +131,18 @@ def configure_compilation_cache(
     if cache_dir is not None:
         cache_dir = cache_dir.strip()
         if cache_dir.lower() in _OFF:
+            # actively un-wire a previously-enabled cache: callers that
+            # force-enable a scoped cache (test fixtures) must be able to
+            # hand the process back with caching genuinely off, not just
+            # decline to enable it again
+            import jax
+
+            if jax.config.jax_compilation_cache_dir:
+                jax.config.update("jax_compilation_cache_dir", None)
+                from jax.experimental.compilation_cache import compilation_cache
+
+                compilation_cache.reset_cache()
+            _compilation_cache_dir_applied = None
             return None
         if not cache_dir:
             # `ACCELERATE_TPU_COMPILATION_CACHE= python ...` means "unset",

@@ -70,6 +70,7 @@ _PHASE_METRICS = {
     "server": ("server_http_load", "summary"),
     "pod": ("serving_pod_offered_load", "summary"),
     "serving_spec": ("serving_speculative_ab", "summary"),
+    "serving_host_tier": ("serving_host_tier_ab", "summary"),
 }
 
 
@@ -396,6 +397,66 @@ def _collect_greedy_tokens(sb, speculative: bool, draft_k: int):
     return [r.tokens for r in reqs]
 
 
+def _serving_host_tier_row(num_requests: int = 24) -> dict:
+    """Hierarchical-KV A/B smoke (ISSUE 16): the SAME seeded churn
+    trace — a prefix pool bigger than the HBM page pool, so hot prefixes
+    cycle through eviction — with the host tier off (baseline: eviction
+    destroys, hits re-prefill) and on (eviction swaps out, hits swap
+    back in). The row quotes prefill chunks per arm and their ratio
+    (the acceptance bar is >= 2x fewer with the tier on), the swap and
+    host-hit counters, plus a greedy exactness verdict: a prefix that
+    round-tripped through host DRAM must continue byte-identically, in
+    bf16 and int8 pools both."""
+    sb = _load_serve_bench()
+    keep = ("tokens_per_sec", "prefill_chunks", "prefix_hit_rate",
+            "prefix_hits_hbm", "prefix_hits_host", "swap_out_pages",
+            "swap_in_pages", "swap_in_p50_ms", "host_tier_pages_in_use",
+            "requests_finished", "compiles_decode")
+    row: dict = {}
+    for arm, budget in (("baseline", 0), ("host_tier", 1 << 28)):
+        engine, cfg = sb.build_tiny_engine(
+            "llama", num_slots=2, max_len=160, prefill_chunk=16,
+            page_size=4, num_pages=96, host_tier_bytes=budget)
+        s = sb.run_offered_load(engine, cfg.vocab_size,
+                                num_requests=num_requests, rate_hz=200.0,
+                                prompt_len=(4, 16),
+                                max_new_tokens=(4, 8),
+                                prefix_pool=6, prefix_len=112, seed=0)
+        row[arm] = {k: round(float(s[k]), 4) for k in keep if k in s}
+    base_chunks = row["baseline"].get("prefill_chunks", 0.0)
+    tier_chunks = row["host_tier"].get("prefill_chunks", 0.0)
+    if tier_chunks:
+        row["prefill_chunk_ratio"] = round(base_chunks / tier_chunks, 3)
+    row["greedy_byte_identical"] = all(
+        _host_tier_round_trip_exact(sb, kv) for kv in (None, "int8"))
+    return row
+
+
+def _host_tier_round_trip_exact(sb, kv_dtype) -> bool:
+    """Greedy exactness probe: decode a prompt cold, churn its pages out
+    to the host tier, decode it again through the swap-in path — the
+    tokens must match, and a swap-in must actually have happened (a
+    probe that silently skipped the round trip proves nothing)."""
+    import numpy as np
+
+    engine, _cfg = sb.build_tiny_engine(
+        "llama", num_slots=2, max_len=64, prefill_chunk=8, page_size=4,
+        num_pages=18, host_tier_bytes=1 << 28, kv_dtype=kv_dtype)
+    rng = np.random.default_rng(11)
+    pA, pB, pC = (rng.integers(0, _cfg.vocab_size, (33,)).astype(np.int32)
+                  for _ in range(3))
+    cold = engine.submit(pA, max_new_tokens=6)
+    engine.run_until_idle()
+    for p in (pB, pC):                      # churn A's pages to the tier
+        engine.submit(p, max_new_tokens=6)
+        engine.run_until_idle()
+    warm = engine.submit(pA, max_new_tokens=6)
+    engine.run_until_idle()
+    swapped = engine.metrics.swap_in_pages > 0
+    engine.close()
+    return swapped and list(cold.tokens) == list(warm.tokens)
+
+
 def _pod_row(num_requests: int = 10) -> dict:
     """Disaggregated-pod offered-load smoke (ISSUE 9): one prefill + one
     decode worker with KV pages shipping between them, behind the same
@@ -432,7 +493,7 @@ def _child_main() -> None:
 
         force_cpu_platform()
     if phase in ("serving", "serving_prefix", "server", "pod",
-                 "serving_spec"):
+                 "serving_spec", "serving_host_tier"):
         if not on_cpu:
             # spawned on the TPU-success path: if the tunnel dropped
             # after the train child, jax would silently fall back to CPU
@@ -448,7 +509,8 @@ def _child_main() -> None:
                "serving_prefix": _serving_prefix_row,
                "server": _server_row,
                "pod": _pod_row,
-               "serving_spec": _serving_spec_row}[phase]()
+               "serving_spec": _serving_spec_row,
+               "serving_host_tier": _serving_host_tier_row}[phase]()
         print(json.dumps(row))
         return
     if on_cpu:
@@ -513,6 +575,8 @@ def _emit(payload: dict, cpu: bool) -> None:
         extra["pod"] = _phase_row("pod", _run_phase("pod", cpu))
         extra["serving_spec"] = _phase_row(
             "serving_spec", _run_phase("serving_spec", cpu))
+        extra["serving_host_tier"] = _phase_row(
+            "serving_host_tier", _run_phase("serving_host_tier", cpu))
     _normalize_row(payload, "llama_train_tokens_per_sec_per_chip",
                    "tokens/s/chip")
     payload["schema_version"] = _SCHEMA_VERSION

@@ -73,7 +73,8 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       page_size: int = 16, prefix_cache: bool = True,
                       tenants=None, kv_dtype=None,
                       paged_attention="auto", speculative: bool = False,
-                      draft_k: int = 4):
+                      draft_k: int = 4, num_pages: int | None = None,
+                      host_tier_bytes: int = 0):
     """A small engine on the named family (tiny config, fresh params).
     `metrics_port` turns on the engine's Prometheus endpoint (0 binds an
     ephemeral port, reported on `engine.metrics_server.port`);
@@ -114,7 +115,8 @@ def build_tiny_engine(family_name: str = "llama", num_slots: int = 4,
                       kv_dtype=kv_dtype, paged_attention=paged_attention,
                       speculative=((family, cfg, params) if speculative
                                    else None),
-                      draft_k=draft_k)
+                      draft_k=draft_k, num_pages=num_pages,
+                      host_tier_bytes=host_tier_bytes)
     return Engine(family, cfg, params, ec), cfg
 
 
@@ -143,7 +145,9 @@ def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
                           max_queue: int = 64, seed: int = 0,
                           page_size: int = 16, prefix_cache: bool = True,
                           metrics_port: int | None = None, tenants=None,
-                          kv_dtype=None, paged_attention="auto"):
+                          kv_dtype=None, paged_attention="auto",
+                          num_pages: int | None = None,
+                          host_tier_bytes: int = 0):
     """A disaggregated pod (serving.pod.PodEngine) on the named family:
     `pod_roles=(N, M)` prefill/decode workers, optionally `tensor_parallel`
     chips per worker. Same submit/step surface as the single engine, so
@@ -172,7 +176,9 @@ def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
                       cache_dtype=jnp.bfloat16, seed=seed,
                       page_size=page_size, prefix_cache=prefix_cache,
                       metrics_port=metrics_port, tenants=tenants,
-                      kv_dtype=kv_dtype, paged_attention=paged_attention)
+                      kv_dtype=kv_dtype, paged_attention=paged_attention,
+                      num_pages=num_pages,
+                      host_tier_bytes=host_tier_bytes)
     pc = PodConfig(prefill_workers=pod_roles[0], decode_workers=pod_roles[1],
                    tensor_parallel=tensor_parallel)
     return PodEngine(family, cfg, params, ec, pc), cfg
@@ -607,6 +613,18 @@ def main() -> None:
                         "prefix + unique suffix")
     p.add_argument("--page-size", type=int, default=16,
                    help="KV pool page size (prefix reuse is page-granular)")
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="HBM page-pool size (default num_slots * "
+                        "pages_per_slot). Shrink it under --prefix-pool "
+                        "for the CHURN workload: a prefix pool bigger "
+                        "than the HBM budget thrashes destructively "
+                        "without a host tier and keeps hitting with one")
+    p.add_argument("--host-tier-bytes", type=int, default=0,
+                   help="host-DRAM overflow tier budget for evicted KV "
+                        "pages (hierarchical KV): evictions swap out "
+                        "instead of destroying, radix hits on "
+                        "host-resident prefixes swap back in. 0 = off "
+                        "(the A/B baseline on the same seeded trace)")
     p.add_argument("--no-prefix-cache", action="store_true",
                    help="disable cross-request prefix reuse (paged cache "
                         "kept) — the A/B baseline")
@@ -700,7 +718,9 @@ def main() -> None:
             prefix_cache=not args.no_prefix_cache,
             metrics_port=args.metrics_port,
             kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
-            paged_attention=False if args.no_paged_attention else "auto")
+            paged_attention=False if args.no_paged_attention else "auto",
+            num_pages=args.num_pages,
+            host_tier_bytes=args.host_tier_bytes)
     else:
         engine, cfg = build_tiny_engine(
             args.family, num_slots=args.slots, max_len=max_len,
@@ -709,7 +729,9 @@ def main() -> None:
             metrics_port=args.metrics_port,
             kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
             paged_attention=False if args.no_paged_attention else "auto",
-            speculative=args.speculative, draft_k=args.draft_k)
+            speculative=args.speculative, draft_k=args.draft_k,
+            num_pages=args.num_pages,
+            host_tier_bytes=args.host_tier_bytes)
     if engine.metrics_server is not None:
         import sys
 

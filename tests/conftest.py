@@ -25,24 +25,21 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
 
-# The suite is XLA-compile-bound (parity tests compile many shard_map /
-# pipeline / serving programs). Point jax's persistent compilation cache at
-# a stable per-checkout dir so repeat runs deserialize instead of
-# recompiling; jax's own >=1s-compile-time threshold keeps the cache small.
-# (Do NOT drop the threshold to 0 here: caching the suite's hundreds of
-# sub-second programs was tried for the ISSUE 7 headroom satellite and
-# deserializing them segfaulted jaxlib on this line — reads are not gated
-# by the threshold, so a cache dir polluted with small entries crashes
-# every later run until wiped.)
-# ACCELERATE_TPU_COMPILATION_CACHE=off disables (the helper honors it).
+# The persistent compilation cache is DISABLED for the suite. It was
+# pointed at a per-checkout .xla_test_cache for the ISSUE 7 headroom work,
+# but this jaxlib segfaults executing deserialized entries (ISSUE 7 saw it
+# for sub-second programs; ISSUE 16 reproduced it for ordinary jit_step_fn /
+# jit_prefill entries too). On an idle machine nothing crosses jax's
+# >=1s-compile-time write threshold, so the cache never helped a healthy
+# run — but on a loaded machine the suite's own compiles cross 1s, get
+# persisted mid-run, and the next identical-HLO trace deserializes the
+# fresh entry and segfaults the whole session. Net value negative: off.
+# (Export ACCELERATE_TPU_COMPILATION_CACHE=<dir> to opt back in; wipe the
+# dir at the first "Fatal Python error" with jit_* entries present.)
 from accelerate_tpu.utils.constants import ENV_COMPILATION_CACHE  # noqa: E402
 from accelerate_tpu.utils.environment import configure_compilation_cache  # noqa: E402
 
-os.environ.setdefault(
-    ENV_COMPILATION_CACHE,
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".xla_test_cache"),
-)
+os.environ.setdefault(ENV_COMPILATION_CACHE, "off")
 configure_compilation_cache()
 
 # Serving-state sanitizer (ISSUE 13): every engine the suite builds

@@ -1,0 +1,361 @@
+"""Hierarchical KV cache (ISSUE 16): the host-DRAM overflow tier and
+the cache-aware scheduler that rides on it.
+
+The contract under test: an evicted refcount-0 prefix swaps OUT to a
+byte-budgeted host mirror instead of dying; a radix hit on the
+host-resident tail swaps back IN through the jitted transport pair
+before admission — token-exactly, in fp32 and int8 pools both, with
+compile counts flat across every hit/miss/swap mix (the transport pair
+compiles once each). Backpressure stalls the ADMISSION, never decode; a
+budget-full tier falls back to the classic destructive eviction. On the
+scheduling side, N concurrent identical prompts cost exactly ONE full
+prefill (in-flight dedup), and queued prefix-sharers admit back to
+back. The pod router routes shipments to the worker already holding
+the prefix.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.models import gpt2
+from accelerate_tpu.serving import Engine, EngineConfig, RequestStatus
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _persistent_compile_cache(tmp_path_factory):
+    from accelerate_tpu.utils.environment import configure_compilation_cache
+
+    prev = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
+    os.environ.setdefault(
+        "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
+    configure_compilation_cache(
+        str(tmp_path_factory.mktemp("xla_cache")), force=True)
+    yield
+    # scoped: hand the process back with caching OFF — a later module that
+    # re-traces an AOT-compiled train step would deserialize a threshold-0
+    # entry from this dir and segfault jaxlib (ISSUE 16 hit this the moment
+    # an engine module sorted before test_launched_scripts)
+    if prev is None:
+        os.environ.pop(
+            "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", None)
+    configure_compilation_cache("off", force=True)
+
+
+@pytest.fixture(scope="module")
+def gpt2_setup():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _engine(cfg, params, **overrides):
+    defaults = dict(num_slots=2, max_len=64, prefill_chunk=8, page_size=4,
+                    cache_dtype=jnp.float32, sanitize=True,
+                    host_tier_bytes=1 << 28)
+    defaults.update(overrides)
+    return Engine(gpt2, cfg, params, EngineConfig(**defaults))
+
+
+def _prompt(rng, n, vocab):
+    return rng.integers(0, vocab, (n,)).astype(np.int32)
+
+
+def _churn_out(eng, cfg, rng, n=33, rounds=2):
+    """Fill the pool with fresh prefixes until earlier ones evict."""
+    for _ in range(rounds):
+        r = eng.submit(_prompt(rng, n, cfg.vocab_size), max_new_tokens=4)
+        eng.run_until_idle()
+        assert r.status is RequestStatus.FINISHED
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: swap-out / swap-in round trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv", [None, "int8"])
+def test_swap_round_trip_token_exact(gpt2_setup, kv):
+    """Cold-decode a prompt, churn its pages out to the host tier,
+    decode it again through the swap-in path: byte-identical tokens,
+    and the hit is attributed to the HOST tier, not HBM. int8 pools
+    swap codes + scales verbatim, so quantized sharing stays
+    bit-stable across the round trip."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18, kv_dtype=kv,
+                  cache_dtype=jnp.float32 if kv is None else jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompt = _prompt(rng, 33, cfg.vocab_size)
+    cold = eng.submit(prompt, max_new_tokens=6)
+    eng.run_until_idle()
+    _churn_out(eng, cfg, rng)
+    assert eng.allocator.index.host_pages > 0, "churn must swap out"
+    warm = eng.submit(prompt, max_new_tokens=6)
+    eng.run_until_idle()
+    assert list(warm.tokens) == list(cold.tokens)
+    assert eng.metrics.swap_in_pages > 0
+    assert eng.metrics.prefix_hits_host >= 1
+    assert eng.metrics.swap_out_pages >= eng.metrics.swap_in_pages
+    eng.close()
+
+
+def test_compile_counts_flat_across_swap_mixes(gpt2_setup):
+    """Cold miss, HBM hit, host-tier hit, partial-host hit: every mix
+    runs the same five programs — admit/prefill/decode plus the
+    transport extract/install pair — each compiled exactly once."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18)
+    rng = np.random.default_rng(1)
+    shared = _prompt(rng, 28, cfg.vocab_size)
+
+    def run(p):
+        r = eng.submit(p, max_new_tokens=3)
+        eng.run_until_idle()
+        return r
+
+    run(shared)                                        # cold
+    run(np.concatenate([shared, _prompt(rng, 5, cfg.vocab_size)]))  # HBM hit
+    _churn_out(eng, cfg, rng, rounds=3)
+    run(shared)                                        # host hit
+    _churn_out(eng, cfg, rng, rounds=3)
+    run(np.concatenate([shared, _prompt(rng, 5, cfg.vocab_size)]))  # partial
+    assert eng.metrics.swap_in_pages > 0
+    assert eng.compile_stats() == {"admit": 1, "prefill": 1, "decode": 1,
+                                   "extract": 1, "install": 1}
+    eng.close()
+
+
+def test_host_tier_full_falls_back_to_destructive(gpt2_setup):
+    """A tier whose byte budget is exhausted (capacity 0 pages here)
+    declines every offer: eviction destroys as before, the request
+    re-prefills from scratch, and nothing deadlocks or stalls."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18,
+                  host_tier_bytes=1)         # < one page: capacity 0
+    assert eng._host_tier.capacity_pages == 0
+    rng = np.random.default_rng(2)
+    prompt = _prompt(rng, 33, cfg.vocab_size)
+    cold = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    _churn_out(eng, cfg, rng)
+    assert eng._host_tier.rejected_pages > 0
+    assert eng.allocator.index.host_pages == 0
+    warm = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    assert list(warm.tokens) == list(cold.tokens)
+    assert eng.metrics.swap_in_pages == 0
+    assert eng.metrics.prefix_hits_host == 0
+    eng.close()
+
+
+def test_swap_in_racing_eviction_materializes_synchronously(gpt2_setup):
+    """The drain thread is killed so no background device->host copy
+    ever runs; a swap-in arriving before its own swap-out drained must
+    materialize the bytes synchronously (the per-entry lock path) and
+    still decode token-exactly."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18)
+    eng._host_tier._queue.put(None)          # drain thread exits
+    eng._host_tier._drain.join(timeout=5.0)
+    rng = np.random.default_rng(3)
+    prompt = _prompt(rng, 33, cfg.vocab_size)
+    cold = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    _churn_out(eng, cfg, rng)
+    assert eng.allocator.index.host_pages > 0
+    for e in list(eng._host_tier._entries.values()):
+        assert e.data is None, "nothing may have drained"
+    warm = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    assert list(warm.tokens) == list(cold.tokens)
+    assert eng.metrics.swap_in_pages > 0
+    eng.close()
+
+
+def test_swap_queue_backpressure_stalls_admission_not_decode(gpt2_setup):
+    """When the bounded drain queue cannot absorb an eviction's worth
+    of offers, allocate() returns None BEFORE evicting anything — the
+    request waits in the queue, the tree is untouched, and no victim
+    is destroyed while the tier still has budget for it."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18)
+    rng = np.random.default_rng(4)
+    eng.submit(_prompt(rng, 33, cfg.vocab_size), max_new_tokens=4)
+    eng.run_until_idle()
+    alloc = eng.allocator
+    cached_before = alloc.index.cached_pages
+    assert cached_before > 0
+    eng.allocator.swap_stall = lambda need: True     # queue reports full
+    from accelerate_tpu.serving.scheduler import Request
+
+    internal = Request(prompt=_prompt(rng, 33, cfg.vocab_size),
+                       max_new_tokens=30)
+    assert alloc.allocate(internal) is None
+    assert alloc.index.cached_pages == cached_before, \
+        "a stalled admission must not evict"
+    assert eng._host_tier.swapped_out_pages == 0
+    eng.close()
+
+
+def test_rollback_reverts_swap_ins(gpt2_setup):
+    """An allocation that re-homed host-resident chunks but then failed
+    to admit must put them BACK: residency flips to host, the mirror
+    entries survive, the fresh pages return to the pool — and a later
+    admission still swaps in token-exactly."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_pages=18)
+    rng = np.random.default_rng(5)
+    prompt = _prompt(rng, 33, cfg.vocab_size)
+    cold = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    _churn_out(eng, cfg, rng)
+    alloc = eng.allocator
+    host_before = alloc.index.host_pages
+    free_before = alloc.pages_free
+    out_before = eng._host_tier.swapped_out_pages
+    assert host_before > 0
+    from accelerate_tpu.serving.scheduler import Request
+
+    a = alloc.allocate(Request(prompt=prompt, max_new_tokens=4))
+    # the allocation itself may evict MORE pages into the tier — only
+    # the swap_ins delta is this allocation's to revert
+    new_out = eng._host_tier.swapped_out_pages - out_before
+    assert a is not None and a.swap_ins
+    assert alloc.index.host_pages == host_before + new_out - len(a.swap_ins)
+    alloc.rollback(a)
+    assert alloc.index.host_pages == host_before + new_out
+    assert alloc.pages_free >= free_before
+    assert len(eng._host_tier._entries) == alloc.index.host_pages
+    warm = eng.submit(prompt, max_new_tokens=4)
+    eng.run_until_idle()
+    assert list(warm.tokens) == list(cold.tokens)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# cache-aware scheduling: in-flight dedup + prefix grouping
+# ---------------------------------------------------------------------------
+
+
+def test_identical_prompts_cost_one_full_prefill(gpt2_setup):
+    """N concurrent identical prompts: the leader prefills the shared
+    prefix once; every follower waits for the published pages and pays
+    only its own unshareable final partial page — not N full prefills."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_slots=4, num_pages=96)
+    rng = np.random.default_rng(6)
+    prompt = _prompt(rng, 17, cfg.vocab_size)
+    reqs = [eng.submit(prompt.copy(), max_new_tokens=4) for _ in range(4)]
+    eng.run_until_idle()
+    toks = [list(r.tokens) for r in reqs]
+    assert all(t == toks[0] for t in toks)
+    # leader: 3 chunks of 8 for 17 tokens; followers: 1 chunk each for
+    # the final partial page. Without dedup this would be 12.
+    assert eng.metrics.prefill_chunks == 6
+    assert eng.metrics.prefix_dedup_hits >= 1
+    eng.close()
+
+
+def test_dedup_leader_cancelled_mid_prefill(gpt2_setup):
+    """A follower holding for a leader's published pages must not hang
+    when the leader is cancelled mid-prefill: the hold re-evaluates
+    each admission attempt and the follower prefills itself."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_slots=2, num_pages=96, max_len=128,
+                  prefill_chunk=8)
+    rng = np.random.default_rng(7)
+    prompt = _prompt(rng, 65, cfg.vocab_size)     # many chunks to cancel in
+    leader = eng.submit(prompt, max_new_tokens=4)
+    eng.step()                                    # leader admits, chunk 1
+    follower = eng.submit(prompt.copy(), max_new_tokens=4)
+    eng.step()
+    assert follower.status is RequestStatus.QUEUED, \
+        "follower must hold while the leader prefills"
+    assert eng.cancel(leader)
+    eng.run_until_idle()
+    assert follower.status is RequestStatus.FINISHED
+    assert len(follower.tokens) == 4
+    eng.close()
+
+
+def test_dedup_never_waits_on_lower_priority_leader(gpt2_setup):
+    """Bounded wait: a gold request never holds for a bronze leader —
+    the tenant-priority guard keeps dedup from inverting QoS."""
+    cfg, params = gpt2_setup
+    from accelerate_tpu.serving import TenantSpec
+
+    tenants = [TenantSpec("gold", priority=0),
+               TenantSpec("bronze", priority=2)]
+    eng = _engine(cfg, params, num_slots=2, num_pages=96, max_len=128,
+                  prefill_chunk=8, tenants=tenants)
+    rng = np.random.default_rng(8)
+    prompt = _prompt(rng, 65, cfg.vocab_size)
+    eng.submit(prompt, max_new_tokens=4, tenant="bronze")
+    eng.step()                                    # bronze leader admits
+    gold = eng.submit(prompt.copy(), max_new_tokens=4, tenant="gold")
+    eng.step()
+    # the gold request must admit (second slot) rather than hold
+    assert gold.status is RequestStatus.RUNNING
+    eng.run_until_idle()
+    assert gold.status is RequestStatus.FINISHED
+    eng.close()
+
+
+def test_admission_groups_queued_prefix_sharers(gpt2_setup):
+    """With one slot, a queued request sharing the admitted head's
+    prefix is promoted ahead of unrelated traffic, so the shared pages
+    are still hot (no eviction window between them)."""
+    cfg, params = gpt2_setup
+    eng = _engine(cfg, params, num_slots=1, num_pages=96)
+    rng = np.random.default_rng(9)
+    shared = _prompt(rng, 16, cfg.vocab_size)
+    a1 = eng.submit(np.concatenate([shared, _prompt(rng, 3, cfg.vocab_size)]),
+                    max_new_tokens=3)
+    other = eng.submit(_prompt(rng, 19, cfg.vocab_size), max_new_tokens=3)
+    a2 = eng.submit(np.concatenate([shared, _prompt(rng, 4, cfg.vocab_size)]),
+                    max_new_tokens=3)
+    eng.run_until_idle()
+    assert all(r.status is RequestStatus.FINISHED for r in (a1, other, a2))
+    assert a2.finished_at < other.finished_at, \
+        "the prefix sharer must ride directly behind its head"
+    assert eng.metrics.prefix_hits >= 1
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# pod: prefix-affinity placement
+# ---------------------------------------------------------------------------
+
+
+def test_pod_routes_to_prefix_resident_worker(gpt2_setup):
+    """Repeat prompts land on the decode worker already holding their
+    prefix (HBM or host tier) instead of round-robining by load — the
+    affinity counter proves the placement, the tokens prove it stayed
+    exact."""
+    from accelerate_tpu.serving.pod import PodConfig, PodEngine
+
+    cfg, params = gpt2_setup
+    pod = PodEngine(gpt2, cfg, params,
+                    EngineConfig(num_slots=2, max_len=64, prefill_chunk=8,
+                                 page_size=4, num_pages=18,
+                                 cache_dtype=jnp.float32, sanitize=True,
+                                 host_tier_bytes=1 << 28),
+                    PodConfig(prefill_workers=1, decode_workers=2))
+    rng = np.random.default_rng(10)
+    prompt = _prompt(rng, 33, cfg.vocab_size)
+    r1 = pod.submit(prompt, max_new_tokens=4)
+    pod.run_until_idle()
+    for _ in range(2):   # churn the resident worker's pool via the tier
+        pod.submit(_prompt(rng, 33, cfg.vocab_size), max_new_tokens=4)
+        pod.run_until_idle()
+    r2 = pod.submit(prompt, max_new_tokens=4)
+    pod.run_until_idle()
+    assert list(r2.tokens) == list(r1.tokens)
+    s = pod.metrics_summary()
+    assert s["pod_affinity_hits"] >= 1
+    assert pod.compile_stats() == {"admit": 1, "prefill": 1, "decode": 1,
+                                   "extract": 1, "install": 1}
+    pod.close()

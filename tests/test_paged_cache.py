@@ -37,10 +37,20 @@ def _persistent_compile_cache(tmp_path_factory):
 
     from accelerate_tpu.utils.environment import configure_compilation_cache
 
+    prev = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
     os.environ.setdefault(
         "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
     configure_compilation_cache(
         str(tmp_path_factory.mktemp("xla_cache")), force=True)
+    yield
+    # scoped: hand the process back with caching OFF — a later module that
+    # re-traces an AOT-compiled train step would deserialize a threshold-0
+    # entry from this dir and segfault jaxlib (ISSUE 16 hit this the moment
+    # an engine module sorted before test_launched_scripts)
+    if prev is None:
+        os.environ.pop(
+            "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", None)
+    configure_compilation_cache("off", force=True)
 
 
 @pytest.fixture(scope="module")

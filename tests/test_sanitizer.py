@@ -31,10 +31,20 @@ from accelerate_tpu.serving.sanitizer import resolve_sanitize
 def _persistent_compile_cache(tmp_path_factory):
     from accelerate_tpu.utils.environment import configure_compilation_cache
 
+    prev = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
     os.environ.setdefault(
         "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
     configure_compilation_cache(
         str(tmp_path_factory.mktemp("xla_cache")), force=True)
+    yield
+    # scoped: hand the process back with caching OFF — a later module that
+    # re-traces an AOT-compiled train step would deserialize a threshold-0
+    # entry from this dir and segfault jaxlib (ISSUE 16 hit this the moment
+    # an engine module sorted before test_launched_scripts)
+    if prev is None:
+        os.environ.pop(
+            "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", None)
+    configure_compilation_cache("off", force=True)
 
 
 @pytest.fixture(scope="module")
@@ -157,6 +167,84 @@ def test_fires_on_scheduler_book_corruption(gpt2_setup):
     r2.status = RequestStatus.QUEUED
     eng.cancel(r1)
     eng.cancel(r2)
+
+
+# ---------------------------------------------------------------------------
+# the two-tier (ISSUE 16) joins: host residency vs the tier's mirror
+# ---------------------------------------------------------------------------
+
+
+def _host_tier_engine(cfg, params, rng, serves=2):
+    """An engine with host-resident radix nodes: one prompt cached, then
+    churned out to the tier. Two serves are the cheapest churn that
+    leaves a host-resident node; serves=3 builds a deeper host chain
+    (a parent->child pair) for the suffix-property test."""
+    eng = _engine(cfg, params, page_size=4, num_pages=18,
+                  host_tier_bytes=1 << 28)
+    for _ in range(serves):
+        r = eng.submit(_prompt(rng, 33, cfg.vocab_size), max_new_tokens=2)
+        eng.run_until_idle()
+        assert r.status is RequestStatus.FINISHED
+    assert eng.allocator.index.host_pages > 0
+    return eng
+
+
+def test_fires_on_host_node_without_mirror(gpt2_setup):
+    """A host-resident node whose tier entry vanished is a prefix whose
+    bytes are GONE — a hit would install garbage."""
+    cfg, params = gpt2_setup
+    eng = _host_tier_engine(cfg, params, np.random.default_rng(20))
+    node = next(iter(eng._host_tier._entries))
+    del eng._host_tier._entries[node]
+    with pytest.raises(SanitizerViolation) as ei:
+        eng.step()
+    assert ei.value.check == "page-conservation"
+    assert "mirror" in str(ei.value)
+    eng.close()
+
+
+def test_fires_on_host_node_claiming_hbm_page(gpt2_setup):
+    """A host-resident node still naming an HBM page double-owns it —
+    the residency flip and the page release must be atomic."""
+    cfg, params = gpt2_setup
+    eng = _host_tier_engine(cfg, params, np.random.default_rng(21))
+    node = next(iter(eng._host_tier._entries))
+    node.page = 0
+    with pytest.raises(SanitizerViolation) as ei:
+        eng.step()
+    assert ei.value.check == "page-conservation"
+    assert "host-resident" in str(ei.value)
+    eng.close()
+
+
+def test_fires_on_host_pages_counter_drift(gpt2_setup):
+    cfg, params = gpt2_setup
+    eng = _host_tier_engine(cfg, params, np.random.default_rng(22))
+    eng.allocator.index.host_pages += 1
+    with pytest.raises(SanitizerViolation) as ei:
+        eng.step()
+    assert ei.value.check == "page-conservation"
+    assert "host_pages" in str(ei.value)
+    eng.close()
+
+
+def test_fires_on_hbm_child_under_host_parent(gpt2_setup):
+    """Residency must be a suffix property along any root path —
+    eviction drains leaf-first, so an HBM node under a host parent
+    means the eviction order was violated."""
+    cfg, params = gpt2_setup
+    eng = _host_tier_engine(cfg, params, np.random.default_rng(23), serves=3)
+    node = next(n for n in eng._host_tier._entries if n.children)
+    child = next(iter(node.children.values()))
+    assert child.residency == "host"
+    # fake an HBM child: give it a page the sanitizer can see
+    eng._host_tier.discard(child)
+    child.residency = "hbm"
+    child.page = eng.allocator.pool._free[0]
+    with pytest.raises(SanitizerViolation) as ei:
+        eng.step()
+    assert ei.value.check == "page-conservation"
+    eng.close()
 
 
 # ---------------------------------------------------------------------------
