@@ -20,6 +20,7 @@ SUBCOMMAND_MODULES = [
     "accelerate_tpu.commands.cloud",
     "accelerate_tpu.commands.lint",
     "accelerate_tpu.commands.serve",
+    "accelerate_tpu.commands.pod",
     "accelerate_tpu.commands.incident",
     "accelerate_tpu.commands.profile",
     "accelerate_tpu.commands.bench_diff",

@@ -71,6 +71,12 @@ _LOWER_IS_BETTER = {
     "mxu_idle_fraction", "decode_mxu_idle_fraction",
     # hierarchical KV: PCIe round-trip cost per swapped-in prefix page
     "swap_in_p50_ms", "swap_in_p99_ms", "swap_in_mean_ms",
+    # true multi-host pod (ISSUE 17): every replayed request re-pays its
+    # prefill, every lost worker is an availability event, and recovery
+    # latency is the time a stream stalls before its replay lands
+    "pod_requests_replayed", "pod_workers_lost",
+    "pod_recovery_latency_p50_ms", "pod_recovery_latency_p99_ms",
+    "pod_recovery_latency_mean_ms",
 }
 
 

@@ -347,9 +347,14 @@ class HttpFrontDoor:
                               head_only=False) -> None:
         # the SAME negotiation as the standalone exporter: an OpenMetrics
         # Accept gets bucket histograms with trace-id exemplars on the
-        # latency series, everyone else format 0.0.4
-        text, ctype = negotiate_exposition(headers.get("accept"),
-                                           self.service.engine.registry)
+        # latency series, everyone else format 0.0.4. A distributed pod
+        # front merges every worker's heartbeat-shipped snapshot into the
+        # exposition (telemetry/aggregate.merged_registry) — ask for that
+        # richer registry when the engine offers one.
+        build = getattr(self.service.engine, "exposition_registry", None)
+        registry = build() if build is not None \
+            else self.service.engine.registry
+        text, ctype = negotiate_exposition(headers.get("accept"), registry)
         await self._send_raw(writer, 200, text.encode(), ctype,
                              head_only=head_only)
 

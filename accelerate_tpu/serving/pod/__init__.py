@@ -14,9 +14,15 @@ Two composable layers over the continuous-batching engine:
   API over the fleet, with role assignment, page-transfer bookkeeping,
   and decode-side backpressure handled host-side.
 
-Both layers are proven token-exact against the single-device engine on
+- **Layer 3 (multi-host, `pod.distributed`)** — the same dataflow over
+  OS processes: a socket wire format for shipments, worker heartbeats,
+  re-prefill-from-prompt failure recovery, and elastic role
+  rebalancing. `DistributedPodRouter` is the front; `PodRouter` stays
+  the in-process `local` transport.
+
+All layers are proven token-exact against the single-device engine on
 seeded traces (tier-1, forced-host-device CPU meshes). See
-docs/serving.md "Pod-scale serving".
+docs/serving.md "Pod-scale serving" and "True multi-host pod".
 """
 
 from .mesh import (
@@ -26,7 +32,7 @@ from .mesh import (
     tensor_mesh,
 )
 from .router import PodConfig, PodEngine, PodRouter
-from .transfer import KVPageShipment, PageTransport
+from .transfer import KVPageShipment, PageTransport, place_shipment
 
 __all__ = [
     "tensor_mesh",
@@ -38,4 +44,16 @@ __all__ = [
     "PodEngine",
     "KVPageShipment",
     "PageTransport",
+    "place_shipment",
 ]
+
+
+def __getattr__(name):
+    # layer 3 is import-heavy (sockets/threads) and optional for layer
+    # 1/2 users — load it lazily on first touch
+    if name in ("DistributedPodConfig", "DistributedPodRouter",
+                "WorkerHandle", "build_local_distributed_pod"):
+        from . import distributed
+
+        return getattr(distributed, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

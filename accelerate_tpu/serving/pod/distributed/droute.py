@@ -1,0 +1,1120 @@
+"""DistributedPodRouter: the multi-host pod front, behind the same API.
+
+PR 9's `PodRouter` proved the disaggregated dataflow (prefill workers
+produce KV page shipments, decode workers own slots) inside one process.
+This router runs the SAME dataflow over channels: workers are separate
+OS processes reached through `SocketChannel`s (or in-process
+`WorkerServer`s over `LocalChannel`s — the deterministic test form), and
+the router holds no model, no params, no device state — it is pure
+bookkeeping plus the user-facing scheduler, which is exactly what lets
+it survive any worker dying.
+
+Exactness is inherited, not engineered: sampling keys fold the request
+key with the ABSOLUTE position (`engine.sample_slot`), so token `i` of a
+request is a pure function of (params, prompt, key, position) — the same
+schedule-independence that made the in-process pod byte-identical to the
+single engine makes the process boundary invisible, and makes recovery a
+replay: re-prefilling `prompt + delivered_tokens` with the original key
+samples its "first token" at position `prompt_len + d`, which IS token
+`d` of the original stream. Delivered tokens stand; the continuation is
+byte-identical; nothing is lost or duplicated.
+
+Failure model (every path funnels into `_replay_flight`):
+
+- dropped connection  -> worker lost immediately (`channel_drop`)
+- missed heartbeats   -> worker lost after `heartbeat_timeout_s`
+  (`heartbeat_timeout` — the hung-but-connected case)
+- stalled flight      -> no progress for `flight_timeout_s` while the
+  worker looks alive (`stalled` — a dropped submit/shipment/tokens
+  message on a lossy transport); the old attempt is cancelled
+- worker refuses an install -> `install_refused`; worker kills an
+  internal -> `worker_drop`; each replay bumps `attempt`, so stale
+  messages from superseded attempts are recognized and dropped
+- a flight that exhausts `max_attempts` is shed with the PR 9 shed
+  vocabulary (`SHED_WORKER_DROP` + retry_after) instead of looping
+
+Every recovery appends a `recovery_log` entry with its shed-code-style
+`recovery_reason` and bumps `serving_pod_worker_{lost,recovered}_total`
+/ `serving_pod_requests_replayed_total`; recovery latency (loss detected
+-> replayed stream's next token delivered) lands in the
+`serving_pod_recovery_latency_seconds` sketch.
+
+Elastic rebalancing replaces the config-time role ratio: roles are SOFT
+labels the router flips on idle workers from live queue-depth/occupancy
+signals, hysteresis-banded (`occupancy_low` .. `occupancy_high` is a
+dead zone, so it cannot flap) and bounded to one conversion per
+`rebalance_window_s`. Soft roles are also the last line of recovery: if
+a role has NO alive workers, any alive worker takes its work — a pod
+reduced to one surviving worker keeps serving.
+
+Backpressure is unchanged from PR 9: the router's pending-shipment
+buffer is bounded (`_assign_prefill` stops feeding when full) and
+`SocketChannel.send` blocks on a full send queue — the decode side
+stalls the ROUTER, never a prefill worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, AsyncIterator, Iterator
+
+import numpy as np
+
+from ....telemetry.aggregate import merged_registry
+from ....telemetry.export import start_metrics_server
+from ....telemetry.registry import MetricsRegistry
+from ....telemetry.trace import record_span
+from ....telemetry.watchdog import StallWatchdog, resolve_stall_timeout
+from ...engine import (
+    EngineConfig,
+    _as_raw_key,
+    close_request_trace,
+    prepare_request_tracing,
+)
+from ...metrics import ServingMetrics
+from ...sanitizer import check_distributed_router, resolve_sanitize
+from ...scheduler import Request, RequestStatus, SHED_WORKER_DROP
+from ..router import _FrontScheduler
+from ..transfer import KVPageShipment
+from .transport import Channel, ChannelListener
+from .wire import Message, shipment_from_message, shipment_to_message
+from .worker import WorkerServer
+
+__all__ = ["DistributedPodConfig", "DistributedPodRouter", "WorkerHandle",
+           "build_local_distributed_pod"]
+
+# recovery_reason vocabulary (shed-code style: machine-readable, stable)
+RECOVER_CHANNEL_DROP = "channel_drop"
+RECOVER_HEARTBEAT_TIMEOUT = "heartbeat_timeout"
+RECOVER_STALLED = "stalled"
+RECOVER_INSTALL_REFUSED = "install_refused"
+RECOVER_WORKER_DROP = "worker_drop"
+RECOVER_WORKER_DRAINED = "worker_drained"
+RECOVER_GAVE_UP = "gave_up"
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedPodConfig:
+    """Knobs for the multi-host pod front (`PodConfig`'s distributed
+    sibling). Timeouts are generous by default — CPU-test prefills are
+    slow; production tightens them."""
+
+    prefill_workers: int = 1
+    decode_workers: int = 1
+    max_pending_shipments: int | None = None
+    heartbeat_interval_s: float = 0.25
+    heartbeat_timeout_s: float = 5.0
+    # a flight with no progress for this long while its worker still
+    # heartbeats -> the message (not the worker) was lost: replay
+    flight_timeout_s: float = 60.0
+    max_attempts: int = 5
+    rebalance: bool = True
+    rebalance_window_s: float = 10.0
+    occupancy_high: float = 0.85
+    occupancy_low: float = 0.25
+
+    def __post_init__(self):
+        if self.prefill_workers < 1 or self.decode_workers < 1:
+            raise ValueError("a pod needs at least one worker per role")
+        if not (0.0 <= self.occupancy_low < self.occupancy_high <= 1.0):
+            raise ValueError(
+                "rebalance bands must satisfy 0 <= low < high <= 1 (got "
+                f"low={self.occupancy_low}, high={self.occupancy_high})")
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    """Router-side view of one worker process."""
+
+    worker_id: int
+    channel: Channel
+    role: str                         # SOFT label; router-authoritative
+    slots: int
+    alive: bool = False               # True after hello/first heartbeat
+    lost: bool = False
+    draining: bool = False
+    last_heartbeat: float = 0.0
+    stats: dict = dataclasses.field(default_factory=dict)
+    compiles: dict = dataclasses.field(default_factory=dict)
+    snapshot: dict | None = None      # last heartbeat's registry snapshot
+    local: Any = None                 # in-process WorkerServer to pump
+
+
+@dataclasses.dataclass
+class _DFlight:
+    """One user request's journey, replay-aware. Phases:
+    replay -> prefill -> pending -> decode (replay re-enters at replay)."""
+
+    user: Request
+    flight_id: int
+    key_raw: np.ndarray
+    attempt: int = 1
+    phase: str = "replay"
+    worker: int = -1                  # worker_id, -1 while router-held
+    shipment: KVPageShipment | None = None
+    copied: int = 0                   # internal tokens mirrored (decode)
+    base: int = 0                     # user tokens delivered before attempt
+    progress_at: float = 0.0
+    replay_started_at: float | None = None
+
+
+class DistributedPodRouter:
+    """Multi-host pod front behind the `ServingEngine` API."""
+
+    def __init__(
+        self,
+        engine_config: EngineConfig | None = None,
+        pod_config: DistributedPodConfig | None = None,
+        clock=time.monotonic,
+        listener: ChannelListener | None = None,
+    ):
+        self.engine_config = ec = engine_config or EngineConfig()
+        self.pod_config = pc = pod_config or DistributedPodConfig()
+        self._clock = clock
+        self.listener = listener
+        self._unclaimed: list[Channel] = []
+
+        self._sanitize = resolve_sanitize(ec.sanitize)
+        self.workers: dict[int, WorkerHandle] = {}
+        self._flights: dict[int, _DFlight] = {}        # flight_id -> flight
+        self._by_user: dict[int, _DFlight] = {}        # id(user) -> flight
+        self._pending: deque[int] = deque()            # flight_ids
+        self._replay: deque[int] = deque()             # flight_ids
+        self._next_flight_id = 1
+        self._max_pending = pc.max_pending_shipments
+        if self._max_pending is None:
+            self._max_pending = max(2, ec.num_slots)
+        # start the rebalance window NOW: converting on the first step
+        # (queue pressure exists before decode occupancy can) would
+        # reshape the pod before it ever ran its configured shape
+        self._last_rebalance = self._clock()
+        self.recovery_log: deque[dict] = deque(maxlen=256)
+
+        self.scheduler = _FrontScheduler(
+            self, max_len=ec.max_len, max_queue=ec.max_queue, clock=clock,
+            tenants=ec.tenants, prefill_chunk=ec.prefill_chunk)
+        self.registry = MetricsRegistry()
+        self.metrics = ServingMetrics(registry=self.registry)
+        reg = self.registry
+        self._c_shipments = reg.counter("serving_pod_shipments_total")
+        self._c_pages_shipped = reg.counter("serving_pod_pages_shipped_total")
+        self._c_stalls = reg.counter("serving_pod_backpressure_stalls_total")
+        self._c_lost = reg.counter("serving_pod_worker_lost_total")
+        self._c_recovered = reg.counter("serving_pod_worker_recovered_total")
+        self._c_replayed = reg.counter("serving_pod_requests_replayed_total")
+        self._c_stale = reg.counter("serving_pod_stale_messages_total")
+        self._c_conversions = {
+            d: reg.counter("serving_pod_role_conversions_total", direction=d)
+            for d in ("prefill_to_decode", "decode_to_prefill")}
+        self._h_recovery = reg.histogram(
+            "serving_pod_recovery_latency_seconds")
+        self._g_pending = reg.gauge("serving_pod_pending_shipments")
+        self._g_alive = reg.gauge("serving_pod_workers_alive")
+        self._g_occupancy = {
+            role: reg.gauge("serving_pod_role_occupancy", role=role)
+            for role in ("prefill", "decode")}
+        self.metrics_server = start_metrics_server(
+            ec.metrics_port, registry=self.registry)
+        self.watchdog: StallWatchdog | None = None
+        wd_timeout = resolve_stall_timeout(ec.watchdog_timeout_s)
+        if wd_timeout is not None:
+            self.watchdog = StallWatchdog(
+                wd_timeout, name="serving-pod-droute",
+                incident_dir=ec.incident_dir, registry=self.registry,
+                dumps=self.incident_dumps).start()
+        import jax
+
+        self._base_key = jax.random.key(ec.seed)
+
+    # -- worker registration -------------------------------------------------
+
+    def register_worker(self, channel: Channel, worker_id: int, role: str,
+                        slots: int | None = None,
+                        local: "WorkerServer | None" = None) -> WorkerHandle:
+        """Attach a worker the router already knows the identity of
+        (in-process factories, pre-spawned CLI workers). Socket workers
+        that dial the listener instead self-identify via `hello`."""
+        handle = WorkerHandle(
+            worker_id=int(worker_id), channel=channel, role=role,
+            slots=slots if slots is not None else self.engine_config.num_slots,
+            last_heartbeat=self._clock(), local=local)
+        self.workers[handle.worker_id] = handle
+        return handle
+
+    # -- request API (the ServingEngine surface) -----------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int = 32,
+        temperature: float = 0.0,
+        key=None,
+        eos_token_id: int | None = None,
+        deadline_s: float | None = None,
+        tenant: str = "default",
+        slo_ttft_s: float | None = None,
+        trace_id=None,
+        trace_parent=0,
+        trace_sampled: bool | None = None,
+    ) -> Request:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        req = Request(
+            prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), key=key,
+            eos_token_id=eos_token_id, deadline_s=deadline_s,
+            tenant=tenant, slo_ttft_s=slo_ttft_s,
+        )
+        prepare_request_tracing(req, trace_id, trace_parent, trace_sampled)
+        self.scheduler.shed_expired(self._clock())
+        for victim in self.scheduler.drain_shed():
+            self._finalize(victim)
+        self._assign_prefill()
+        self.scheduler.submit(req)
+        for victim in self.scheduler.drain_shed():
+            self._finalize(victim)
+        if req.done:
+            self._finalize(req)
+        else:
+            self._assign_prefill()
+        return req
+
+    def cancel(self, request: Request) -> bool:
+        if request.done:
+            return False
+        if self.scheduler.cancel(request):
+            self._finalize(request)
+            return True
+        flight = self._by_user.get(id(request))
+        if flight is None:
+            return False
+        self._retire_flight(flight, notify="cancel")
+        request.status = RequestStatus.CANCELLED
+        request.finished_at = self._clock()
+        self._finalize(request)
+        return True
+
+    def finish(self, request: Request) -> bool:
+        if request.done:
+            return False
+        flight = self._by_user.get(id(request))
+        if flight is None:
+            return False
+        self._retire_flight(flight, notify="finish")
+        request.status = RequestStatus.FINISHED
+        request.finished_at = self._clock()
+        self._finalize(request)
+        return True
+
+    def _retire_flight(self, flight: _DFlight, notify: str) -> None:
+        """Drop a flight from every router structure and (best-effort)
+        tell its worker to free the slot."""
+        if flight.phase == "pending":
+            try:
+                self._pending.remove(flight.flight_id)
+            except ValueError:
+                pass
+        elif flight.phase == "replay":
+            try:
+                self._replay.remove(flight.flight_id)
+            except ValueError:
+                pass
+        elif flight.worker in self.workers:
+            handle = self.workers[flight.worker]
+            if handle.alive:
+                try:
+                    handle.channel.send(Message(notify, {
+                        "flight_id": flight.flight_id,
+                        "attempt": flight.attempt}))
+                except ConnectionError:
+                    pass  # failure detection will reap the worker
+        self._flights.pop(flight.flight_id, None)
+        self._by_user.pop(id(flight.user), None)
+
+    def stream(self, request: Request) -> Iterator[int]:
+        sent = 0
+        while True:
+            while sent < len(request.tokens):
+                yield request.tokens[sent]
+                sent += 1
+            if request.done or not self.step():
+                break
+        yield from request.tokens[sent:]
+
+    async def astream(self, request: Request) -> AsyncIterator[int]:
+        import asyncio
+
+        sent = 0
+        while True:
+            while sent < len(request.tokens):
+                yield request.tokens[sent]
+                sent += 1
+            if request.done or not self.step():
+                break
+            await asyncio.sleep(0)
+        for tok in request.tokens[sent:]:
+            yield tok
+
+    # -- the drive loop ------------------------------------------------------
+
+    def step(self) -> bool:
+        """One router round: accept joiners, dispatch worker messages,
+        detect failures, replay, assign, forward, rebalance, pump local
+        workers. Returns False only when the whole pod is idle — while
+        flights are outstanding on remote workers it returns True even
+        if nothing moved this instant (the work is elsewhere)."""
+        if self.metrics.started_at is None:
+            self.metrics.started_at = self._clock()
+        if self.watchdog is not None:
+            self.watchdog.tick()
+        t0 = self._clock()
+        self.scheduler.shed_expired(t0)
+        for victim in self.scheduler.drain_shed():
+            self._finalize(victim)
+        self._accept_joiners()
+        worked = self._dispatch_inbound()
+        self._detect_failures()
+        self._watch_flights()
+        worked = self._assign_prefill() or worked
+        worked = self._forward_pending() or worked
+        self._rebalance()
+        for handle in self.workers.values():
+            if handle.local is not None and not handle.lost:
+                worked = handle.local.run_once() or worked
+        self._update_gauges()
+        self.metrics.stopped_at = self._clock()
+        if worked:
+            self.scheduler.note_step_time(self.metrics.stopped_at - t0)
+            live = len([f for f in self._flights.values()
+                        if f.phase == "decode"])
+            cap = sum(h.slots for h in self.workers.values()
+                      if h.alive and h.role == "decode") or 1
+            self.metrics.observe_step(live, cap, self.scheduler.queue_depth)
+        if self._sanitize:
+            check_distributed_router(self)
+        outstanding = bool(self._flights) or self.scheduler.queue_depth > 0
+        if not worked and outstanding and not self._has_local_workers():
+            time.sleep(0.001)   # remote work in flight: don't spin hot
+        return worked or outstanding
+
+    def run_until_idle(self) -> None:
+        while self.step():
+            pass
+
+    def _has_local_workers(self) -> bool:
+        return any(h.local is not None for h in self.workers.values())
+
+    # -- inbound -------------------------------------------------------------
+
+    def _accept_joiners(self) -> None:
+        if self.listener is not None:
+            self._unclaimed.extend(self.listener.accept_all())
+        still: list[Channel] = []
+        for ch in self._unclaimed:
+            claimed = False
+            for msg in ch.poll():
+                if msg.kind == "hello":
+                    self._claim(ch, msg.meta)
+                    claimed = True
+                # pre-hello chatter from an unclaimed channel is dropped
+            if not claimed and not ch.closed:
+                still.append(ch)
+        self._unclaimed = still
+
+    def _claim(self, channel: Channel, meta: dict) -> None:
+        wid = int(meta["worker_id"])
+        handle = self.workers.get(wid)
+        if handle is None:
+            self.workers[wid] = handle = WorkerHandle(
+                worker_id=wid, channel=channel,
+                role=str(meta.get("role", "decode")),
+                slots=int(meta.get("slots", self.engine_config.num_slots)))
+        else:
+            # rejoin on a fresh connection: the router already replayed
+            # everything this worker held — wipe its local state and
+            # re-impose the router-authoritative role label
+            handle.channel = channel
+            try:
+                channel.send(Message("reset", {}))
+                channel.send(Message("set_role", {"role": handle.role}))
+            except ConnectionError:
+                return
+        handle.slots = int(meta.get("slots", handle.slots))
+        self._mark_alive(handle)
+
+    def _mark_alive(self, handle: WorkerHandle) -> None:
+        handle.last_heartbeat = self._clock()
+        if handle.lost:
+            handle.lost = False
+            self._c_recovered.inc()
+        handle.alive = True
+
+    def _dispatch_inbound(self) -> bool:
+        worked = False
+        for handle in list(self.workers.values()):
+            if handle.channel.closed:
+                continue
+            for msg in handle.channel.poll():
+                kind = msg.kind
+                # heartbeats are liveness, not progress: counting them as
+                # work would keep an idle pod's step() returning True
+                worked = worked or kind not in ("heartbeat", "hello")
+                if kind == "heartbeat":
+                    self._on_heartbeat(handle, msg.meta)
+                elif kind == "shipment":
+                    self._on_shipment(handle, msg)
+                elif kind == "tokens":
+                    self._on_tokens(handle, msg.meta)
+                elif kind == "install_failed":
+                    self._on_flight_refusal(msg.meta, RECOVER_INSTALL_REFUSED,
+                                            want_phase="decode")
+                elif kind == "prefill_failed":
+                    self._on_flight_refusal(msg.meta, RECOVER_WORKER_DROP,
+                                            want_phase="prefill")
+                elif kind == "hello":
+                    handle.slots = int(msg.meta.get("slots", handle.slots))
+                    self._mark_alive(handle)
+                elif kind == "bye":
+                    self._on_bye(handle)
+        return worked
+
+    def _on_heartbeat(self, handle: WorkerHandle, meta: dict) -> None:
+        # heartbeat recency uses the ROUTER's receipt clock: worker
+        # clocks are not comparable across hosts
+        was_lost = handle.lost
+        self._mark_alive(handle)
+        handle.stats = meta.get("stats", {})
+        handle.compiles = meta.get("compiles", {})
+        handle.snapshot = meta.get("snapshot")
+        handle.slots = int(handle.stats.get("slots", handle.slots))
+        if was_lost:
+            # rejoined after a partition the router recovered around:
+            # its flights were replayed elsewhere — clear its state
+            try:
+                handle.channel.send(Message("reset", {}))
+                handle.channel.send(
+                    Message("set_role", {"role": handle.role}))
+            except ConnectionError:
+                pass
+
+    def _stale_msg(self, meta: dict, want_phase: str) -> "_DFlight | None":
+        """Resolve a job-bearing message to its flight, or count it
+        stale (unknown flight / superseded attempt / wrong phase)."""
+        flight = self._flights.get(int(meta["flight_id"]))
+        if (flight is None or int(meta["attempt"]) != flight.attempt
+                or flight.phase != want_phase):
+            self._c_stale.inc()
+            return None
+        return flight
+
+    def _on_shipment(self, handle: WorkerHandle, msg: Message) -> None:
+        flight = self._stale_msg(msg.meta, want_phase="prefill")
+        if flight is None:
+            return
+        shipment = shipment_from_message(msg)
+        now = self._clock()
+        user = flight.user
+        first = int(shipment.first_token)
+        user.tokens.append(first)
+        if shipment.first_logprob is not None:
+            user.logprobs.append(float(shipment.first_logprob))
+        user.token_times.append(now)
+        if user.first_token_at is None:
+            # replays keep the ORIGINAL TTFT — the user saw their first
+            # token when they saw it; recovery shows up in recovery
+            # latency, not a rewritten TTFT
+            user.first_token_at = now
+        if flight.replay_started_at is not None:
+            self._h_recovery.record(now - flight.replay_started_at)
+            flight.replay_started_at = None
+        flight.progress_at = now
+        done = (len(user.tokens) >= user.max_new_tokens
+                or (user.eos_token_id is not None
+                    and first == user.eos_token_id))
+        if done:
+            self._flights.pop(flight.flight_id, None)
+            self._by_user.pop(id(user), None)
+            user.status = RequestStatus.FINISHED
+            user.finished_at = now
+            self._finalize(user)
+            return
+        # the decode internal seeds the shipped first token via
+        # note_token, so its budget counts from that token: remaining
+        # stream = max_new minus tokens delivered BEFORE it
+        flight.base = len(user.tokens) - 1
+        shipment.max_new_tokens = user.max_new_tokens - flight.base
+        shipment.eos_token_id = user.eos_token_id
+        flight.phase = "pending"
+        flight.worker = -1
+        flight.shipment = shipment
+        self._pending.append(flight.flight_id)
+
+    def _on_tokens(self, handle: WorkerHandle, meta: dict) -> None:
+        flight = self._stale_msg(meta, want_phase="decode")
+        if flight is None:
+            return
+        user = flight.user
+        toks, lps = meta["tokens"], meta["logprobs"]
+        now = self._clock()
+        # full-state sync: keep the longest prefix seen for this attempt
+        # (idempotent under dup/reorder — a shorter late message is a
+        # no-op, never a rewind)
+        while flight.copied < len(toks):
+            i = flight.copied
+            user.tokens.append(int(toks[i]))
+            if i < len(lps):
+                user.logprobs.append(float(lps[i]))
+            user.token_times.append(now)
+            flight.copied += 1
+        flight.progress_at = now
+        if meta.get("done"):
+            if meta.get("status") == RequestStatus.FINISHED.value:
+                self._flights.pop(flight.flight_id, None)
+                self._by_user.pop(id(user), None)
+                user.status = RequestStatus.FINISHED
+                user.finished_at = now
+                self._finalize(user)
+            else:
+                # the worker's internal died under it — treat like a
+                # worker drop of this one flight
+                self._replay_flight(flight, RECOVER_WORKER_DROP)
+
+    def _on_flight_refusal(self, meta: dict, reason: str,
+                           want_phase: str) -> None:
+        flight = self._stale_msg(meta, want_phase=want_phase)
+        if flight is not None:
+            self._replay_flight(flight, reason)
+
+    def _on_bye(self, handle: WorkerHandle) -> None:
+        handle.draining = True
+        handle.alive = False
+        for flight in [f for f in self._flights.values()
+                       if f.worker == handle.worker_id
+                       and f.phase in ("prefill", "decode")]:
+            self._replay_flight(flight, RECOVER_WORKER_DRAINED)
+
+    # -- failure detection & recovery ----------------------------------------
+
+    def _detect_failures(self) -> None:
+        now = self._clock()
+        for handle in self.workers.values():
+            if not handle.alive or handle.lost:
+                continue
+            if handle.channel.closed:
+                self._lose_worker(handle, RECOVER_CHANNEL_DROP)
+            elif now - handle.last_heartbeat > self.pod_config.heartbeat_timeout_s:
+                self._lose_worker(handle, RECOVER_HEARTBEAT_TIMEOUT)
+
+    def _lose_worker(self, handle: WorkerHandle, reason: str) -> None:
+        handle.alive = False
+        handle.lost = True
+        self._c_lost.inc()
+        for flight in [f for f in self._flights.values()
+                       if f.worker == handle.worker_id
+                       and f.phase in ("prefill", "decode")]:
+            self._replay_flight(flight, reason)
+
+    def _watch_flights(self) -> None:
+        """A flight with no progress while its worker still heartbeats:
+        the MESSAGE was lost, not the worker. Cancel the old attempt on
+        the worker (frees its slot) and replay."""
+        timeout = self.pod_config.flight_timeout_s
+        if timeout is None or timeout <= 0:
+            return
+        now = self._clock()
+        for flight in list(self._flights.values()):
+            if flight.phase not in ("prefill", "decode"):
+                continue
+            if now - flight.progress_at <= timeout:
+                continue
+            handle = self.workers.get(flight.worker)
+            if handle is not None and handle.alive:
+                try:
+                    handle.channel.send(Message("cancel", {
+                        "flight_id": flight.flight_id,
+                        "attempt": flight.attempt}))
+                except ConnectionError:
+                    pass
+            self._replay_flight(flight, RECOVER_STALLED)
+
+    def _replay_flight(self, flight: _DFlight, reason: str) -> None:
+        """Recovery's one funnel: re-prefill-from-prompt. The replay
+        prompt is `prompt + delivered_tokens` with the ORIGINAL sampling
+        key — position-folded keys make the continuation byte-identical
+        (see module docstring). Attempt bumps so stragglers of the old
+        attempt are stale; attempt exhaustion sheds instead of looping."""
+        now = self._clock()
+        user = flight.user
+        old_worker = flight.worker
+        self.recovery_log.append({
+            "request_id": user.request_id,
+            "flight_id": flight.flight_id,
+            "attempt": flight.attempt,
+            "recovery_reason": reason,
+            "worker": old_worker,
+        })
+        if flight.phase == "pending":
+            try:
+                self._pending.remove(flight.flight_id)
+            except ValueError:
+                pass
+        if flight.attempt >= self.pod_config.max_attempts:
+            self._flights.pop(flight.flight_id, None)
+            self._by_user.pop(id(user), None)
+            user.status = RequestStatus.EXPIRED
+            user.reject_reason = (
+                f"gave up after {flight.attempt} attempts "
+                f"(last: {reason} on worker {old_worker})")
+            user.shed_code = SHED_WORKER_DROP
+            user.retry_after_s = self.scheduler.retry_after_estimate()
+            user.finished_at = now
+            self.recovery_log.append({
+                "request_id": user.request_id,
+                "flight_id": flight.flight_id,
+                "attempt": flight.attempt,
+                "recovery_reason": RECOVER_GAVE_UP,
+                "worker": old_worker,
+            })
+            self._finalize(user)
+            return
+        flight.attempt += 1
+        flight.phase = "replay"
+        flight.worker = -1
+        flight.shipment = None
+        flight.copied = 0
+        flight.progress_at = now
+        if flight.replay_started_at is None:
+            flight.replay_started_at = now
+        self._replay.append(flight.flight_id)
+        self._c_replayed.inc()
+
+    # -- assignment ----------------------------------------------------------
+
+    def _role_pool(self, role: str) -> list[WorkerHandle]:
+        """Alive, non-draining workers for a role. SOFT: if the role has
+        no alive workers at all, every alive worker qualifies — a pod
+        reduced to one survivor keeps serving both phases."""
+        alive = [h for h in self.workers.values()
+                 if h.alive and not h.draining]
+        preferred = [h for h in alive if h.role == role]
+        return preferred if preferred else alive
+
+    def _worker_load(self, wid: int) -> int:
+        return sum(1 for f in self._flights.values() if f.worker == wid)
+
+    def _pick_worker(self, role: str) -> WorkerHandle | None:
+        best, best_cap = None, 0
+        for h in self._role_pool(role):
+            cap = h.slots - self._worker_load(h.worker_id)
+            if cap > best_cap:
+                best, best_cap = h, cap
+        return best
+
+    def _assign_prefill(self) -> bool:
+        """Replay queue first (recovery outranks fresh admissions — the
+        user already has a live stream), then the front queue in policy
+        order. Stops at the pending-shipment bound: same backpressure
+        valve as PR 9."""
+        worked = False
+        now = self._clock()
+        while True:
+            if len(self._pending) >= self._max_pending:
+                break
+            handle = self._pick_worker("prefill")
+            if handle is None:
+                break
+            flight: _DFlight | None = None
+            if self._replay:
+                flight = self._flights.get(self._replay[0])
+                if flight is None:        # cancelled while queued
+                    self._replay.popleft()
+                    continue
+            if flight is None:
+                name = self.scheduler._select_tenant()
+                if name is None:
+                    break
+                user = self.scheduler._pop_selected(name)
+                user.status = RequestStatus.RUNNING
+                user.admitted_at = now
+                if user.trace_sampled:
+                    record_span("serving.queue_wait", user.submitted_at,
+                                now, trace=user.trace_id,
+                                parent=user.span_id, tenant=user.tenant)
+                key_raw = _as_raw_key(user.key)
+                if key_raw is None:
+                    # the single engine's derivation, verbatim — and
+                    # derived ONCE, router-side, so every replay of this
+                    # request reuses the same key (exactness under
+                    # recovery depends on it)
+                    import jax
+
+                    key_raw = jax.random.key_data(
+                        jax.random.fold_in(self._base_key, user.request_id))
+                flight = _DFlight(
+                    user=user, flight_id=self._next_flight_id,
+                    key_raw=np.asarray(key_raw, np.uint32),
+                    progress_at=now)
+                self._next_flight_id += 1
+                self._flights[flight.flight_id] = flight
+                self._by_user[id(user)] = flight
+            else:
+                self._replay.popleft()
+            user = flight.user
+            # replay prompt = original prompt + every delivered token:
+            # its "first token" samples at position prompt_len + d,
+            # which IS token d of the original stream
+            if user.tokens:
+                prompt = np.concatenate(
+                    [user.prompt, np.asarray(user.tokens, np.int32)])
+            else:
+                prompt = user.prompt
+            # budget 2 keeps the worker's internal RUNNING past its first
+            # token so pages are still mapped at extract — unless the
+            # prompt is one short of max_len (PR 9's rule, re-applied to
+            # the REPLAY length)
+            budget = 2 if len(prompt) + 2 <= self.engine_config.max_len \
+                else 1
+            try:
+                handle.channel.send(Message(
+                    "submit",
+                    {"flight_id": flight.flight_id,
+                     "attempt": flight.attempt,
+                     "budget": budget,
+                     "temperature": user.temperature},
+                    buffers=[np.asarray(prompt, np.int32), flight.key_raw]))
+            except ConnectionError:
+                self._lose_worker(handle, RECOVER_CHANNEL_DROP)
+                # _lose_worker did NOT see this flight (worker still -1);
+                # park it for the next pick
+                flight.phase = "replay"
+                self._replay.appendleft(flight.flight_id)
+                continue
+            flight.phase = "prefill"
+            flight.worker = handle.worker_id
+            flight.progress_at = now
+            worked = True
+        return worked
+
+    def _forward_pending(self) -> bool:
+        """Land pending shipments on decode workers, strictly FIFO (no
+        skip-ahead, PR 9's rule). The bounded channel send queue is the
+        transport half of backpressure; this loop's stall counter is the
+        router half — at most one increment per step."""
+        worked = False
+        while self._pending:
+            flight = self._flights.get(self._pending[0])
+            if flight is None or flight.user.done:
+                self._pending.popleft()
+                continue
+            handle = self._pick_worker("decode")
+            if handle is None:
+                self._c_stalls.inc()
+                break
+            shipment = flight.shipment
+            try:
+                handle.channel.send(shipment_to_message(
+                    shipment, flight_id=flight.flight_id,
+                    attempt=flight.attempt))
+            except ConnectionError:
+                self._lose_worker(handle, RECOVER_CHANNEL_DROP)
+                continue       # head flight intact: try another worker
+            self._pending.popleft()
+            flight.phase = "decode"
+            flight.worker = handle.worker_id
+            flight.copied = 1          # the first token is already out
+            flight.progress_at = self._clock()
+            flight.shipment = None     # freed at send: router memory is
+            #                            bounded; a lost shipment replays
+            self._c_shipments.inc()
+            self._c_pages_shipped.inc(shipment.n_prompt_pages)
+            if flight.user.trace_sampled:
+                record_span(
+                    "serving.page_transfer", shipment.extracted_at,
+                    flight.progress_at, trace=flight.user.trace_id,
+                    parent=flight.user.span_id,
+                    pages=shipment.n_prompt_pages,
+                    bytes=shipment.page_bytes,
+                    src_worker=shipment.src_worker,
+                    dst_worker=handle.worker_id)
+            worked = True
+        return worked
+
+    # -- elastic rebalancing -------------------------------------------------
+
+    def _rebalance(self) -> None:
+        """Convert ONE idle worker between roles per window, from live
+        signals. Hysteresis: decode occupancy must cross `occupancy_high`
+        to pull a prefill worker over, drop under `occupancy_low` to give
+        one back — the band between is a dead zone, so the pod cannot
+        flap. Never drops a role below one worker, never converts a
+        worker that holds flights."""
+        pc = self.pod_config
+        if not pc.rebalance:
+            return
+        now = self._clock()
+        if now - self._last_rebalance < pc.rebalance_window_s:
+            return
+        alive = [h for h in self.workers.values()
+                 if h.alive and not h.draining]
+        pref = [h for h in alive if h.role == "prefill"]
+        dec = [h for h in alive if h.role == "decode"]
+        if not pref or not dec:
+            return      # soft-role survival mode; nothing to convert
+        prefill_demand = self.scheduler.queue_depth + len(self._replay)
+        decode_live = sum(1 for f in self._flights.values()
+                          if f.phase == "decode")
+        decode_occ = decode_live / max(1, sum(h.slots for h in dec))
+        idle = [h for h in alive if self._worker_load(h.worker_id) == 0]
+        target = None
+        if ((decode_occ >= pc.occupancy_high
+             or len(self._pending) >= self._max_pending)
+                and prefill_demand == 0 and len(pref) > 1):
+            cands = [h for h in idle if h.role == "prefill"]
+            if cands:
+                target, new_role = cands[0], "decode"
+        elif (prefill_demand > 0 and decode_occ <= pc.occupancy_low
+                and len(dec) > 1):
+            cands = [h for h in idle if h.role == "decode"]
+            if cands:
+                target, new_role = cands[0], "prefill"
+        if target is None:
+            return
+        direction = f"{target.role}_to_{new_role}"
+        target.role = new_role
+        self._c_conversions[direction].inc()
+        self._last_rebalance = now
+        try:
+            target.channel.send(Message("set_role", {"role": new_role}))
+        except ConnectionError:
+            pass
+
+    # -- terminal ------------------------------------------------------------
+
+    def _finalize(self, req: Request) -> None:
+        end = req.finished_at
+        if end is None:
+            end = self._clock()
+        close_request_trace(req, end)
+        self.metrics.observe_request(req)
+
+    # -- metrics / observability ---------------------------------------------
+
+    def _update_gauges(self) -> None:
+        self._g_pending.set(len(self._pending))
+        self._g_alive.set(sum(1 for h in self.workers.values() if h.alive))
+        for role in ("prefill", "decode"):
+            workers = [h for h in self.workers.values()
+                       if h.alive and h.role == role]
+            cap = sum(h.slots for h in workers)
+            live = sum(self._worker_load(h.worker_id) for h in workers)
+            self._g_occupancy[role].set(live / max(1, cap))
+
+    def compile_stats(self) -> dict[str, int]:
+        """Per-program compile counts as reported by worker heartbeats,
+        aggregated as the MAX per program across workers — flat per
+        program is still the pod's recompile guard."""
+        out = {"admit": 0, "prefill": 0, "decode": 0, "extract": 0,
+               "install": 0}
+        for h in self.workers.values():
+            for k, v in (h.compiles or {}).items():
+                out[k] = max(out.get(k, 0), int(v))
+        return out
+
+    def metrics_summary(self) -> dict[str, float]:
+        out = self.metrics.summary()
+        out.update({f"compiles_{k}": float(v)
+                    for k, v in self.compile_stats().items()})
+        out["pod_shipments"] = float(self._c_shipments.value)
+        out["pod_pages_shipped"] = float(self._c_pages_shipped.value)
+        out["pod_backpressure_stalls"] = float(self._c_stalls.value)
+        out["pod_workers_lost"] = float(self._c_lost.value)
+        out["pod_workers_recovered"] = float(self._c_recovered.value)
+        out["pod_requests_replayed"] = float(self._c_replayed.value)
+        out["pod_stale_messages"] = float(self._c_stale.value)
+        out["pod_role_conversions"] = float(sum(
+            c.value for c in self._c_conversions.values()))
+        if self._h_recovery.count:
+            out["pod_recovery_latency_p50_ms"] = \
+                self._h_recovery.quantile(0.5) * 1e3
+            out["pod_recovery_latency_p99_ms"] = \
+                self._h_recovery.quantile(0.99) * 1e3
+            out["pod_recovery_latency_mean_ms"] = self._h_recovery.mean * 1e3
+        return out
+
+    def exposition_registry(self) -> MetricsRegistry:
+        """The router's `/metrics` view: its own series verbatim, plus
+        every worker's last-heartbeat registry snapshot merged with the
+        `aggregate_snapshot` semantics (counter sums, gauge min/mean/max,
+        sketch-merged histograms incl. `__slowest_host_mean`) under
+        `origin="workers"` — one scrape shows the whole pod, no jax
+        process group involved."""
+        reg = MetricsRegistry()
+        for kind, name, labels, metric in self.registry.items():
+            if kind == "counter":
+                reg.counter(name, **dict(labels)).inc(metric.value)
+            elif kind == "gauge":
+                reg.gauge(name, **dict(labels)).set(metric.value)
+            else:
+                reg.histogram(name, **dict(labels)).merge(metric)
+        snaps = [h.snapshot for h in self.workers.values()
+                 if h.snapshot is not None]
+        if snaps:
+            merged_registry(snaps, registry=reg, origin="workers")
+        return reg
+
+    def reset_metrics(self) -> None:
+        self.registry.reset()
+        self.metrics = ServingMetrics(registry=self.registry)
+        self.scheduler.step_time_ema = 0.0
+
+    def close(self) -> None:
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        for handle in self.workers.values():
+            try:
+                handle.channel.send(Message("drain", {}))
+            except ConnectionError:
+                pass
+            handle.channel.close()
+        if self.listener is not None:
+            self.listener.close()
+
+    # -- introspection -------------------------------------------------------
+
+    def debug_requests(self) -> dict:
+        from ...engine import Engine
+
+        now = self._clock()
+        return {
+            "queued": [Engine._request_info(r, now)
+                       for r in self.scheduler.queue],
+            "running": [dict(Engine._request_info(f.user, now),
+                             phase=f.phase, attempt=f.attempt,
+                             worker=f.worker)
+                        for f in self._flights.values()],
+        }
+
+    def debug_pod(self) -> dict:
+        phases: dict[str, int] = {}
+        for f in self._flights.values():
+            phases[f.phase] = phases.get(f.phase, 0) + 1
+        return {
+            "workers": [{
+                "worker_id": h.worker_id, "role": h.role,
+                "alive": h.alive, "lost": h.lost, "draining": h.draining,
+                "slots": h.slots,
+                "load": self._worker_load(h.worker_id),
+                "stats": h.stats, "compiles": h.compiles,
+            } for h in self.workers.values()],
+            "in_flight": phases,
+            "queued": self.scheduler.queue_depth,
+            "pending_shipments": len(self._pending),
+            "replay_queue": len(self._replay),
+            "max_pending_shipments": self._max_pending,
+            "workers_lost_total": int(self._c_lost.value),
+            "workers_recovered_total": int(self._c_recovered.value),
+            "requests_replayed_total": int(self._c_replayed.value),
+            "recovery_log": list(self.recovery_log)[-16:],
+        }
+
+    def debug_slots(self) -> list[dict]:
+        # the router holds no slots; the /debug/slots route gets the
+        # heartbeat-reported occupancy of every worker instead
+        return [{
+            "worker": h.worker_id, "role": h.role, "alive": h.alive,
+            "slots": h.slots,
+            "live_slots": (h.stats or {}).get("live_slots"),
+            "flights": self._worker_load(h.worker_id),
+        } for h in self.workers.values()]
+
+    def debug_pages(self) -> dict:
+        return {str(h.worker_id): {
+            "role": h.role, "alive": h.alive,
+            "pages_free": (h.stats or {}).get("pages_free"),
+            "pages_in_use": (h.stats or {}).get("pages_in_use"),
+        } for h in self.workers.values()}
+
+    def debug_scheduler(self) -> dict:
+        out = self.scheduler.debug_state()
+        out["pod"] = {
+            "in_flight": len(self._flights),
+            "pending_shipments": len(self._pending),
+            "replay_queue": len(self._replay),
+        }
+        return out
+
+    def incident_dumps(self) -> dict:
+        out: dict[str, Any] = {}
+        for name, build in (
+            ("pod", self.debug_pod),
+            ("requests", self.debug_requests),
+            ("scheduler", self.debug_scheduler),
+            ("compile_stats", self.compile_stats),
+        ):
+            try:
+                out[name] = build()
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# in-process factory (the deterministic `local` distributed form)
+# ---------------------------------------------------------------------------
+
+
+def build_local_distributed_pod(
+    family, config, params,
+    engine_config: EngineConfig | None = None,
+    pod_config: DistributedPodConfig | None = None,
+    clock=time.monotonic,
+    channel_wrap=None,
+):
+    """Router + in-process `WorkerServer`s over `LocalChannel` pairs —
+    every message still crosses the wire codec, the clock can be fake,
+    and the router pumps the workers itself, so the whole distributed
+    protocol (heartbeats, recovery, rebalancing) runs deterministically
+    in one interpreter. `channel_wrap(worker_id, role, channel)` may
+    wrap the ROUTER-side endpoint (e.g. with `FlakyTransport`).
+
+    Returns (router, workers)."""
+    from ...engine import Engine
+    from .transport import LocalChannel
+
+    ec = engine_config or EngineConfig()
+    pc = pod_config or DistributedPodConfig()
+    worker_ec = dataclasses.replace(
+        ec, tenants=None, metrics_port=None, watchdog_timeout_s=None,
+        incident_dir=None, speculative=None)
+    router = DistributedPodRouter(
+        engine_config=ec, pod_config=pc, clock=clock)
+    workers = []
+    wid = 0
+    for role, count in (("prefill", pc.prefill_workers),
+                        ("decode", pc.decode_workers)):
+        for _ in range(count):
+            router_side, worker_side = LocalChannel.pair()
+            if channel_wrap is not None:
+                router_side = channel_wrap(wid, role, router_side)
+            engine = Engine(family, config, params, worker_ec, clock=clock)
+            engine.close()   # heartbeats are the worker's only exporter
+            server = WorkerServer(
+                engine, worker_side, worker_id=wid, role=role,
+                heartbeat_interval_s=pc.heartbeat_interval_s, clock=clock)
+            router.register_worker(router_side, wid, role,
+                                   slots=len(engine.scheduler.slots),
+                                   local=server)
+            workers.append(server)
+            wid += 1
+    return router, workers

@@ -1,0 +1,353 @@
+"""Pod worker: one Engine behind a channel, role assigned by the router.
+
+A worker is deliberately role-AGNOSTIC: it owns a single `Engine` plus
+its `PageTransport` and executes whatever the router sends — `submit`
+messages (prefill a prompt, ship its pages back) or `shipment` messages
+(land the pages, decode to completion, stream token state back). "Role"
+is a *label* the router uses for placement preference and elastic
+rebalancing; converting a worker between prefill and decode is a
+router-side bookkeeping flip plus a `set_role` notice, never a process
+restart. That is also what makes single-survivor recovery possible: if
+every decode worker dies, the remaining prefill worker simply starts
+receiving shipments.
+
+Token delivery is FULL-STATE sync, not deltas: every `tokens` message
+carries the internal request's complete token/logprob lists. Resending
+the whole (small — bounded by max_new_tokens) list makes delivery
+idempotent and monotone, so dropped, duplicated, or reordered messages
+need no acks and no sequence recovery — the router just keeps the
+longest prefix it has seen for the flight's current attempt. A
+production transport would delta-encode with acks; the exactness and
+recovery semantics are identical.
+
+Every job-bearing message carries ``(flight_id, attempt)`` and every
+reply echoes it. The router bumps `attempt` on each replay, so a
+duplicate or late message from an earlier attempt is recognizably stale
+and dropped on both sides — this is what makes at-least-once delivery
+safe under re-prefill recovery (no token delivered twice).
+
+`run_once()` is one deterministic pump (poll, dispatch, step, harvest,
+sync, heartbeat) — the in-process tests drive it directly under a fake
+clock. `run()` wraps it in the real loop with SIGTERM drain mirroring
+`serve`: finish in-flight work, say `bye`, exit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from ...scheduler import RequestStatus
+from ..transfer import PageTransport, place_shipment
+from .transport import Channel
+from .wire import Message, shipment_from_message, shipment_to_message
+
+__all__ = ["WorkerServer", "build_worker_engine", "engine_config_from_spec",
+           "ENGINE_SPEC_KEYS"]
+
+# the engine-spec dict shared by CLI workers / tests / serve_bench so
+# separate processes build byte-identical engines (family + seed pin
+# the params; the rest pins the compiled-shape envelope)
+ENGINE_SPEC_KEYS = ("family", "seed", "num_slots", "max_len",
+                    "prefill_chunk", "page_size", "max_queue",
+                    "cache_dtype", "kv_dtype", "prefix_cache")
+
+
+def build_worker_engine(spec: dict[str, Any]):
+    """(family, config, params, Engine) from a JSON-safe spec dict.
+
+    Every process that must agree on model bytes — router-side reference
+    engines, CLI pod workers, serve_bench A/B drivers — builds through
+    this one function: `init_params(cfg, key(seed))` is deterministic,
+    so identical specs give identical params in different processes."""
+    import jax
+
+    from ...engine import Engine
+
+    family_name = spec.get("family", "gpt2")
+    if family_name == "llama":
+        from ....models import llama as family
+
+        cfg = family.LlamaConfig.tiny()
+    elif family_name == "gpt2":
+        from ....models import gpt2 as family
+
+        cfg = family.GPT2Config.tiny()
+    else:
+        raise ValueError(f"unknown family {family_name!r}")
+    params = family.init_params(cfg, jax.random.key(int(spec.get("seed", 0))))
+    engine = Engine(family, cfg, params, engine_config_from_spec(spec))
+    engine.close()  # a pod worker exports via heartbeats, not side-cars
+    return family, cfg, params, engine
+
+
+def engine_config_from_spec(spec: dict[str, Any], **overrides):
+    """`EngineConfig` from the JSON-safe spec — shared with the router
+    CLI, which needs the matching config without paying for an engine."""
+    import jax.numpy as jnp
+
+    from ...engine import EngineConfig
+
+    cache_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        spec.get("cache_dtype", "float32")]
+    kwargs = dict(
+        num_slots=int(spec.get("num_slots", 4)),
+        max_len=int(spec.get("max_len", 64)),
+        prefill_chunk=int(spec.get("prefill_chunk", 8)),
+        max_queue=int(spec.get("max_queue", 64)),
+        page_size=int(spec.get("page_size", 8)),
+        cache_dtype=cache_dtype,
+        kv_dtype=spec.get("kv_dtype"),
+        prefix_cache=bool(spec.get("prefix_cache", True)),
+        seed=int(spec.get("seed", 0)),
+    )
+    kwargs.update(overrides)
+    return EngineConfig(**kwargs)
+
+
+@dataclasses.dataclass
+class _Job:
+    """One flight's worker-side state."""
+
+    flight_id: int
+    attempt: int
+    mode: str                 # "prefill" | "decode"
+    internal: Any
+    sent_tokens: int = 0      # decode: tokens already synced at least once
+    sent_done: bool = False
+
+
+class WorkerServer:
+    """One engine + one channel to the router. See module docstring."""
+
+    def __init__(self, engine, channel: Channel, worker_id: int,
+                 role: str = "decode", heartbeat_interval_s: float = 0.5,
+                 clock=time.monotonic):
+        self.engine = engine
+        self.channel = channel
+        self.worker_id = int(worker_id)
+        self.role = role
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self._clock = clock
+        self.transport = PageTransport(engine)
+        self.draining = False
+        self.done = False
+        self._last_heartbeat = -float("inf")
+        self._jobs: dict[int, _Job] = {}
+        self._admit_pages: dict[int, list] = {}
+        self.stale_messages = 0
+        # the admit hook mirrors PodRouter._record_admit: a short prompt
+        # can admit, prefill and retire inside ONE engine.step(), and the
+        # alloc dies with the slot — snapshot pages the moment they exist
+        engine.on_admit = self._record_admit
+        self._send(Message("hello", {
+            "worker_id": self.worker_id, "role": self.role,
+            "slots": len(engine.scheduler.slots),
+            "pages_free": engine.allocator.pages_free,
+        }))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _record_admit(self, slot, req) -> None:
+        self._admit_pages[id(req)] = list(slot.alloc.pages)
+
+    def _send(self, msg: Message) -> None:
+        try:
+            self.channel.send(msg)
+        except ConnectionError:
+            self.done = True  # router gone: nothing left to serve
+
+    def _stale(self, meta: dict) -> bool:
+        """True when a job-bearing message is from a superseded attempt
+        (dup/reorder of a replayed flight) — dropped, counted."""
+        job = self._jobs.get(int(meta["flight_id"]))
+        if job is not None and int(meta["attempt"]) <= job.attempt \
+                and job.mode is not None:
+            self.stale_messages += 1
+            return True
+        return False
+
+    # -- message handlers ----------------------------------------------------
+
+    def _handle(self, msg: Message) -> None:
+        meta = msg.meta
+        if msg.kind == "submit":
+            if self._stale(meta):
+                return
+            self._evict(int(meta["flight_id"]))
+            prompt, key_raw = msg.buffers
+            internal = self.engine.submit(
+                np.asarray(prompt, np.int32),
+                max_new_tokens=int(meta["budget"]),
+                temperature=float(meta["temperature"]),
+                key=np.asarray(key_raw, np.uint32),
+                trace_sampled=False)
+            self._jobs[int(meta["flight_id"])] = _Job(
+                flight_id=int(meta["flight_id"]),
+                attempt=int(meta["attempt"]), mode="prefill",
+                internal=internal)
+        elif msg.kind == "shipment":
+            if self._stale(meta):
+                return
+            self._evict(int(meta["flight_id"]))
+            shipment = shipment_from_message(msg)
+            placed = place_shipment(self.engine, self.transport, shipment,
+                                    self._clock())
+            if placed is None:
+                # no slot/pages here right now — the router re-routes or
+                # replays; refusing is cheaper than deadlocking a slot
+                self._send(Message("install_failed", {
+                    "flight_id": int(meta["flight_id"]),
+                    "attempt": int(meta["attempt"]),
+                    "worker_id": self.worker_id}))
+                return
+            internal, _slot, _alloc = placed
+            self._jobs[int(meta["flight_id"])] = _Job(
+                flight_id=int(meta["flight_id"]),
+                attempt=int(meta["attempt"]), mode="decode",
+                internal=internal, sent_tokens=1)
+        elif msg.kind == "cancel":
+            job = self._jobs.pop(int(meta["flight_id"]), None)
+            if job is not None:
+                self._admit_pages.pop(id(job.internal), None)
+                self.engine.cancel(job.internal)
+        elif msg.kind == "finish":
+            job = self._jobs.pop(int(meta["flight_id"]), None)
+            if job is not None:
+                self._admit_pages.pop(id(job.internal), None)
+                self.engine.finish(job.internal)
+        elif msg.kind == "set_role":
+            self.role = str(meta["role"])
+        elif msg.kind == "reset":
+            # rejoin after a partition the router already recovered from:
+            # every local flight was replayed elsewhere — drop them all
+            for job in list(self._jobs.values()):
+                self._admit_pages.pop(id(job.internal), None)
+                if not job.internal.done:
+                    self.engine.cancel(job.internal)
+            self._jobs.clear()
+        elif msg.kind == "drain":
+            self.draining = True
+
+    def _evict(self, flight_id: int) -> None:
+        """A NEWER attempt for a flight we already hold: the old
+        internal is dead weight — cancel it before starting over."""
+        job = self._jobs.pop(flight_id, None)
+        if job is not None:
+            self._admit_pages.pop(id(job.internal), None)
+            if not job.internal.done:
+                self.engine.cancel(job.internal)
+
+    # -- outbound ------------------------------------------------------------
+
+    def _harvest_prefill(self) -> None:
+        """Ship every prefill job whose first token exists (mirror of
+        PodRouter._harvest, result crossing the channel instead of a
+        deque). Extraction happens HERE, before the engine steps again —
+        a retired slot's pages are only reallocatable at the next
+        admission, which cannot happen before the next step."""
+        now = self._clock()
+        for job in list(self._jobs.values()):
+            if job.mode != "prefill":
+                continue
+            internal = job.internal
+            if not internal.tokens and not internal.done:
+                continue
+            del self._jobs[job.flight_id]
+            if internal.done and internal.status is not RequestStatus.FINISHED:
+                self._admit_pages.pop(id(internal), None)
+                self._send(Message("prefill_failed", {
+                    "flight_id": job.flight_id, "attempt": job.attempt,
+                    "worker_id": self.worker_id,
+                    "status": internal.status.value}))
+                continue
+            pages = self._admit_pages.pop(id(internal), None)
+            shipment = self.transport.extract_shipment(
+                pages, internal, src_worker=self.worker_id, extracted_at=now)
+            if not internal.done:
+                # retire as FINISHED so the prompt enters this worker's
+                # prefix tree: shared prefixes prefill once per worker
+                self.engine.finish(internal)
+            self._send(shipment_to_message(
+                shipment, flight_id=job.flight_id, attempt=job.attempt,
+                worker_id=self.worker_id))
+
+    def _sync_decode(self) -> None:
+        """Full-state token sync for every decode job with news."""
+        for job in list(self._jobs.values()):
+            if job.mode != "decode":
+                continue
+            internal = job.internal
+            if len(internal.tokens) == job.sent_tokens and not internal.done:
+                continue
+            self._send(Message("tokens", {
+                "flight_id": job.flight_id, "attempt": job.attempt,
+                "worker_id": self.worker_id,
+                "tokens": [int(t) for t in internal.tokens],
+                "logprobs": [float(lp) for lp in internal.logprobs],
+                "done": bool(internal.done),
+                "status": internal.status.value,
+            }))
+            job.sent_tokens = len(internal.tokens)
+            if internal.done:
+                job.sent_done = True
+                del self._jobs[job.flight_id]
+
+    def _maybe_heartbeat(self) -> None:
+        now = self._clock()
+        if now - self._last_heartbeat < self.heartbeat_interval_s:
+            return
+        self._last_heartbeat = now
+        eng = self.engine
+        self._send(Message("heartbeat", {
+            "worker_id": self.worker_id, "role": self.role, "t": now,
+            "draining": self.draining,
+            "stats": {
+                "slots": len(eng.scheduler.slots),
+                "live_slots": eng.scheduler.live_slots,
+                "queue_depth": eng.scheduler.queue_depth,
+                "pages_free": eng.allocator.pages_free,
+                "pages_in_use": eng.allocator.pages_in_use,
+            },
+            "compiles": {**eng.compile_stats(),
+                         **self.transport.compile_stats()},
+            # the registry snapshot IS the telemetry merge payload:
+            # counters/gauges/sketches aggregate router-side without a
+            # jax process group (telemetry/aggregate.py)
+            "snapshot": eng.registry.snapshot(include_sketch=True),
+        }))
+
+    # -- drive ---------------------------------------------------------------
+
+    def run_once(self) -> bool:
+        """One deterministic pump. Returns True when anything moved."""
+        if self.done:
+            return False
+        if self.channel.closed:
+            self.done = True
+            return False
+        msgs = self.channel.poll()
+        for msg in msgs:
+            self._handle(msg)
+        worked = bool(msgs)
+        if self.engine.scheduler.has_work():
+            self.engine.step()
+            worked = True
+        self._harvest_prefill()
+        self._sync_decode()
+        self._maybe_heartbeat()
+        if self.draining and not self._jobs \
+                and not self.engine.scheduler.has_work():
+            self._send(Message("bye", {"worker_id": self.worker_id}))
+            self.done = True
+        return worked
+
+    def run(self, poll_interval_s: float = 0.002) -> None:
+        """Blocking loop for real worker processes; returns when drained
+        or the router goes away. SIGTERM -> drain is wired by the CLI."""
+        while not self.done:
+            if not self.run_once() and not self.done:
+                time.sleep(poll_interval_s)

@@ -69,7 +69,7 @@ from ..scheduler import (
     SlotState,
 )
 from .mesh import shard_params, tensor_mesh
-from .transfer import PageTransport
+from .transfer import PageTransport, place_shipment
 
 __all__ = ["PodConfig", "PodRouter", "PodEngine"]
 
@@ -606,51 +606,16 @@ class PodRouter:
                            -self.decode_workers[i].allocator.pages_free))
         for widx in order:
             engine = self.decode_workers[widx]
-            if engine.scheduler.live_slots >= len(engine.scheduler.slots):
-                continue
-            internal = Request(
-                prompt=shipment.prompt,
-                max_new_tokens=user.max_new_tokens,
-                temperature=shipment.temperature,
-                key=shipment.key_raw,
-                eos_token_id=user.eos_token_id,
-            )
-            # clock BEFORE the page reservation: nothing that can raise
-            # may sit between allocate and the adopt/rollback pair that
-            # owns its outcome (the ATP201 exception-window class)
+            # clock BEFORE the page reservation: placement owns the whole
+            # allocate->adopt->install sequence (shared with the
+            # multi-host worker's install handler — see
+            # transfer.place_shipment)
             now = self._clock()
-            alloc = engine.allocator.allocate(internal)
-            if alloc is None:
+            placed = place_shipment(
+                engine, self._transports_d[widx], shipment, now)
+            if placed is None:
                 continue
-            internal.submitted_at = now
-            slot = engine.scheduler.adopt_running(internal, alloc, now=now)
-            if slot is None:               # raced: give the pages back
-                engine.allocator.rollback(alloc)
-                continue
-            engine._table[slot.index, :] = engine.cache.trash_page
-            engine._table[slot.index, :len(alloc.pages)] = alloc.pages
-            self._transports_d[widx].install_shipment(
-                shipment, slot.index, alloc)
-            # host-resident prefix chunks were re-homed to fresh pages
-            # by allocate(); the shipment just wrote those pages with
-            # the exact same bytes the mirror holds, so the mirror is
-            # dead — drop it instead of fetching (skips a host->device
-            # copy). After install on purpose: the slot claim must
-            # complete before any non-essential bookkeeping call could
-            # raise (the ATP201 exception-window discipline).
-            if alloc.swap_ins:
-                for node, _page in alloc.swap_ins:
-                    engine._host_tier.discard(node)
-            # seed the first token into the worker's books so EOS/budget
-            # accounting continues exactly where the prefill worker left
-            # off (the user already holds this token — don't re-mirror);
-            # its logprob rides the shipment so the internal's logprobs
-            # list stays index-aligned with its tokens
-            engine.scheduler.note_token(slot, shipment.first_token, now=now,
-                                        logprob=shipment.first_logprob)
-            engine.metrics.note_admission(
-                internal.prompt_len, alloc.reused_len,
-                host_pages=len(alloc.swap_ins or ()))
+            internal, _slot, _alloc = placed
             if scores[widx] > 0:
                 self._c_affinity.inc()
             flight.phase = "decode"

@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["KVPageShipment", "PageTransport"]
+__all__ = ["KVPageShipment", "PageTransport", "place_shipment"]
 
 
 @dataclasses.dataclass
@@ -240,3 +240,60 @@ class PageTransport:
         # strict audit must still cover it once
         eng._strict_audit("admit", eng._admit_p, admit_args)
         eng.cache, eng._slot_keys, eng._temps = eng._admit_p(*admit_args)
+
+
+def place_shipment(engine, transport: PageTransport, shipment: KVPageShipment,
+                   now: float):
+    """Land one shipment on `engine` end-to-end: internal Request built
+    from the shipment, pages allocated (prefix-reuse aware), slot adopted
+    RUNNING, table row written, pages installed, stale host mirrors
+    dropped, first token + admission booked. Returns
+    ``(internal, slot, alloc)`` or ``None`` when the engine has no free
+    slot or pages right now (nothing mutated on None).
+
+    This is the single placement path shared by the in-process
+    `PodRouter._try_install` and the multi-host worker's `install`
+    handler — the process boundary must not fork the landing semantics.
+    """
+    from ..scheduler import Request
+
+    if engine.scheduler.live_slots >= len(engine.scheduler.slots):
+        return None
+    internal = Request(
+        prompt=shipment.prompt,
+        max_new_tokens=shipment.max_new_tokens,
+        temperature=shipment.temperature,
+        key=shipment.key_raw,
+        eos_token_id=shipment.eos_token_id,
+    )
+    # nothing that can raise may sit between allocate and the
+    # adopt/rollback pair that owns its outcome (ATP201 exception window)
+    alloc = engine.allocator.allocate(internal)
+    if alloc is None:
+        return None
+    internal.submitted_at = now
+    slot = engine.scheduler.adopt_running(internal, alloc, now=now)
+    if slot is None:               # raced: give the pages back
+        engine.allocator.rollback(alloc)
+        return None
+    engine._table[slot.index, :] = engine.cache.trash_page
+    engine._table[slot.index, :len(alloc.pages)] = alloc.pages
+    transport.install_shipment(shipment, slot.index, alloc)
+    # host-resident prefix chunks were re-homed to fresh pages by
+    # allocate(); the shipment just wrote those pages with the exact
+    # bytes the mirror holds, so the mirror is dead — drop it instead of
+    # fetching (skips a host->device copy). After install on purpose:
+    # the slot claim must complete before any non-essential bookkeeping
+    # call could raise (ATP201 discipline).
+    if alloc.swap_ins:
+        for node, _page in alloc.swap_ins:
+            engine._host_tier.discard(node)
+    # seed the first token so EOS/budget accounting continues exactly
+    # where the prefill worker left off; its logprob rides the shipment
+    # so the internal's logprob list stays index-aligned
+    engine.scheduler.note_token(slot, shipment.first_token, now=now,
+                                logprob=shipment.first_logprob)
+    engine.metrics.note_admission(
+        internal.prompt_len, alloc.reused_len,
+        host_pages=len(alloc.swap_ins or ()))
+    return internal, slot, alloc

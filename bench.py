@@ -69,6 +69,7 @@ _PHASE_METRICS = {
     "serving_prefix": ("serving_prefix_reuse", "summary"),
     "server": ("server_http_load", "summary"),
     "pod": ("serving_pod_offered_load", "summary"),
+    "pod_dist": ("serving_pod_distributed", "summary"),
     "serving_spec": ("serving_speculative_ab", "summary"),
     "serving_host_tier": ("serving_host_tier_ab", "summary"),
 }
@@ -478,6 +479,45 @@ def _pod_row(num_requests: int = 10) -> dict:
     return {k: round(float(s[k]), 3) for k in keep if k in s}
 
 
+def _pod_dist_row(num_requests: int = 8) -> dict:
+    """TRUE multi-host pod offered-load smoke (ISSUE 17): the same
+    offered-load trace as the in-process pod row, but through
+    `DistributedPodRouter` with one prefill + one decode worker as REAL
+    OS processes shipping KV pages over TCP — the A/B against the "pod"
+    row prices the wire + process boundary. Reports the shipment and
+    recovery counters (workers_lost / requests_replayed must be 0 on a
+    healthy run) next to the latency percentiles."""
+    sb = _load_serve_bench()
+    engine, cfg, procs = sb.build_tiny_distributed_pod(
+        "llama", pod_roles=(1, 1), num_slots=4, max_len=128,
+        prefill_chunk=16)
+    try:
+        s = sb.run_offered_load(engine, cfg.vocab_size,
+                                num_requests=num_requests, rate_hz=200.0,
+                                prompt_len=(4, 16), max_new_tokens=(4, 8))
+    finally:
+        engine.close()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                proc.kill()
+    keep = ("tokens_per_sec", "ttft_p50_ms", "ttft_p99_ms",
+            "per_token_p50_ms", "requests_finished", "pod_shipments",
+            "pod_pages_shipped", "pod_backpressure_stalls",
+            "pod_workers_lost", "pod_workers_recovered",
+            "pod_requests_replayed", "pod_stale_messages",
+            "pod_role_conversions", "pod_recovery_latency_p50_ms",
+            "pod_recovery_latency_p99_ms",
+            "compiles_decode", "compiles_install", "compiles_extract")
+    row = {k: round(float(s[k]), 3) for k in keep if k in s}
+    row["transport"] = "socket"
+    return row
+
+
 def _child_main() -> None:
     """Runs inside a bench child process (BENCH_CHILD=1). BENCH_PHASE
     selects which phase this child IS: "train" (default, the full
@@ -492,7 +532,7 @@ def _child_main() -> None:
         from accelerate_tpu.utils.environment import force_cpu_platform
 
         force_cpu_platform()
-    if phase in ("serving", "serving_prefix", "server", "pod",
+    if phase in ("serving", "serving_prefix", "server", "pod", "pod_dist",
                  "serving_spec", "serving_host_tier"):
         if not on_cpu:
             # spawned on the TPU-success path: if the tunnel dropped
@@ -509,6 +549,7 @@ def _child_main() -> None:
                "serving_prefix": _serving_prefix_row,
                "server": _server_row,
                "pod": _pod_row,
+               "pod_dist": _pod_dist_row,
                "serving_spec": _serving_spec_row,
                "serving_host_tier": _serving_host_tier_row}[phase]()
         print(json.dumps(row))
@@ -573,6 +614,7 @@ def _emit(payload: dict, cpu: bool) -> None:
             "serving_prefix", _run_phase("serving_prefix", cpu))
         extra["server"] = _phase_row("server", _run_phase("server", cpu))
         extra["pod"] = _phase_row("pod", _run_phase("pod", cpu))
+        extra["pod_dist"] = _phase_row("pod_dist", _run_phase("pod_dist", cpu))
         extra["serving_spec"] = _phase_row(
             "serving_spec", _run_phase("serving_spec", cpu))
         extra["serving_host_tier"] = _phase_row(

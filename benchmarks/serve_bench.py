@@ -39,6 +39,12 @@ KV pages that ship to M decode workers owning the slots; `--pod-tp K`
 additionally mesh-shards every worker over K devices. The summary then
 carries the pod counters (`pod_shipments`, `pod_pages_shipped`,
 `pod_backpressure_stalls`) next to the usual latency percentiles.
+`--pod-transport socket` is the A/B arm for the TRUE multi-host pod
+(serving.pod.distributed): the same roles run as real `pod-worker` OS
+processes dialing the router over TCP, so the delta against the default
+`local` transport is the wire + process-boundary cost; the summary adds
+the recovery counters (`pod_requests_replayed`, `pod_workers_lost`,
+`pod_recovery_latency_*`).
 
 `--tenants` switches to the MULTI-TENANT HTTP harness (`run_http_load`):
 the real `accelerate_tpu.server` front door is stood up in-process on an
@@ -182,6 +188,79 @@ def build_tiny_pod_engine(family_name: str = "llama", pod_roles=(1, 1),
     pc = PodConfig(prefill_workers=pod_roles[0], decode_workers=pod_roles[1],
                    tensor_parallel=tensor_parallel)
     return PodEngine(family, cfg, params, ec, pc), cfg
+
+
+def build_tiny_distributed_pod(family_name: str = "llama", pod_roles=(1, 1),
+                               num_slots: int = 4, max_len: int = 128,
+                               prefill_chunk: int = 16, max_queue: int = 64,
+                               seed: int = 0, page_size: int = 16,
+                               prefix_cache: bool = True, kv_dtype=None,
+                               metrics_port: int | None = None,
+                               worker_wait_s: float = 180.0):
+    """The TRUE multi-host pod: `DistributedPodRouter` in this process,
+    N+M real `pod-worker` OS processes dialing its listener over TCP.
+    Same submit/step surface as the single engine, so `run_offered_load`
+    drives it unchanged — the A/B against `build_tiny_pod_engine` prices
+    the wire + process boundary. Returns (router, cfg, procs); the
+    caller owns `router.close()` and reaping the procs."""
+    import os
+    import sys as _sys
+    import time as _time
+
+    import accelerate_tpu
+    from accelerate_tpu.commands.pod import spawn_socket_workers
+    from accelerate_tpu.serving.pod.distributed import (
+        ChannelListener, DistributedPodConfig, DistributedPodRouter)
+    from accelerate_tpu.serving.pod.distributed.worker import (
+        engine_config_from_spec)
+
+    spec = {"family": family_name, "seed": seed, "num_slots": num_slots,
+            "max_len": max_len, "prefill_chunk": prefill_chunk,
+            "page_size": page_size, "max_queue": max_queue,
+            "cache_dtype": "bfloat16", "kv_dtype": kv_dtype,
+            "prefix_cache": prefix_cache}
+    if family_name == "llama":
+        from accelerate_tpu.models import llama as family
+
+        cfg = family.LlamaConfig.tiny()
+    elif family_name == "gpt2":
+        from accelerate_tpu.models import gpt2 as family
+
+        cfg = family.GPT2Config.tiny()
+    else:
+        raise ValueError(f"unknown family {family_name!r}")
+    listener = ChannelListener("127.0.0.1", 0)
+    # workers must import accelerate_tpu from this checkout even when it
+    # is not pip-installed
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(accelerate_tpu.__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH", "")) if p)
+    roles = (["prefill"] * pod_roles[0] + ["decode"] * pod_roles[1])
+    procs = spawn_socket_workers(listener.port, spec, roles, env=env,
+                                 stderr=_sys.stderr)
+    router = DistributedPodRouter(
+        engine_config=engine_config_from_spec(spec,
+                                              metrics_port=metrics_port),
+        pod_config=DistributedPodConfig(
+            prefill_workers=pod_roles[0], decode_workers=pod_roles[1],
+            # first-request compiles stall worker heartbeats; generous
+            # timeouts keep a loaded box from counting phantom losses
+            heartbeat_timeout_s=120.0, flight_timeout_s=300.0),
+        listener=listener)
+    deadline = _time.monotonic() + worker_wait_s
+    while sum(1 for w in router.workers.values() if w.alive) < len(roles):
+        router.step()
+        dead = [p.returncode for p in procs if p.poll() is not None]
+        if dead:
+            raise RuntimeError(f"pod worker died before hello (rc={dead})")
+        if _time.monotonic() > deadline:
+            raise RuntimeError(
+                f"only {sum(1 for w in router.workers.values() if w.alive)}"
+                f"/{len(roles)} pod workers joined within {worker_wait_s}s")
+        _time.sleep(0.05)
+    return router, cfg, procs
 
 
 def run_offered_load(
@@ -657,6 +736,12 @@ def main() -> None:
     p.add_argument("--pod-tp", type=int, default=1,
                    help="with --pod-roles: tensor-parallel width per "
                         "worker (mesh-sharded layer 1 under the pod)")
+    p.add_argument("--pod-transport", default="local",
+                   choices=("local", "socket"),
+                   help="with --pod-roles: 'local' = in-process PodEngine "
+                        "(default), 'socket' = real pod-worker OS "
+                        "processes over TCP (serving.pod.distributed) — "
+                        "the A/B prices the wire + process boundary")
     p.add_argument("--tenants", default=None,
                    help="multi-tenant HTTP harness: semicolon-separated "
                         "specs, e.g. 'gold:priority=0,weight=4,slo=0.3,"
@@ -677,6 +762,11 @@ def main() -> None:
         p.error("--speculative is not supported with --pod-roles "
                 "(the pod's extract/install protocol drives the classic "
                 "admit program; pod + speculation is a future arc)")
+    if args.pod_transport == "socket" and not args.pod_roles:
+        p.error("--pod-transport socket requires --pod-roles")
+    if args.pod_transport == "socket" and args.pod_tp > 1:
+        p.error("--pod-transport socket does not compose with --pod-tp "
+                "(each worker process owns its whole backend)")
     if args.tenants or args.trace:
         specs, loads = parse_tenant_load_arg(args.tenants or "")
         engine, cfg = build_tiny_engine(
@@ -709,7 +799,17 @@ def main() -> None:
     if args.prefix_pool and args.prefix_len:
         max_len = max(max_len, args.prefix_len + args.prompt_len[1]
                       + args.max_new_tokens[1])
-    if args.pod_roles:
+    pod_procs = None
+    if args.pod_roles and args.pod_transport == "socket":
+        engine, cfg, pod_procs = build_tiny_distributed_pod(
+            args.family, pod_roles=parse_pod_roles(args.pod_roles),
+            num_slots=args.slots, max_len=max_len,
+            prefill_chunk=args.prefill_chunk, seed=args.seed,
+            page_size=args.page_size,
+            prefix_cache=not args.no_prefix_cache,
+            metrics_port=args.metrics_port,
+            kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype)
+    elif args.pod_roles:
         engine, cfg = build_tiny_pod_engine(
             args.family, pod_roles=parse_pod_roles(args.pod_roles),
             tensor_parallel=args.pod_tp, num_slots=args.slots,
@@ -737,13 +837,27 @@ def main() -> None:
 
         print(f"serving Prometheus metrics on "
               f":{engine.metrics_server.port}/metrics", file=sys.stderr)
-    summary = run_offered_load(
-        engine, cfg.vocab_size, num_requests=args.num_requests,
-        rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),
-        max_new_tokens=tuple(args.max_new_tokens),
-        temperature=args.temperature, deadline_s=args.deadline_s,
-        seed=args.seed, prefix_pool=args.prefix_pool,
-        prefix_len=args.prefix_len)
+    try:
+        summary = run_offered_load(
+            engine, cfg.vocab_size, num_requests=args.num_requests,
+            rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),
+            max_new_tokens=tuple(args.max_new_tokens),
+            temperature=args.temperature, deadline_s=args.deadline_s,
+            seed=args.seed, prefix_pool=args.prefix_pool,
+            prefix_len=args.prefix_len)
+    finally:
+        if pod_procs is not None:
+            engine.close()   # drains the workers, closes every channel
+            for proc in pod_procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in pod_procs:
+                try:
+                    proc.wait(timeout=15)
+                except Exception:
+                    proc.kill()
+    if args.pod_roles:
+        summary["pod_transport"] = args.pod_transport
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(summary.get("tokens_per_sec", 0.0), 2),

@@ -34,6 +34,14 @@ import dataclasses  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+# opt in to the parent fixture's exported compilation cache (no-op when
+# the env var is unset): the N=2 and N=4 children share the
+# single-device reference compiles instead of each paying them
+from accelerate_tpu.utils.environment import (  # noqa: E402
+    configure_compilation_cache)
+
+configure_compilation_cache()
+
 from accelerate_tpu.models import gpt2  # noqa: E402
 from accelerate_tpu.serving import Engine, EngineConfig  # noqa: E402
 from accelerate_tpu.serving.pod import (  # noqa: E402

@@ -160,7 +160,7 @@ def test_operator_cpu_pin_skips_tpu_attempt(monkeypatch, capsys):
     assert len(train) == 1, "TPU child must not be spawned under a cpu pin"
     assert train[0]["BENCH_TPU_SKIPPED"] == "1"
     assert phases == ["serving", "serving_prefix", "server", "pod",
-                      "serving_spec", "serving_host_tier"]
+                      "pod_dist", "serving_spec", "serving_host_tier"]
     assert all(e["JAX_PLATFORMS"] == "cpu" for e in calls)
     line = json.loads(capsys.readouterr().out.strip())
     assert "skipped" in line and "error" not in line
@@ -230,7 +230,7 @@ def test_tunnel_drop_after_train_is_reported_not_cpu_numbers(monkeypatch,
     line = json.loads(capsys.readouterr().out.strip())
     assert line["value"] == 123.0
     for row in ("serving", "serving_prefix", "server", "pod",
-                "serving_spec", "serving_host_tier"):
+                "pod_dist", "serving_spec", "serving_host_tier"):
         assert "no tpu visible" in line["extra"][row]["error"]
 
 
@@ -406,7 +406,7 @@ def _assert_schema_v2(line: dict):
     assert line["schema_version"] == 2
     rows = [line] + [line["extra"][k]
                      for k in ("serving", "serving_prefix", "server", "pod",
-                               "serving_spec", "serving_host_tier")
+                               "pod_dist", "serving_spec", "serving_host_tier")
                      if k in line.get("extra", {})]
     for row in rows:
         assert row.get("metric"), row

@@ -379,3 +379,57 @@ def test_resolved_axes_rejects_unresolved_dcn_fill():
         cfg.resolved_axes(8)
     # build() resolves it fine (one domain here -> FULL_SHARD)
     assert dict(cfg.build().shape) == {"fsdp": 8}
+
+
+# ---------------------------------------------------------------------------
+# pod-router / pod-worker CLI (ISSUE 17) — jax-free validation surface
+# ---------------------------------------------------------------------------
+
+
+def test_pod_router_dry_run_prints_config(capsys):
+    """--dry-run validates everything and prints ONE JSON line without
+    binding a socket, spawning a worker, or importing jax."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    rc = main(["pod-router", "--dry-run", "--family", "gpt2",
+               "--slots", "3", "--max-len", "64", "--prefill-chunk", "8",
+               "--page-size", "8", "--prefill-workers", "2",
+               "--decode-workers", "1", "--no-rebalance"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    cfg = json.loads(out[-1])
+    assert cfg["dry_run"] is True
+    assert cfg["workers"] == ["prefill", "prefill", "decode"]
+    assert cfg["engine"]["num_slots"] == 3
+    assert cfg["engine"]["max_len"] == 64
+    assert cfg["pod"]["rebalance"] is False
+    assert "/v1/completions" in cfg["routes"]
+    # the spec the router prints is exactly what each worker receives
+    from accelerate_tpu.serving.pod.distributed.worker import ENGINE_SPEC_KEYS
+
+    assert set(cfg["engine"]) == set(ENGINE_SPEC_KEYS)
+
+
+def test_pod_router_rejects_bad_config(capsys):
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    assert main(["pod-router", "--dry-run", "--prefill-workers", "0"]) == 2
+    assert "at least 1 prefill" in capsys.readouterr().err
+    assert main(["pod-router", "--dry-run", "--heartbeat-interval-s", "5",
+                 "--heartbeat-timeout-s", "2"]) == 2
+    assert "timeout must exceed" in capsys.readouterr().err
+    assert main(["pod-router", "--dry-run", "--listen", "nonsense"]) == 2
+    assert "HOST:PORT" in capsys.readouterr().err
+
+
+def test_pod_worker_rejects_bad_args(capsys):
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    assert main(["pod-worker", "--connect", "nonsense",
+                 "--worker-id", "0"]) == 2
+    assert "HOST:PORT" in capsys.readouterr().err
+    assert main(["pod-worker", "--connect", "127.0.0.1:1",
+                 "--worker-id", "0", "--engine-json", "[1]"]) == 2
+    assert "JSON object" in capsys.readouterr().err
+    with pytest.raises(SystemExit):  # argparse: --connect is required
+        main(["pod-worker", "--worker-id", "0"])

@@ -44,19 +44,28 @@ def _persistent_compile_cache(tmp_path_factory):
     later runs)."""
     from accelerate_tpu.utils.environment import configure_compilation_cache
 
-    prev = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
+    cache_dir = str(tmp_path_factory.mktemp("xla_cache"))
+    prev = {k: os.environ.get(k)
+            for k in ("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS",
+                      "ACCELERATE_TPU_COMPILATION_CACHE")}
     os.environ.setdefault(
         "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
-    configure_compilation_cache(
-        str(tmp_path_factory.mktemp("xla_cache")), force=True)
+    # exported: the forced-device children (pod_exactness_script at N=2
+    # then N=4) opt in via configure_compilation_cache() and share this
+    # dir — the single-device reference programs compile once across
+    # both runs instead of once per child (tier-1 budget)
+    os.environ["ACCELERATE_TPU_COMPILATION_CACHE"] = cache_dir
+    configure_compilation_cache(cache_dir, force=True)
     yield
     # scoped: hand the process back with caching OFF — a later module that
     # re-traces an AOT-compiled train step would deserialize a threshold-0
     # entry from this dir and segfault jaxlib (ISSUE 16 hit this the moment
     # an engine module sorted before test_launched_scripts)
-    if prev is None:
-        os.environ.pop(
-            "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", None)
+    for k, v in prev.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
     configure_compilation_cache("off", force=True)
 
 

@@ -18,6 +18,30 @@ from accelerate_tpu.ops.quant import (
 from accelerate_tpu.utils.dataclasses import QuantizationConfig
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _persistent_compile_cache(tmp_path_factory):
+    """The mixtral/t5/zoo fp8 convergence tests jit near-identical train
+    steps over and over; the repo's persistent compilation cache turns
+    the repeats into deserializes (same pattern as test_serving.py)."""
+    import os
+
+    from accelerate_tpu.utils.environment import configure_compilation_cache
+
+    prev = os.environ.get("ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS")
+    os.environ.setdefault(
+        "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", "0")
+    configure_compilation_cache(
+        str(tmp_path_factory.mktemp("xla_cache")), force=True)
+    yield
+    # scoped: hand the process back with caching OFF — a later module that
+    # re-traces an AOT-compiled train step would deserialize a threshold-0
+    # entry from this dir and segfault jaxlib (the ISSUE 16 gotcha)
+    if prev is None:
+        os.environ.pop(
+            "ACCELERATE_TPU_COMPILATION_CACHE_MIN_COMPILE_SECS", None)
+    configure_compilation_cache("off", force=True)
+
+
 # -- quantization -------------------------------------------------------------
 
 
