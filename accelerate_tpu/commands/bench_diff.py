@@ -77,6 +77,11 @@ _LOWER_IS_BETTER = {
     "pod_requests_replayed", "pod_workers_lost",
     "pod_recovery_latency_p50_ms", "pod_recovery_latency_p99_ms",
     "pod_recovery_latency_mean_ms",
+    # pod distributed tracing (ISSUE 18): span-export lag bounds how
+    # stale a merged fleet trace is; the tracing A/B overhead should
+    # round to zero — a regression here is instrumentation on the hot
+    # path
+    "pod_span_export_lag_s", "pod_trace_overhead_pct",
 }
 
 

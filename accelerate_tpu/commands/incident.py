@@ -179,6 +179,7 @@ def _run_show(base: str, ref: str, fmt: str) -> int:
                 line += (f", mfu {mfu:.4f} "
                          f"(idle {sheet.get('mxu_idle_fraction', 0):.3f})")
             print(line)
+    _render_fleet(path, files)
     metrics = files.get("metrics.json") or {}
     counters = metrics.get("counters") or {}
     if counters:
@@ -194,6 +195,59 @@ def _run_show(base: str, ref: str, fmt: str) -> int:
     if m.get("write_errors"):
         print(f"warnings {m['write_errors']}")
     return 0
+
+
+def _render_fleet(path: str, files: dict) -> None:
+    """The fleet half of a pod incident bundle: per-worker clock offsets,
+    each worker's own dumps (or the honest hole where an unreachable
+    worker should be), and the merged per-request chrome traces."""
+    offsets = files.get("clock_offsets.json") or {}
+    if offsets:
+        print("fleet clock offsets (router - worker, +-rtt/2):")
+        for wid in sorted(offsets, key=str):
+            o = offsets[wid] if isinstance(offsets[wid], dict) else {}
+            off, rtt = o.get("offset_s"), o.get("rtt_s")
+            state = ("lost" if o.get("lost")
+                     else "alive" if o.get("alive") else "joining")
+            line = f"  worker {wid} [{o.get('role', '?')}/{state}]"
+            if isinstance(off, (int, float)):
+                line += f" offset {off * 1e3:+.3f}ms"
+            if isinstance(rtt, (int, float)):
+                line += f" rtt {rtt * 1e3:.3f}ms"
+            hb = o.get("heartbeat_age_s")
+            if isinstance(hb, (int, float)):
+                line += f" heartbeat {hb:.2f}s ago"
+            print(line)
+    workers = sorted(f for f in files
+                     if f.startswith("worker_") and f.endswith(".json"))
+    for fname in workers:
+        wd = files[fname] if isinstance(files[fname], dict) else {}
+        label = fname[len("worker_"):-len(".json")]
+        if "worker_error" in wd:
+            print(f"worker {label}: UNREACHABLE — {wd['worker_error']}")
+            continue
+        jobs = wd.get("jobs")
+        line = (f"worker {label} [{wd.get('role', '?')}"
+                f"{' draining' if wd.get('draining') else ''}]"
+                f" pid={wd.get('pid', '?')}")
+        if isinstance(jobs, list):
+            line += f" jobs={len(jobs)}"
+        engine = wd.get("engine")
+        if isinstance(engine, dict):
+            line += f" engine_dumps={','.join(sorted(engine))}"
+        print(line + f"  (see {os.path.join(path, fname)})")
+    traces = files.get("flights_trace.json") or {}
+    if traces:
+        print(f"in-flight traces ({len(traces)} merged, worker spans "
+              "rebased to router time):")
+        for tid in sorted(traces, key=str):
+            doc = traces[tid] if isinstance(traces[tid], dict) else {}
+            events = doc.get("traceEvents") or []
+            pids = sorted({e.get("pid") for e in events
+                           if isinstance(e, dict)}, key=str)
+            print(f"  {tid}: {len(events)} spans across "
+                  f"{len(pids)} process(es) — load flights_trace.json "
+                  "in Perfetto")
 
 
 if __name__ == "__main__":

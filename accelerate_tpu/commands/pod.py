@@ -90,10 +90,12 @@ def register_subcommand(subparsers) -> None:
     router.add_argument("--prefill-workers", type=int, default=1)
     router.add_argument("--decode-workers", type=int, default=1)
     router.add_argument("--heartbeat-interval-s", type=float, default=0.25)
-    # generous default: a worker compiling its first prefill can't
-    # heartbeat, and a phantom loss costs a pointless replay (dropped
-    # connections are caught instantly regardless of this)
-    router.add_argument("--heartbeat-timeout-s", type=float, default=60.0)
+    # tight default is safe now: a worker announces `busy` before its
+    # first compile / long device blocks, and a busy worker gets
+    # `busy_heartbeat_timeout_s` instead — silence only counts against
+    # this budget when the worker did NOT warn us (dropped connections
+    # are caught instantly regardless of this)
+    router.add_argument("--heartbeat-timeout-s", type=float, default=10.0)
     router.add_argument("--flight-timeout-s", type=float, default=60.0)
     router.add_argument("--no-rebalance", action="store_true",
                         help="disable elastic role conversion")

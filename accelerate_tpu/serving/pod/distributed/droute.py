@@ -65,8 +65,13 @@ import numpy as np
 from ....telemetry.aggregate import merged_registry
 from ....telemetry.export import start_metrics_server
 from ....telemetry.registry import MetricsRegistry
-from ....telemetry.trace import record_span
-from ....telemetry.watchdog import StallWatchdog, resolve_stall_timeout
+from ....telemetry.trace import export_chrome_trace, ingest_spans, record_span
+from ....telemetry.watchdog import (
+    StallWatchdog,
+    resolve_incident_dir,
+    resolve_stall_timeout,
+    write_incident_bundle,
+)
 from ...engine import (
     EngineConfig,
     _as_raw_key,
@@ -79,7 +84,12 @@ from ...scheduler import Request, RequestStatus, SHED_WORKER_DROP
 from ..router import _FrontScheduler
 from ..transfer import KVPageShipment
 from .transport import Channel, ChannelListener
-from .wire import Message, shipment_from_message, shipment_to_message
+from .wire import (
+    Message,
+    shipment_from_message,
+    shipment_to_message,
+    trace_meta,
+)
 from .worker import WorkerServer
 
 __all__ = ["DistributedPodConfig", "DistributedPodRouter", "WorkerHandle",
@@ -114,6 +124,19 @@ class DistributedPodConfig:
     rebalance_window_s: float = 10.0
     occupancy_high: float = 0.85
     occupancy_low: float = 0.25
+    # a worker whose last heartbeat said `busy` (first-compile, long
+    # device block) gets THIS silence budget instead of
+    # heartbeat_timeout_s — busy-not-dead must not be a phantom loss,
+    # which is what lets heartbeat_timeout_s itself stay tight
+    busy_heartbeat_timeout_s: float = 300.0
+    # fleet incident bundles: per-worker incident_dumps RPC wall-clock
+    # budget, and the write rate limit (a flake storm must not turn the
+    # incident dir into a DoS on its own disk)
+    incident_rpc_timeout_s: float = 2.0
+    fleet_bundle_min_interval_s: float = 30.0
+    # lost workers' metric snapshots are served labeled stale="true";
+    # set a horizon (seconds since last heartbeat) to drop them entirely
+    snapshot_stale_after_s: float | None = None
 
     def __post_init__(self):
         if self.prefill_workers < 1 or self.decode_workers < 1:
@@ -137,10 +160,19 @@ class WorkerHandle:
     alive: bool = False               # True after hello/first heartbeat
     lost: bool = False
     draining: bool = False
+    busy: bool = False                # last heartbeat announced a long block
     last_heartbeat: float = 0.0
     stats: dict = dataclasses.field(default_factory=dict)
     compiles: dict = dataclasses.field(default_factory=dict)
     snapshot: dict | None = None      # last heartbeat's registry snapshot
+    snapshot_at: float = 0.0          # router clock at snapshot receipt
+    pid: int | None = None
+    # NTP-style clock estimate (router clock MINUS worker clock) from
+    # heartbeat round trips, EWMA-smoothed; error is bounded by +-RTT/2
+    clock_offset_s: float | None = None
+    clock_rtt_s: float | None = None
+    span_seq: int = 0                 # span-export high-water (dedup)
+    last_span_at: float | None = None  # router clock of last span ingest
     local: Any = None                 # in-process WorkerServer to pump
 
 
@@ -160,6 +192,8 @@ class _DFlight:
     base: int = 0                     # user tokens delivered before attempt
     progress_at: float = 0.0
     replay_started_at: float | None = None
+    dispatch_span: int = 0            # span id of this attempt's dispatch
+    #                                   (a replay span links its original)
 
 
 class DistributedPodRouter:
@@ -212,13 +246,23 @@ class DistributedPodRouter:
             for d in ("prefill_to_decode", "decode_to_prefill")}
         self._h_recovery = reg.histogram(
             "serving_pod_recovery_latency_seconds")
+        self._c_spans = reg.counter("serving_pod_worker_spans_ingested_total")
         self._g_pending = reg.gauge("serving_pod_pending_shipments")
         self._g_alive = reg.gauge("serving_pod_workers_alive")
+        self._g_clock_offset: dict[int, Any] = {}  # worker_id -> gauge
         self._g_occupancy = {
             role: reg.gauge("serving_pod_role_occupancy", role=role)
             for role in ("prefill", "decode")}
         self.metrics_server = start_metrics_server(
             ec.metrics_port, registry=self.registry)
+        # fleet incident bundles: triggered by loss/recovery/sanitizer
+        # events, written at the END of the triggering step (never from
+        # inside dispatch — the RPC fan-out re-enters the poll loop)
+        self._incident_dir = resolve_incident_dir(ec.incident_dir)
+        self._pending_incident: tuple[str, str] | None = None
+        self._last_fleet_bundle: float | None = None
+        self._incident_seq = 0
+        self._incident_replies: dict[tuple[int, int], dict] = {}
         self.watchdog: StallWatchdog | None = None
         wd_timeout = resolve_stall_timeout(ec.watchdog_timeout_s)
         if wd_timeout is not None:
@@ -397,8 +441,16 @@ class DistributedPodRouter:
             cap = sum(h.slots for h in self.workers.values()
                       if h.alive and h.role == "decode") or 1
             self.metrics.observe_step(live, cap, self.scheduler.queue_depth)
+        # fleet bundles write at the END of the step: the RPC fan-out
+        # re-enters the poll loop, which must not happen inside dispatch
+        self._maybe_write_fleet_bundle()
         if self._sanitize:
-            check_distributed_router(self)
+            try:
+                check_distributed_router(self)
+            except Exception:
+                self._note_incident("sanitizer_violation")
+                self._maybe_write_fleet_bundle()
+                raise
         outstanding = bool(self._flights) or self.scheduler.queue_depth > 0
         if not worked and outstanding and not self._has_local_workers():
             time.sleep(0.001)   # remote work in flight: don't spin hot
@@ -481,19 +533,43 @@ class DistributedPodRouter:
                 elif kind == "hello":
                     handle.slots = int(msg.meta.get("slots", handle.slots))
                     self._mark_alive(handle)
+                elif kind == "incident_dumps":
+                    self._incident_replies[
+                        (int(msg.meta.get("req_id") or 0),
+                         handle.worker_id)] = msg.meta.get("dumps") or {}
                 elif kind == "bye":
                     self._on_bye(handle)
         return worked
 
     def _on_heartbeat(self, handle: WorkerHandle, meta: dict) -> None:
         # heartbeat recency uses the ROUTER's receipt clock: worker
-        # clocks are not comparable across hosts
+        # clocks are not comparable across hosts — which is exactly why
+        # the same receipt stamp doubles as T4 of the NTP exchange below
+        now = self._clock()
         was_lost = handle.lost
         self._mark_alive(handle)
-        handle.stats = meta.get("stats", {})
-        handle.compiles = meta.get("compiles", {})
-        handle.snapshot = meta.get("snapshot")
+        # lean busy announces omit stats/compiles/snapshot: only update
+        # what this heartbeat actually carries
+        if meta.get("stats") is not None:
+            handle.stats = meta["stats"]
+        if meta.get("compiles") is not None:
+            handle.compiles = meta["compiles"]
+        handle.busy = bool(meta.get("busy", False))
+        if meta.get("pid") is not None:
+            handle.pid = int(meta["pid"])
+        if meta.get("snapshot") is not None:
+            handle.snapshot = meta.get("snapshot")
+            handle.snapshot_at = now
         handle.slots = int(handle.stats.get("slots", handle.slots))
+        self._sync_worker_clock(handle, meta, now)
+        self._ingest_worker_spans(handle, meta, now)
+        try:
+            # receipt stamp back to the worker; its echo on the NEXT
+            # heartbeat closes the NTP round trip
+            handle.channel.send(Message("hb_ack", {
+                "worker_t": meta.get("t"), "router_t": now}))
+        except ConnectionError:
+            pass  # failure detection will reap the worker
         if was_lost:
             # rejoined after a partition the router recovered around:
             # its flights were replayed elsewhere — clear its state
@@ -503,6 +579,84 @@ class DistributedPodRouter:
                     Message("set_role", {"role": handle.role}))
             except ConnectionError:
                 pass
+
+    def _sync_worker_clock(self, handle: WorkerHandle, meta: dict,
+                           now: float) -> None:
+        """NTP-style offset estimate from the heartbeat round trip. The
+        worker echoes the router's last `hb_ack` (T1 = router send, T2 =
+        worker receipt) alongside its own send stamp (T3); `now` is the
+        router receipt (T4):
+
+            offset(router - worker) = ((T1 - T2) + (T4 - T3)) / 2
+            rtt = (T4 - T1) - (T3 - T2)
+
+        Error is bounded by +-rtt/2; EWMA smoothing (alpha 0.25) rides
+        out scheduling jitter. First contact has no echo yet — fall back
+        to the one-way T4 - T3 (biased by the network delay; the first
+        completed round trip corrects it). In-process workers short-
+        circuit to offset 0 — they share the router's clock, and the
+        estimator's "delay" would be whole engine steps."""
+        if handle.local is not None:
+            # in-process workers share this very clock: the estimator's
+            # "network delay" would be whole engine steps (large, one-
+            # sided), injecting error where the true offset is exactly 0
+            handle.clock_offset_s = 0.0
+            handle.clock_rtt_s = 0.0
+        t3 = meta.get("t")
+        if t3 is None:
+            return
+        t3 = float(t3)
+        if handle.local is not None:
+            self._set_clock_offset_gauge(handle)
+            return
+        ack = meta.get("ack") or {}
+        t1, t2 = ack.get("router_t"), ack.get("worker_recv_t")
+        if t1 is not None and t2 is not None:
+            t1, t2 = float(t1), float(t2)
+            rtt = (now - t1) - (t3 - t2)
+            if rtt < 0:
+                return   # a clock stepped mid-round: discard the sample
+            handle.clock_rtt_s = (
+                rtt if handle.clock_rtt_s is None
+                else 0.75 * handle.clock_rtt_s + 0.25 * rtt)
+            sample = ((t1 - t2) + (now - t3)) / 2.0
+        elif handle.clock_offset_s is None:
+            sample = now - t3
+        else:
+            return       # have a round-trip estimate; don't regress to one-way
+        handle.clock_offset_s = (
+            sample if handle.clock_offset_s is None
+            else 0.75 * handle.clock_offset_s + 0.25 * sample)
+        self._set_clock_offset_gauge(handle)
+
+    def _set_clock_offset_gauge(self, handle: WorkerHandle) -> None:
+        gauge = self._g_clock_offset.get(handle.worker_id)
+        if gauge is None:
+            gauge = self._g_clock_offset[handle.worker_id] = \
+                self.registry.gauge(
+                    "serving_pod_worker_clock_offset_seconds",
+                    worker=str(handle.worker_id))
+        gauge.set(handle.clock_offset_s)
+
+    def _ingest_worker_spans(self, handle: WorkerHandle, meta: dict,
+                             now: float) -> None:
+        """Rebase a heartbeat's span batch into router time and index it.
+        `span_seq` is the worker's export high-water mark — a duplicated
+        heartbeat (at-least-once transports resend) must not double its
+        spans."""
+        spans = meta.get("spans")
+        seq = int(meta.get("span_seq") or 0)
+        if seq > handle.span_seq:
+            handle.span_seq = seq
+        elif spans:
+            return
+        if not spans:
+            return
+        n = ingest_spans(spans, offset_s=handle.clock_offset_s or 0.0,
+                         pid=handle.pid, worker=handle.worker_id)
+        if n:
+            self._c_spans.inc(n)
+            handle.last_span_at = now
 
     def _stale_msg(self, meta: dict, want_phase: str) -> "_DFlight | None":
         """Resolve a job-bearing message to its flight, or count it
@@ -609,13 +763,23 @@ class DistributedPodRouter:
                 continue
             if handle.channel.closed:
                 self._lose_worker(handle, RECOVER_CHANNEL_DROP)
-            elif now - handle.last_heartbeat > self.pod_config.heartbeat_timeout_s:
+                continue
+            timeout = self.pod_config.heartbeat_timeout_s
+            if handle.busy:
+                # the worker ANNOUNCED a long block (first compile, big
+                # device step) before going quiet: busy-not-dead gets the
+                # long rope, which is what lets the plain timeout stay
+                # tight without phantom losses
+                timeout = max(timeout,
+                              self.pod_config.busy_heartbeat_timeout_s)
+            if now - handle.last_heartbeat > timeout:
                 self._lose_worker(handle, RECOVER_HEARTBEAT_TIMEOUT)
 
     def _lose_worker(self, handle: WorkerHandle, reason: str) -> None:
         handle.alive = False
         handle.lost = True
         self._c_lost.inc()
+        self._note_incident(reason, f"fleet-loss-w{handle.worker_id}")
         for flight in [f for f in self._flights.values()
                        if f.worker == handle.worker_id
                        and f.phase in ("prefill", "decode")]:
@@ -653,6 +817,21 @@ class DistributedPodRouter:
         now = self._clock()
         user = flight.user
         old_worker = flight.worker
+        if user.trace_sampled:
+            # the replay decision as a span: linked (not parented) to the
+            # failed attempt's dispatch, tagged with the machine-readable
+            # reason — the trace shows WHY the timeline restarts
+            record_span(
+                "serving.replay", flight.progress_at, now,
+                trace=user.trace_id, parent=user.span_id,
+                links=([flight.dispatch_span] if flight.dispatch_span
+                       else None),
+                recovery_reason=reason, attempt=flight.attempt,
+                worker=old_worker)
+        if reason in (RECOVER_STALLED, RECOVER_INSTALL_REFUSED,
+                      RECOVER_WORKER_DROP):
+            # loss reasons already noted in _lose_worker
+            self._note_incident(reason, f"fleet-{reason}")
         self.recovery_log.append({
             "request_id": user.request_id,
             "flight_id": flight.flight_id,
@@ -787,7 +966,9 @@ class DistributedPodRouter:
                     {"flight_id": flight.flight_id,
                      "attempt": flight.attempt,
                      "budget": budget,
-                     "temperature": user.temperature},
+                     "temperature": user.temperature,
+                     **trace_meta(user.trace_id, user.span_id or 0,
+                                  user.trace_sampled)},
                     buffers=[np.asarray(prompt, np.int32), flight.key_raw]))
             except ConnectionError:
                 self._lose_worker(handle, RECOVER_CHANNEL_DROP)
@@ -799,6 +980,14 @@ class DistributedPodRouter:
             flight.phase = "prefill"
             flight.worker = handle.worker_id
             flight.progress_at = now
+            if user.trace_sampled:
+                # instant marker; a later replay links back to it to say
+                # WHICH attempt it supersedes
+                flight.dispatch_span = record_span(
+                    "serving.pod.dispatch", now, now,
+                    trace=user.trace_id, parent=user.span_id,
+                    flight_id=flight.flight_id, attempt=flight.attempt,
+                    worker=handle.worker_id)
             worked = True
         return worked
 
@@ -821,7 +1010,10 @@ class DistributedPodRouter:
             try:
                 handle.channel.send(shipment_to_message(
                     shipment, flight_id=flight.flight_id,
-                    attempt=flight.attempt))
+                    attempt=flight.attempt,
+                    **trace_meta(flight.user.trace_id,
+                                 flight.user.span_id or 0,
+                                 flight.user.trace_sampled)))
             except ConnectionError:
                 self._lose_worker(handle, RECOVER_CHANNEL_DROP)
                 continue       # head flight intact: try another worker
@@ -835,10 +1027,17 @@ class DistributedPodRouter:
             self._c_shipments.inc()
             self._c_pages_shipped.inc(shipment.n_prompt_pages)
             if flight.user.trace_sampled:
+                # extracted_at was stamped on the PREFILL worker's clock:
+                # rebase it into router time so the transfer span doesn't
+                # float against the rest of the timeline
+                src = self.workers.get(shipment.src_worker)
+                offset = (src.clock_offset_s or 0.0) if src else 0.0
+                start = shipment.extracted_at + offset
                 record_span(
-                    "serving.page_transfer", shipment.extracted_at,
+                    "serving.page_transfer", min(start, flight.progress_at),
                     flight.progress_at, trace=flight.user.trace_id,
                     parent=flight.user.span_id,
+                    attempt=flight.attempt,
                     pages=shipment.n_prompt_pages,
                     bytes=shipment.page_bytes,
                     src_worker=shipment.src_worker,
@@ -946,6 +1145,13 @@ class DistributedPodRouter:
             out["pod_recovery_latency_p99_ms"] = \
                 self._h_recovery.quantile(0.99) * 1e3
             out["pod_recovery_latency_mean_ms"] = self._h_recovery.mean * 1e3
+        out["pod_spans_ingested"] = float(self._c_spans.value)
+        now = self._clock()
+        lags = [now - h.last_span_at for h in self.workers.values()
+                if h.last_span_at is not None]
+        if lags:
+            # the SLOWEST exporter bounds how fresh a merged trace is
+            out["pod_span_export_lag_s"] = max(lags)
         return out
 
     def exposition_registry(self) -> MetricsRegistry:
@@ -954,7 +1160,14 @@ class DistributedPodRouter:
         `aggregate_snapshot` semantics (counter sums, gauge min/mean/max,
         sketch-merged histograms incl. `__slowest_host_mean`) under
         `origin="workers"` — one scrape shows the whole pod, no jax
-        process group involved."""
+        process group involved.
+
+        Staleness-honest: every contributing snapshot also exposes its
+        age (`serving_pod_worker_snapshot_age_seconds{worker=}`), and a
+        LOST worker's numbers merge under an extra `stale="true"` label —
+        frozen counters from a dead process must not impersonate live
+        ones. Past `snapshot_stale_after_s` (when set) they drop
+        entirely."""
         reg = MetricsRegistry()
         for kind, name, labels, metric in self.registry.items():
             if kind == "counter":
@@ -963,10 +1176,24 @@ class DistributedPodRouter:
                 reg.gauge(name, **dict(labels)).set(metric.value)
             else:
                 reg.histogram(name, **dict(labels)).merge(metric)
-        snaps = [h.snapshot for h in self.workers.values()
-                 if h.snapshot is not None]
-        if snaps:
-            merged_registry(snaps, registry=reg, origin="workers")
+        now = self._clock()
+        horizon = self.pod_config.snapshot_stale_after_s
+        live, stale = [], []
+        for h in self.workers.values():
+            if h.snapshot is None:
+                continue
+            reg.gauge("serving_pod_worker_snapshot_age_seconds",
+                      worker=str(h.worker_id)).set(
+                          max(0.0, now - h.snapshot_at))
+            if h.alive and not h.lost:
+                live.append(h.snapshot)
+            elif horizon is None or now - h.last_heartbeat <= horizon:
+                stale.append(h.snapshot)
+        if live:
+            merged_registry(live, registry=reg, origin="workers")
+        if stale:
+            merged_registry(stale, registry=reg, origin="workers",
+                            stale="true")
         return reg
 
     def reset_metrics(self) -> None:
@@ -1009,12 +1236,23 @@ class DistributedPodRouter:
         phases: dict[str, int] = {}
         for f in self._flights.values():
             phases[f.phase] = phases.get(f.phase, 0) + 1
+        now = self._clock()
         return {
             "workers": [{
                 "worker_id": h.worker_id, "role": h.role,
                 "alive": h.alive, "lost": h.lost, "draining": h.draining,
+                "busy": h.busy, "pid": h.pid,
                 "slots": h.slots,
                 "load": self._worker_load(h.worker_id),
+                "heartbeat_age_s": (now - h.last_heartbeat
+                                    if h.last_heartbeat else None),
+                "snapshot_age_s": (now - h.snapshot_at
+                                   if h.snapshot is not None else None),
+                "clock_offset_s": h.clock_offset_s,
+                "clock_rtt_s": h.clock_rtt_s,
+                "span_export_lag_s": (now - h.last_span_at
+                                      if h.last_span_at is not None
+                                      else None),
                 "stats": h.stats, "compiles": h.compiles,
             } for h in self.workers.values()],
             "in_flight": phases,
@@ -1061,12 +1299,138 @@ class DistributedPodRouter:
             ("requests", self.debug_requests),
             ("scheduler", self.debug_scheduler),
             ("compile_stats", self.compile_stats),
+            ("clock_offsets", self._clock_offsets),
+            ("flights_trace", self._flights_trace),
         ):
             try:
                 out[name] = build()
             except Exception as e:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
+
+    # -- fleet incident bundles ----------------------------------------------
+
+    def _clock_offsets(self) -> dict:
+        now = self._clock()
+        return {str(h.worker_id): {
+            "role": h.role, "alive": h.alive, "lost": h.lost,
+            "offset_s": h.clock_offset_s, "rtt_s": h.clock_rtt_s,
+            "heartbeat_age_s": (now - h.last_heartbeat
+                                if h.last_heartbeat else None),
+        } for h in self.workers.values()}
+
+    def _flights_trace(self) -> dict:
+        """Merged chrome traces of every in-flight sampled request —
+        worker spans are already rebased into router time at ingest, so
+        each document is ONE aligned Perfetto timeline."""
+        out: dict[str, Any] = {}
+        for f in self._flights.values():
+            tid = f.user.trace_id
+            if tid is None or not f.user.trace_sampled:
+                continue
+            try:
+                out[str(tid)] = export_chrome_trace(trace_id=tid)
+            except Exception as e:
+                out[str(tid)] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def _note_incident(self, reason: str, name: str | None = None) -> None:
+        """Arm a fleet bundle for the END of this step. First trigger
+        wins — a cascade (loss -> replays -> sanitizer) is one incident,
+        not four bundles."""
+        if self._incident_dir is None:
+            return
+        if self._pending_incident is None:
+            self._pending_incident = (reason, name or f"fleet-{reason}")
+
+    def _maybe_write_fleet_bundle(self) -> None:
+        if self._pending_incident is None:
+            return
+        reason, name = self._pending_incident
+        self._pending_incident = None
+        # wall clock on purpose: rate-limits real disk writes even under
+        # a fake injected clock, so a flake storm cannot DoS the disk
+        now = time.monotonic()
+        if (self._last_fleet_bundle is not None
+                and now - self._last_fleet_bundle
+                < self.pod_config.fleet_bundle_min_interval_s):
+            return
+        self._last_fleet_bundle = now
+        try:
+            self.write_fleet_incident_bundle(reason, name=name)
+        except Exception:
+            pass   # incident capture must never take down serving
+
+    def fetch_worker_dumps(self, timeout_s: float | None = None) \
+            -> dict[int, dict]:
+        """`incident_dumps` from every reachable worker over a bounded
+        RPC: fan out `incident_request`, pump replies off the normal
+        dispatch path, give up per-worker at the deadline. Unreachable
+        workers yield a `worker_error` stanza — a fleet bundle is always
+        complete, just honest about holes."""
+        budget = (self.pod_config.incident_rpc_timeout_s
+                  if timeout_s is None else timeout_s)
+        self._incident_seq += 1
+        rid = self._incident_seq
+        out: dict[int, dict] = {}
+        asked: list[WorkerHandle] = []
+        for handle in self.workers.values():
+            if not handle.alive or handle.channel.closed:
+                out[handle.worker_id] = {
+                    "worker_error": "unreachable (lost)"}
+                continue
+            try:
+                handle.channel.send(
+                    Message("incident_request", {"req_id": rid}))
+            except ConnectionError:
+                out[handle.worker_id] = {
+                    "worker_error": "unreachable (send failed)"}
+                continue
+            asked.append(handle)
+        # wall-clock deadline: an injected fake clock doesn't tick while
+        # we block here, and a dead worker must not hang the bundle
+        deadline = time.monotonic() + budget
+        while asked and time.monotonic() < deadline:
+            for handle in asked:
+                if handle.local is not None and not handle.lost:
+                    handle.local.run_once()
+            self._dispatch_inbound()
+            for handle in list(asked):
+                dumps = self._incident_replies.pop(
+                    (rid, handle.worker_id), None)
+                if dumps is not None:
+                    out[handle.worker_id] = dumps
+                    asked.remove(handle)
+            if asked and not self._has_local_workers():
+                time.sleep(0.005)
+        for handle in asked:
+            out[handle.worker_id] = {
+                "worker_error": f"no reply within {budget}s"}
+        return out
+
+    def write_fleet_incident_bundle(self, reason: str,
+                                    name: str | None = None) -> str | None:
+        """ONE bundle for a pod-wide event: the router's own dumps, every
+        reachable worker's `incident_dumps` (`worker_<id>` sections),
+        clock offsets, and the merged chrome trace of each in-flight
+        request. Returns the bundle path (None when no incident dir)."""
+        if self._incident_dir is None:
+            return None
+        worker_dumps = self.fetch_worker_dumps()
+        dumps: dict[str, Any] = self.incident_dumps()
+        for wid, wd in sorted(worker_dumps.items()):
+            dumps[f"worker_{wid}"] = wd
+        report = {
+            "kind": "fleet_incident",
+            "reason": reason,
+            "workers": sorted(self.workers),
+            "clock_offsets": dumps.get("clock_offsets"),
+            "recovery_log": list(self.recovery_log)[-32:],
+        }
+        return write_incident_bundle(
+            self._incident_dir, report,
+            registry=self.exposition_registry(), dumps=dumps,
+            name=name or f"fleet-{reason}")
 
 
 # ---------------------------------------------------------------------------
@@ -1111,7 +1475,10 @@ def build_local_distributed_pod(
             engine.close()   # heartbeats are the worker's only exporter
             server = WorkerServer(
                 engine, worker_side, worker_id=wid, role=role,
-                heartbeat_interval_s=pc.heartbeat_interval_s, clock=clock)
+                heartbeat_interval_s=pc.heartbeat_interval_s, clock=clock,
+                # in-process workers share the router's span ring —
+                # exporting over the wire would double every span
+                export_spans=False)
             router.register_worker(router_side, wid, role,
                                    slots=len(engine.scheduler.slots),
                                    local=server)

@@ -27,6 +27,14 @@ Frame layout (all integers big-endian)::
 
 `MAX_FRAME_BYTES` bounds what a reader will allocate for one frame —
 a garbage length prefix must not OOM the router.
+
+Trace context rides the header, not the framing: any job-bearing
+message's meta may carry an optional ``traceparent`` (the W3C
+`00-<trace>-<span>-<flags>` string) plus a ``sampled`` verdict —
+`trace_meta` builds the pair, and a missing/malformed header simply
+means "unsampled" (never an error; tracing must not be able to break
+the dataflow). The router stamps it on submits and shipments so a
+worker's engine spans join the router-minted request trace.
 """
 
 from __future__ import annotations
@@ -46,6 +54,7 @@ __all__ = [
     "write_frame",
     "shipment_to_message",
     "shipment_from_message",
+    "trace_meta",
     "WireError",
 ]
 
@@ -170,6 +179,23 @@ def write_frame(sock, frame: bytes) -> None:
 
 # buffer order is part of the wire contract (header carries no names)
 _SHIP_BUFFERS = ("prompt", "k_pages", "v_pages", "key_raw")
+
+
+def trace_meta(trace_id, span_id: int = 0, sampled: bool = False) -> dict:
+    """Meta fields that propagate one request's trace context across a
+    hop: a W3C ``traceparent`` plus the router's head-sampling verdict.
+    Empty dict when the request has no trace id (tracing off) — the
+    caller splices it into any message meta with ``**``, so untraced
+    traffic carries zero extra bytes."""
+    if not trace_id:
+        return {}
+    from ....telemetry.trace import format_traceparent
+
+    return {
+        "traceparent": format_traceparent(str(trace_id), span_id or 0,
+                                          bool(sampled)),
+        "sampled": bool(sampled),
+    }
 
 
 def shipment_to_message(shipment, **extra_meta) -> Message:

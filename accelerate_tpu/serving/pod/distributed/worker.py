@@ -30,16 +30,41 @@ safe under re-prefill recovery (no token delivered twice).
 sync, heartbeat) — the in-process tests drive it directly under a fake
 clock. `run()` wraps it in the real loop with SIGTERM drain mirroring
 `serve`: finish in-flight work, say `bye`, exit.
+
+Observability (ISSUE 18) crosses the boundary in both directions:
+
+- inbound `traceparent` meta (submits AND shipments) joins this
+  worker's engine spans to the router-minted request trace, so a
+  prefill on worker A and the decode on worker B belong to ONE trace;
+- heartbeats export the worker's recent ring-buffer span events
+  (bounded, newest-first — `telemetry.trace.drain_spans`) plus the
+  NTP-style echo (`ack`) the router needs to estimate this worker's
+  clock offset and rebase those spans into router time;
+- a `busy` heartbeat announces "entering a device block that may
+  outlast the heartbeat interval" (first-compile, long steps) BEFORE
+  going silent, so the router can defer the phantom `heartbeat_timeout`
+  verdict — the documented PR 17 hazard;
+- an `incident_request` message answers with this worker's
+  `incident_dumps()` so the router's fleet incident bundle freezes
+  every process's state, not just its own.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 from typing import Any
 
 import numpy as np
 
+from ....telemetry.trace import (
+    drain_spans,
+    parse_traceparent,
+    record_span,
+    tracing_enabled,
+)
 from ...scheduler import RequestStatus
 from ..transfer import PageTransport, place_shipment
 from .transport import Channel
@@ -118,6 +143,7 @@ class _Job:
     internal: Any
     sent_tokens: int = 0      # decode: tokens already synced at least once
     sent_done: bool = False
+    started_at: float = 0.0   # worker clock; bounds this job's spans
 
 
 class WorkerServer:
@@ -125,7 +151,8 @@ class WorkerServer:
 
     def __init__(self, engine, channel: Channel, worker_id: int,
                  role: str = "decode", heartbeat_interval_s: float = 0.5,
-                 clock=time.monotonic):
+                 clock=time.monotonic, export_spans: bool = True,
+                 span_export_limit: int = 256):
         self.engine = engine
         self.channel = channel
         self.worker_id = int(worker_id)
@@ -139,6 +166,16 @@ class WorkerServer:
         self._jobs: dict[int, _Job] = {}
         self._admit_pages: dict[int, list] = {}
         self.stale_messages = 0
+        # span export: off for in-process workers (they share the
+        # router's flight recorder — exporting would double every span),
+        # on for real worker processes
+        self.export_spans = bool(export_spans)
+        self.span_export_limit = int(span_export_limit)
+        self._span_cursor = 0
+        # the router's last hb_ack, echoed on the next heartbeat — the
+        # two middle timestamps of the NTP exchange the router completes
+        self._last_ack: dict | None = None
+        self._last_step_s = 0.0
         # the admit hook mirrors PodRouter._record_admit: a short prompt
         # can admit, prefill and retire inside ONE engine.step(), and the
         # alloc dies with the slot — snapshot pages the moment they exist
@@ -160,6 +197,21 @@ class WorkerServer:
         except ConnectionError:
             self.done = True  # router gone: nothing left to serve
 
+    @staticmethod
+    def _trace_context(meta: dict) -> tuple[str | None, int, bool]:
+        """(trace_id, parent_span_id, sampled) from a job-bearing
+        message's optional `traceparent` meta. Malformed or absent ->
+        (None, 0, False): tracing can degrade, never break dataflow."""
+        parsed = parse_traceparent(meta.get("traceparent"))
+        if parsed is None:
+            return None, 0, False
+        trace_id, parent_hex = parsed
+        try:
+            parent = int(parent_hex, 16)
+        except ValueError:
+            parent = 0
+        return trace_id, parent, bool(meta.get("sampled", False))
+
     def _stale(self, meta: dict) -> bool:
         """True when a job-bearing message is from a superseded attempt
         (dup/reorder of a replayed flight) — dropped, counted."""
@@ -179,23 +231,26 @@ class WorkerServer:
                 return
             self._evict(int(meta["flight_id"]))
             prompt, key_raw = msg.buffers
+            trace_id, parent, sampled = self._trace_context(meta)
             internal = self.engine.submit(
                 np.asarray(prompt, np.int32),
                 max_new_tokens=int(meta["budget"]),
                 temperature=float(meta["temperature"]),
                 key=np.asarray(key_raw, np.uint32),
-                trace_sampled=False)
+                trace_id=trace_id, trace_parent=parent,
+                trace_sampled=sampled)
             self._jobs[int(meta["flight_id"])] = _Job(
                 flight_id=int(meta["flight_id"]),
                 attempt=int(meta["attempt"]), mode="prefill",
-                internal=internal)
+                internal=internal, started_at=self._clock())
         elif msg.kind == "shipment":
             if self._stale(meta):
                 return
             self._evict(int(meta["flight_id"]))
             shipment = shipment_from_message(msg)
+            t0 = self._clock()
             placed = place_shipment(self.engine, self.transport, shipment,
-                                    self._clock())
+                                    t0)
             if placed is None:
                 # no slot/pages here right now — the router re-routes or
                 # replays; refusing is cheaper than deadlocking a slot
@@ -205,10 +260,42 @@ class WorkerServer:
                     "worker_id": self.worker_id}))
                 return
             internal, _slot, _alloc = placed
+            # join the router's trace AFTER placement: the internal is
+            # built by place_shipment, not engine.submit
+            trace_id, parent, sampled = self._trace_context(meta)
+            if trace_id is not None:
+                from ...engine import prepare_request_tracing
+
+                prepare_request_tracing(internal, trace_id, parent, sampled)
+                if internal.trace_sampled:
+                    # decode start on THIS worker: pages landed, slot
+                    # adopted — the third leg of the cross-process
+                    # timeline (prefill -> page_transfer -> install)
+                    record_span(
+                        "serving.pod.install", t0, self._clock(),
+                        trace=internal.trace_id, parent=parent,
+                        worker=self.worker_id,
+                        flight_id=int(meta["flight_id"]),
+                        attempt=int(meta["attempt"]),
+                        pages=shipment.n_prompt_pages)
             self._jobs[int(meta["flight_id"])] = _Job(
                 flight_id=int(meta["flight_id"]),
                 attempt=int(meta["attempt"]), mode="decode",
-                internal=internal, sent_tokens=1)
+                internal=internal, sent_tokens=1, started_at=t0)
+        elif msg.kind == "hb_ack":
+            # router's receipt stamp for one of our heartbeats; echo it
+            # (plus OUR receipt time of this ack) on the next heartbeat —
+            # the router then holds all four NTP timestamps
+            self._last_ack = {
+                "router_t": float(meta.get("router_t", 0.0)),
+                "worker_recv_t": self._clock(),
+            }
+        elif msg.kind == "incident_request":
+            self._send(Message("incident_dumps", {
+                "req_id": meta.get("req_id"),
+                "worker_id": self.worker_id,
+                "dumps": self.incident_dumps(),
+            }))
         elif msg.kind == "cancel":
             job = self._jobs.pop(int(meta["flight_id"]), None)
             if job is not None:
@@ -267,6 +354,15 @@ class WorkerServer:
             pages = self._admit_pages.pop(id(internal), None)
             shipment = self.transport.extract_shipment(
                 pages, internal, src_worker=self.worker_id, extracted_at=now)
+            if internal.trace_sampled:
+                # prefill on THIS worker, submit->extract: the first leg
+                # of the cross-process timeline (ends where the router's
+                # page_transfer span begins)
+                record_span(
+                    "serving.pod.prefill", job.started_at, now,
+                    trace=internal.trace_id, parent=internal.trace_parent,
+                    worker=self.worker_id, flight_id=job.flight_id,
+                    attempt=job.attempt)
             if not internal.done:
                 # retire as FINISHED so the prompt enters this worker's
                 # prefix tree: shared prefixes prefill once per worker
@@ -296,15 +392,73 @@ class WorkerServer:
                 job.sent_done = True
                 del self._jobs[job.flight_id]
 
-    def _maybe_heartbeat(self) -> None:
+    def _busy_hint(self) -> bool:
+        """True when the NEXT engine.step() may outlast the heartbeat
+        interval: a program this worker's pending work needs has never
+        compiled (first-compile is the documented phantom-loss hazard),
+        or the previous step already ran long. Announced BEFORE stepping
+        so the router defers its `heartbeat_timeout` verdict while this
+        worker is provably busy-not-dead."""
+        if not self.engine.scheduler.has_work():
+            return False
+        if self._last_step_s > max(self.heartbeat_interval_s, 0.05):
+            return True
+        compiles = self.engine.compile_stats()
+        modes = {j.mode for j in self._jobs.values()}
+        if "decode" not in modes or not modes:
+            # queued/prefill work ahead: needs admit + prefill programs
+            if not compiles.get("admit") or not compiles.get("prefill"):
+                return True
+        if "decode" in modes and not compiles.get("decode"):
+            return True
+        return False
+
+    def incident_dumps(self) -> dict:
+        """This worker's contribution to a fleet incident bundle: its
+        channel-facing job table plus the engine's own dumps, forced
+        JSON-safe (the reply crosses the wire codec — one unserializable
+        value must not cost the router the whole stanza)."""
+        out: dict[str, Any] = {
+            "worker_id": self.worker_id,
+            "role": self.role,
+            "pid": os.getpid(),
+            "draining": self.draining,
+            "stale_messages": self.stale_messages,
+            "jobs": [{
+                "flight_id": j.flight_id, "attempt": j.attempt,
+                "mode": j.mode, "tokens": len(j.internal.tokens),
+                "done": bool(j.internal.done),
+            } for j in self._jobs.values()],
+        }
+        try:
+            out["engine"] = self.engine.incident_dumps()
+        except Exception as e:
+            out["engine"] = {"error": f"{type(e).__name__}: {e}"}
+        return json.loads(json.dumps(out, default=str))
+
+    def _maybe_heartbeat(self, force: bool = False,
+                         busy: bool = False, lean: bool = False) -> None:
         now = self._clock()
-        if now - self._last_heartbeat < self.heartbeat_interval_s:
+        if not force and now - self._last_heartbeat < self.heartbeat_interval_s:
             return
         self._last_heartbeat = now
+        if lean:
+            # the busy pre-announce is latency-critical (it must be in
+            # flight before the device block) — ship only liveness + the
+            # NTP stamps, not the registry snapshot
+            meta = {"worker_id": self.worker_id, "role": self.role,
+                    "t": now, "pid": os.getpid(),
+                    "draining": self.draining, "busy": bool(busy)}
+            if self._last_ack is not None:
+                meta["ack"] = self._last_ack
+            self._send(Message("heartbeat", meta))
+            return
         eng = self.engine
-        self._send(Message("heartbeat", {
+        meta = {
             "worker_id": self.worker_id, "role": self.role, "t": now,
+            "pid": os.getpid(),
             "draining": self.draining,
+            "busy": bool(busy or self._busy_hint()),
             "stats": {
                 "slots": len(eng.scheduler.slots),
                 "live_slots": eng.scheduler.live_slots,
@@ -318,7 +472,23 @@ class WorkerServer:
             # counters/gauges/sketches aggregate router-side without a
             # jax process group (telemetry/aggregate.py)
             "snapshot": eng.registry.snapshot(include_sketch=True),
-        }))
+        }
+        if self._last_ack is not None:
+            # the NTP echo: (router send, our receipt) of the last ack;
+            # together with this heartbeat's ("t", router receipt) the
+            # router holds all four timestamps of one round trip
+            meta["ack"] = self._last_ack
+        if self.export_spans and tracing_enabled():
+            spans, cursor = drain_spans(self._span_cursor,
+                                        limit=self.span_export_limit)
+            if cursor != self._span_cursor:
+                self._span_cursor = cursor
+                if spans:
+                    meta["spans"] = spans
+                # the high-water mark dedups ingestion under heartbeat
+                # dup/reorder (FlakyTransport can deliver one twice)
+                meta["span_seq"] = cursor
+        self._send(Message("heartbeat", meta))
 
     # -- drive ---------------------------------------------------------------
 
@@ -332,9 +502,18 @@ class WorkerServer:
         msgs = self.channel.poll()
         for msg in msgs:
             self._handle(msg)
-        worked = bool(msgs)
+        # hb_acks are clock-sync plumbing, not progress: counting them
+        # would ping-pong with our own heartbeats and keep an idle pod's
+        # step() returning True forever
+        worked = any(m.kind != "hb_ack" for m in msgs)
         if self.engine.scheduler.has_work():
+            if self._busy_hint():
+                # announce the device block BEFORE entering it: the
+                # heartbeat must be in flight while we cannot send
+                self._maybe_heartbeat(force=True, busy=True, lean=True)
+            t0 = self._clock()
             self.engine.step()
+            self._last_step_s = self._clock() - t0
             worked = True
         self._harvest_prefill()
         self._sync_decode()

@@ -36,10 +36,12 @@ from .registry import (
 from .trace import (
     clear_flight_recorder,
     configure_tracing,
+    drain_spans,
     export_chrome_trace,
     flight_recorder,
     format_traceparent,
     head_sample,
+    ingest_spans,
     new_trace_id,
     parse_traceparent,
     record_span,
@@ -98,6 +100,8 @@ __all__ = [
     "trace_events",
     "clear_flight_recorder",
     "export_chrome_trace",
+    "drain_spans",
+    "ingest_spans",
     "MetricsServer",
     "render_prometheus",
     "resolve_metrics_port",

@@ -35,8 +35,21 @@ from .registry import MetricsRegistry, StreamingHistogram, get_registry
 __all__ = ["aggregate_snapshot", "aggregate_flat", "merged_registry"]
 
 
+def _section(snapshot, name: str) -> dict:
+    """A snapshot section as a dict, whatever the peer sent. Snapshots
+    cross process (and version) boundaries — a newer worker's schema may
+    rename or reshape a section; aggregation must skip what it does not
+    understand, never crash the scrape."""
+    if not isinstance(snapshot, dict):
+        return {}
+    sec = snapshot.get(name)
+    return sec if isinstance(sec, dict) else {}
+
+
 def _reduce_scalar(values: list[float]) -> dict[str, float]:
-    vals = [v for v in values if v == v]  # drop NaN
+    vals = [v for v in values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v == v]  # drop non-numeric (foreign schema) and NaN
     if not vals:
         return {"min": math.nan, "mean": math.nan, "max": math.nan,
                 "sum": math.nan}
@@ -69,34 +82,42 @@ def aggregate_snapshot(registry: MetricsRegistry | None = None,
     out: dict = {"num_hosts": len(snapshots), "counters": {}, "gauges": {},
                  "histograms": {}}
 
-    keys = {k for s in snapshots for k in s.get("counters", {})}
+    keys = {k for s in snapshots for k in _section(s, "counters")}
     for key in sorted(keys):
-        vals = [s["counters"][key] for s in snapshots
-                if key in s.get("counters", {})]
+        vals = [_section(s, "counters")[key] for s in snapshots
+                if key in _section(s, "counters")]
         red = _reduce_scalar(vals)
         out["counters"][key] = {"sum": red["sum"], "min": red["min"],
                                 "max": red["max"]}
 
-    keys = {k for s in snapshots for k in s.get("gauges", {})}
+    keys = {k for s in snapshots for k in _section(s, "gauges")}
     for key in sorted(keys):
-        vals = [s["gauges"][key] for s in snapshots
-                if key in s.get("gauges", {})]
+        vals = [_section(s, "gauges")[key] for s in snapshots
+                if key in _section(s, "gauges")]
         red = _reduce_scalar(vals)
         out["gauges"][key] = {"min": red["min"], "mean": red["mean"],
                               "max": red["max"], "sum": red["sum"]}
 
-    keys = {k for s in snapshots for k in s.get("histograms", {})}
+    keys = {k for s in snapshots for k in _section(s, "histograms")}
     for key in sorted(keys):
-        entries = [s["histograms"][key] for s in snapshots
-                   if key in s.get("histograms", {})]
+        entries = [_section(s, "histograms")[key] for s in snapshots
+                   if key in _section(s, "histograms")]
+        entries = [e for e in entries if isinstance(e, dict)]
         merged: StreamingHistogram | None = None
         per_host_means = []
         for e in entries:
-            if e.get("count"):
-                per_host_means.append(e["sum"] / e["count"])
+            count = e.get("count")
+            # an older peer's entry may lack "sum" entirely: no mean
+            # contribution from it, but its sketch still merges
+            if (isinstance(count, (int, float)) and count
+                    and isinstance(e.get("sum"), (int, float))):
+                per_host_means.append(e["sum"] / count)
             sketch = e.get("sketch")
             if sketch is not None:
-                h = StreamingHistogram.from_dict(sketch)
+                try:
+                    h = StreamingHistogram.from_dict(sketch)
+                except (TypeError, KeyError, ValueError):
+                    continue   # foreign sketch encoding: skip this host
                 if merged is None:
                     merged = h
                 else:
@@ -115,8 +136,10 @@ def aggregate_snapshot(registry: MetricsRegistry | None = None,
             }
         else:  # sketchless snapshots still reduce their scalar stats
             entry = {
-                "count": sum(e.get("count", 0.0) for e in entries),
-                "sum": sum(e.get("sum", 0.0) for e in entries),
+                "count": sum(e.get("count", 0.0) for e in entries
+                             if isinstance(e.get("count", 0.0), (int, float))),
+                "sum": sum(e.get("sum", 0.0) for e in entries
+                           if isinstance(e.get("sum", 0.0), (int, float))),
             }
             if entry["count"]:
                 entry["mean"] = entry["sum"] / entry["count"]
@@ -202,9 +225,13 @@ def merged_registry(snapshots: list[dict],
         name, labels = _parse_series_key(key)
         hist = reg.histogram(name, **{**labels, **extra_labels})
         for snap in snapshots:
-            sketch = snap.get("histograms", {}).get(key, {}).get("sketch")
+            e = _section(snap, "histograms").get(key)
+            sketch = e.get("sketch") if isinstance(e, dict) else None
             if sketch is not None:
-                hist.merge(StreamingHistogram.from_dict(sketch))
+                try:
+                    hist.merge(StreamingHistogram.from_dict(sketch))
+                except (TypeError, KeyError, ValueError):
+                    pass   # foreign sketch encoding: skip this host
         if "slowest_host_mean" in entry:
             reg.gauge(f"{name}__slowest_host_mean",
                       **{**labels, **extra_labels}).set(

@@ -63,6 +63,8 @@ __all__ = [
     "trace_events",
     "clear_flight_recorder",
     "export_chrome_trace",
+    "drain_spans",
+    "ingest_spans",
 ]
 
 
@@ -84,13 +86,16 @@ _NULL_SPAN = _NullSpan()
 class _State:
     __slots__ = ("enabled", "annotate", "ring", "ring_size", "index",
                  "lock", "span_ids", "trace_ids", "tls", "sample_rates",
-                 "default_sample_rate")
+                 "default_sample_rate", "appended")
 
     def __init__(self):
         self.enabled = False
         self.annotate = True
         self.ring_size = 4096
         self.ring: deque = deque()
+        # monotone count of every event ever appended — the cursor space
+        # for `drain_spans` (a pod worker's heartbeat exporter)
+        self.appended = 0
         # trace_id -> [event, ...] side index over the SAME event dicts
         # the ring holds; pruned in lockstep with ring eviction, so it is
         # bounded by the ring and never outlives it
@@ -232,6 +237,7 @@ def _append_event(event: dict) -> None:
         if len(_STATE.ring) >= _STATE.ring_size:
             _prune_index(_STATE.ring.popleft())
         _STATE.ring.append(event)
+        _STATE.appended += 1
         tid = event.get("trace_id")
         if tid:
             _STATE.index.setdefault(tid, []).append(event)
@@ -370,9 +376,85 @@ def trace_events(trace_id) -> list[dict]:
 
 
 def clear_flight_recorder() -> None:
+    # `appended` deliberately survives: it is the cursor space for
+    # `drain_spans`, and a cursor must never move backwards
     with _STATE.lock:
         _STATE.ring.clear()
         _STATE.index.clear()
+
+
+# -- cross-process span export (pod workers -> router) -----------------------
+
+
+def drain_spans(cursor: int, limit: int = 256) -> tuple[list[dict], int]:
+    """Ring events appended after `cursor` (a value previously returned
+    by this function; start at 0), NEWEST FIRST and bounded by `limit` —
+    the same shape as the pod's heartbeat metric snapshots: when a
+    burst overflows the bound, the newest spans survive. Only
+    request-scoped events (string trace ids — the W3C shape the wire
+    propagates) and link-carrying events (the shared decode step) are
+    exported; thread-local int-trace chatter stays home. Returns
+    ``(events, new_cursor)``; events are the live ring dicts — callers
+    serialize, they must not mutate."""
+    with _STATE.lock:
+        total = _STATE.appended
+        fresh = total - cursor
+        if fresh <= 0:
+            return [], total
+        events = list(_STATE.ring)[-min(fresh, len(_STATE.ring)):]
+    out = [e for e in reversed(events)
+           if isinstance(e.get("trace_id"), str) or e.get("links")]
+    return out[:limit], total
+
+
+def ingest_spans(events: list[dict], offset_s: float = 0.0,
+                 pid: int | None = None,
+                 worker: int | str | None = None) -> int:
+    """Append pre-formed span events exported by ANOTHER process into
+    this process's flight recorder, rebasing each `start_ns` by
+    `offset_s` (that process's clock -> ours; the router passes its
+    NTP-style per-worker estimate). Process-local int trace ids are
+    namespaced (`w<worker>:<id>`) so they cannot collide with ours;
+    string (request-scoped) trace ids merge verbatim — that is the
+    point. Malformed entries are skipped, never raised. Returns the
+    number ingested; 0 when tracing is disabled."""
+    if not _STATE.enabled or not events:
+        return 0
+    shift = int(offset_s * 1e9)
+    scope = f"w{worker}" if worker is not None else "remote"
+    n = 0
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        try:
+            tid = e.get("trace_id")
+            if not isinstance(tid, str):
+                tid = f"{scope}:{tid}"
+            ev = {
+                "name": str(e["name"]),
+                "trace_id": tid,
+                "span_id": int(e.get("span_id", 0)),
+                "parent_id": int(e.get("parent_id", 0)),
+                "thread": int(e.get("thread", 0)),
+                "start_ns": int(e["start_ns"]) + shift,
+                "dur_ns": max(0, int(e.get("dur_ns", 0))),
+            }
+        except (KeyError, TypeError, ValueError):
+            continue
+        attrs = e.get("attrs")
+        attrs = dict(attrs) if isinstance(attrs, dict) else {}
+        if worker is not None:
+            attrs.setdefault("worker", worker)
+        if attrs:
+            ev["attrs"] = attrs
+        links = e.get("links")
+        if isinstance(links, (list, tuple)) and links:
+            ev["links"] = list(links)
+        if pid is not None:
+            ev["pid"] = int(pid)
+        _append_event(ev)
+        n += 1
+    return n
 
 
 def export_chrome_trace(path: str | None = None, trace_id=None) -> dict:
@@ -380,7 +462,10 @@ def export_chrome_trace(path: str | None = None, trace_id=None) -> dict:
     (complete 'X' events; microsecond timestamps). Returns the document;
     writes it to `path` when given — load alongside a
     `profiler.profile()` capture to line host spans up with XLA device
-    slices. `trace_id` filters to one request's spans."""
+    slices. `trace_id` filters to one request's spans. Events ingested
+    from pod workers (`ingest_spans`) keep their origin pid, so a
+    cross-process request renders as one timeline with one row-group
+    per process."""
     source = flight_recorder() if trace_id is None else trace_events(trace_id)
     events = []
     for e in source:
@@ -398,7 +483,7 @@ def export_chrome_trace(path: str | None = None, trace_id=None) -> dict:
             "ph": "X",
             "ts": e["start_ns"] / 1e3,
             "dur": e["dur_ns"] / 1e3,
-            "pid": os.getpid(),
+            "pid": e.get("pid", os.getpid()),
             "tid": e["thread"],
             "args": args,
         }

@@ -196,7 +196,8 @@ def build_tiny_distributed_pod(family_name: str = "llama", pod_roles=(1, 1),
                                seed: int = 0, page_size: int = 16,
                                prefix_cache: bool = True, kv_dtype=None,
                                metrics_port: int | None = None,
-                               worker_wait_s: float = 180.0):
+                               worker_wait_s: float = 180.0,
+                               trace: bool = False):
     """The TRUE multi-host pod: `DistributedPodRouter` in this process,
     N+M real `pod-worker` OS processes dialing its listener over TCP.
     Same submit/step surface as the single engine, so `run_offered_load`
@@ -237,6 +238,14 @@ def build_tiny_distributed_pod(family_name: str = "llama", pod_roles=(1, 1),
         os.path.abspath(accelerate_tpu.__file__)))
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (pkg_root, env.get("PYTHONPATH", "")) if p)
+    if trace:
+        # distributed tracing A/B arm: workers record + export spans
+        # (env flag is read at their import), router samples every
+        # request so the ingest path is fully exercised
+        from accelerate_tpu.telemetry.trace import configure_tracing
+
+        env["ACCELERATE_TPU_TRACE"] = "1"
+        configure_tracing(enabled=True, default_sample_rate=1.0)
     roles = (["prefill"] * pod_roles[0] + ["decode"] * pod_roles[1])
     procs = spawn_socket_workers(listener.port, spec, roles, env=env,
                                  stderr=_sys.stderr)
@@ -756,6 +765,12 @@ def main() -> None:
     p.add_argument("--trace", default=None, metavar="FILE",
                    help="replay a recorded JSONL arrival trace through "
                         "the HTTP harness instead of generating arrivals")
+    p.add_argument("--pod-trace", action="store_true",
+                   help="with --pod-transport socket: re-run the same "
+                        "load with distributed tracing ON (100%% head "
+                        "sampling, worker span export over heartbeats) "
+                        "and report pod_trace_overhead_pct + span-export "
+                        "lag — prices the tracing path itself")
     args = p.parse_args()
 
     if args.speculative and args.pod_roles:
@@ -767,6 +782,10 @@ def main() -> None:
     if args.pod_transport == "socket" and args.pod_tp > 1:
         p.error("--pod-transport socket does not compose with --pod-tp "
                 "(each worker process owns its whole backend)")
+    if args.pod_trace and args.pod_transport != "socket":
+        p.error("--pod-trace requires --pod-transport socket (the span "
+                "export + clock alignment under test only exist across "
+                "a real process boundary)")
     if args.tenants or args.trace:
         specs, loads = parse_tenant_load_arg(args.tenants or "")
         engine, cfg = build_tiny_engine(
@@ -858,6 +877,47 @@ def main() -> None:
                     proc.kill()
     if args.pod_roles:
         summary["pod_transport"] = args.pod_transport
+    if args.pod_trace and pod_procs is not None:
+        # second arm: identical load, tracing ON. The baseline pod is
+        # already closed, so the two arms never share a port or a worker
+        engine2, _, procs2 = build_tiny_distributed_pod(
+            args.family, pod_roles=parse_pod_roles(args.pod_roles),
+            num_slots=args.slots, max_len=max_len,
+            prefill_chunk=args.prefill_chunk, seed=args.seed,
+            page_size=args.page_size,
+            prefix_cache=not args.no_prefix_cache,
+            kv_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
+            trace=True)
+        try:
+            traced = run_offered_load(
+                engine2, cfg.vocab_size, num_requests=args.num_requests,
+                rate_hz=args.rate_hz, prompt_len=tuple(args.prompt_len),
+                max_new_tokens=tuple(args.max_new_tokens),
+                temperature=args.temperature, deadline_s=args.deadline_s,
+                seed=args.seed, prefix_pool=args.prefix_pool,
+                prefix_len=args.prefix_len)
+        finally:
+            engine2.close()
+            for proc in procs2:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs2:
+                try:
+                    proc.wait(timeout=15)
+                except Exception:
+                    proc.kill()
+            from accelerate_tpu.telemetry.trace import configure_tracing
+
+            configure_tracing(enabled=False)
+        base_tps = summary.get("tokens_per_sec", 0.0)
+        traced_tps = traced.get("tokens_per_sec", 0.0)
+        summary["pod_traced_tokens_per_sec"] = traced_tps
+        if base_tps:
+            summary["pod_trace_overhead_pct"] = \
+                (1.0 - traced_tps / base_tps) * 100.0
+        summary["pod_spans_ingested"] = traced.get("pod_spans_ingested", 0.0)
+        if "pod_span_export_lag_s" in traced:
+            summary["pod_span_export_lag_s"] = traced["pod_span_export_lag_s"]
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "value": round(summary.get("tokens_per_sec", 0.0), 2),

@@ -13,7 +13,15 @@ that dial back over TCP. Proves, across genuine process boundaries:
 - phase 2 (recovery): SIGKILLing the decode worker's PROCESS mid-stream
   recovers every in-flight request by re-prefill-from-prompt on the
   survivor (soft roles: the prefill worker serves decode once the
-  decode pool is empty), byte-identical, nothing lost or duplicated.
+  decode pool is empty), byte-identical, nothing lost or duplicated;
+- tracing (ISSUE 18): the whole run samples every request
+  (`ACCELERATE_TPU_TRACE=1` inherited by the worker processes), so the
+  SIGKILL also proves the observability tentpole: the killed flight's
+  fleet incident bundle holds ONE merged chrome trace with spans from
+  BOTH worker processes rebased into router time and monotonically
+  ordered (prefill end <= shipment <= install), the replay span is
+  linked to the failed dispatch with recovery_reason=channel_drop, and
+  `accelerate-tpu incident show` renders the bundle.
 
 Prints POD_DIST_OK on success; any mismatch asserts (the parent test
 surfaces the child's output).
@@ -21,10 +29,17 @@ surfaces the child's output).
 
 import os
 import sys
+import tempfile
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("ACCELERATE_TPU_SANITIZE", "1")
+# tracing + incident capture on for THIS process and (via env
+# inheritance through spawn_socket_workers) every pod-worker process
+os.environ.setdefault("ACCELERATE_TPU_TRACE", "1")
+_INCIDENT_DIR = os.environ.setdefault(
+    "ACCELERATE_TPU_INCIDENT_DIR",
+    tempfile.mkdtemp(prefix="pod_incidents_"))
 
 import jax  # noqa: E402
 
@@ -52,6 +67,14 @@ from accelerate_tpu.serving.pod.distributed.worker import (  # noqa: E402
     build_worker_engine,
     engine_config_from_spec,
 )
+from accelerate_tpu.telemetry import (  # noqa: E402
+    configure_tracing,
+    trace_events,
+)
+
+# the env var enabled recording at import; head-sample 100% so every
+# plain submit below is a traced request
+configure_tracing(enabled=True, annotate=False, default_sample_rate=1.0)
 
 SPEC = {"family": "gpt2", "seed": 0, "num_slots": 3, "max_len": 64,
         "prefill_chunk": 8, "page_size": 8, "cache_dtype": "float32"}
@@ -86,14 +109,19 @@ def main() -> None:
     # the single-process reference: same spec -> same params bytes
     _family, _cfg, _params, ref_engine = build_worker_engine(SPEC)
     prompts, budgets, temps = traffic()
+    # phase 2 streams LONGER: the SIGKILL window needs a flight that is
+    # still mid-decode after both workers' spans have ridden a heartbeat
+    # into the router — with a warm compile cache an 8-token stream can
+    # finish before the first span-bearing heartbeat is even processed
+    budgets2 = [24, 24, 16, 16]
     # the trace runs TWICE (phase 1 exactness, phase 2 recovery) and
     # sampling keys fold in the request id, so the reference must burn
     # the same ids: batch one gets ids 1..4, batch two ids 5..8
     ref_batches = []
-    for _ in range(2):
+    for bs in (budgets, budgets2):
         ref_reqs = [ref_engine.submit(np.asarray(p, np.int32),
                                       max_new_tokens=b, temperature=t)
-                    for p, b, t in zip(prompts, budgets, temps)]
+                    for p, b, t in zip(prompts, bs, temps)]
         ref_engine.run_until_idle()
         ref_batches.append(([list(r.tokens) for r in ref_reqs],
                             [list(r.logprobs) for r in ref_reqs]))
@@ -146,15 +174,33 @@ def main() -> None:
 
         # phase 2: SIGKILL the decode worker process mid-stream
         reqs = [router.submit(p, max_new_tokens=b, temperature=t)
-                for p, b, t in zip(prompts, budgets, temps)]
+                for p, b, t in zip(prompts, budgets2, temps)]
         victim = next(w for w in router.workers.values()
                       if w.role == "decode")
+        # wait for a decode flight AND for both workers' spans of its
+        # trace (prefill from worker A, install from worker B) to ride a
+        # heartbeat into the router's recorder — the fleet bundle below
+        # must contain the whole cross-process timeline
         deadline = time.monotonic() + 120.0
-        while not any(f.phase == "decode" and f.worker == victim.worker_id
-                      for f in router._flights.values()):
+        candidates = {}
+        while not candidates:
             router.step()
-            assert time.monotonic() < deadline, "no decode flight landed"
+            # a candidate must still owe >= 2 tokens: a flight whose
+            # remaining tokens already sit in the router's socket buffer
+            # finishes instead of replaying
+            candidates = {
+                f.user.request_id: f.user.trace_id
+                for f in router._flights.values()
+                if f.phase == "decode" and f.worker == victim.worker_id
+                and len(f.user.tokens) <= f.user.max_new_tokens - 2
+                and {"serving.pod.prefill", "serving.pod.install"}
+                <= {e["name"] for e in trace_events(f.user.trace_id)}}
+            assert not all(r.done for r in reqs), \
+                "phase-2 batch drained before a traced kill window opened"
+            assert time.monotonic() < deadline, \
+                "no traced decode flight landed"
             time.sleep(0.002)
+        worker_pids = {w.pid for w in router.workers.values() if w.pid}
         procs[victim.worker_id].kill()
         drive(router, reqs)
         got = [list(r.tokens) for r in reqs]
@@ -172,6 +218,81 @@ def main() -> None:
         reasons = {e["recovery_reason"] for e in router.recovery_log}
         assert reasons <= {"channel_drop", "heartbeat_timeout"}, reasons
         print("PHASE2_RECOVERY_OK", flush=True)
+
+        # the observability tentpole, across real process boundaries:
+        # 1) the replay span lives in the killed request's own trace,
+        #    linked to the failed attempt's dispatch span
+        replayed = {e["request_id"] for e in router.recovery_log
+                    if e["recovery_reason"] == "channel_drop"}
+        hit = [tid for rid, tid in candidates.items() if rid in replayed]
+        assert hit, (candidates, list(router.recovery_log))
+        killed_tid = hit[0]
+        events = trace_events(killed_tid)
+        replays = [e for e in events if e["name"] == "serving.replay"]
+        assert replays, sorted({e["name"] for e in events})
+        dispatch_ids = {e["span_id"] for e in events
+                        if e["name"] == "serving.pod.dispatch"}
+        assert any(e["attrs"]["recovery_reason"] == "channel_drop"
+                   and set(e.get("links", ())) & dispatch_ids
+                   for e in replays), replays
+        # 2) the worker loss wrote ONE fleet bundle holding the killed
+        #    flight's merged chrome trace: spans from BOTH worker
+        #    processes rebased into router time, monotonically ordered
+        import json
+
+        bundles = sorted(d for d in os.listdir(_INCIDENT_DIR)
+                         if f"fleet-loss-w{victim.worker_id}" in d)
+        assert bundles, os.listdir(_INCIDENT_DIR)
+        bundle = os.path.join(_INCIDENT_DIR, bundles[-1])
+        with open(os.path.join(bundle, "flights_trace.json")) as f:
+            traces = json.load(f)
+        doc = traces.get(str(killed_tid))
+        assert doc, (sorted(traces), killed_tid)
+        tes = doc["traceEvents"]
+        pids = {e["pid"] for e in tes}
+        assert worker_pids <= pids, (worker_pids, pids)
+        end = {}
+        for e in tes:
+            end[e["name"]] = max(end.get(e["name"], float("-inf")),
+                                 e["ts"] + e["dur"])
+        with open(os.path.join(bundle, "clock_offsets.json")) as f:
+            offsets = json.load(f)
+        # clock-alignment error bound: the estimator is honest about its
+        # own precision (+-rtt/2 per worker, EWMA-lagged) — on a loaded
+        # single-core box "rtt" includes whole engine steps, so the
+        # bound must come from the measured rtt, not a localhost guess
+        tol_us = (0.1 + sum(w.get("rtt_s") or 0.0
+                            for w in offsets.values())) * 1e6
+        assert end["serving.pod.prefill"] \
+            <= end["serving.page_transfer"] + tol_us \
+            <= end["serving.pod.install"] + 2 * tol_us, (end, offsets)
+        assert offsets[str(victim.worker_id)]["lost"], offsets
+        with open(os.path.join(bundle,
+                               f"worker_{victim.worker_id}.json")) as f:
+            dead = json.load(f)
+        assert "worker_error" in dead, dead   # the honest hole
+        survivor = next(w for w in router.workers.values()
+                        if w.worker_id != victim.worker_id)
+        with open(os.path.join(bundle,
+                               f"worker_{survivor.worker_id}.json")) as f:
+            alive = json.load(f)
+        assert "jobs" in alive and "engine" in alive, sorted(alive)
+        # 3) the CLI renders the fleet view of that bundle
+        import contextlib
+        import io
+
+        from accelerate_tpu.commands.incident import _run_show
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = _run_show(_INCIDENT_DIR, os.path.basename(bundle),
+                           "text")
+        shown = buf.getvalue()
+        assert rc == 0, shown
+        assert "fleet clock offsets" in shown, shown
+        assert f"worker {victim.worker_id}: UNREACHABLE" in shown, shown
+        assert "in-flight traces" in shown, shown
+        print("PHASE2_TRACE_OK", flush=True)
     finally:
         router.close()
         for p in procs:

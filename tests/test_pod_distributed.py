@@ -571,9 +571,13 @@ def test_hung_worker_detected_by_heartbeat_timeout(gpt2_setup, ref_outputs):
     replay on the survivor, byte-exact."""
     cfg, params = gpt2_setup
     flaky = {}
+    # busy_heartbeat_timeout_s: the victim's last delivered heartbeat may
+    # announce busy=True (pre-compile), which legitimately defers the
+    # heartbeat verdict — bound that deferral so the fake clock reaches it
     router, _ = _build_pod(cfg, params, pf=1, dec=2,
                            wrap=_wrap_capture(flaky),
-                           heartbeat_timeout_s=1.0, flight_timeout_s=30.0)
+                           heartbeat_timeout_s=1.0, flight_timeout_s=30.0,
+                           busy_heartbeat_timeout_s=1.0)
     reqs = _submit_traffic(router, cfg)
     for _ in range(6):
         router.step()
@@ -735,6 +739,270 @@ def test_sanitizer_catches_corrupted_router_books(gpt2_setup):
 
 
 # ---------------------------------------------------------------------------
+# distributed tracing, clock alignment, fleet incident bundles (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _traced():
+    """Head-sample every request so plain submits are traced; clean the
+    global recorder afterwards (this module has no autouse tracing
+    reset)."""
+    from accelerate_tpu.telemetry import (clear_flight_recorder,
+                                          configure_tracing)
+
+    configure_tracing(enabled=True, annotate=False, default_sample_rate=1.0)
+    yield
+    configure_tracing(enabled=False, default_sample_rate=0.0)
+    clear_flight_recorder()
+
+
+def test_tracing_staleness_and_fleet_bundle_acceptance(
+        gpt2_setup, ref_outputs, _traced, tmp_path, capsys):
+    """The ISSUE-18 tentpole on one pod and one kill (tier-1 budget:
+    these contracts share the engines and the traffic drive):
+
+    1. propagation — every request's spans from router (dispatch,
+       page_transfer), prefill worker (pod.prefill) and decode worker
+       (pod.install) land in ONE trace, monotonically ordered, and
+       tracing changes no tokens;
+    2. replay forensics — the killed flights record `serving.replay`
+       linked to the failed attempt's dispatch span, tagged
+       recovery_reason=channel_drop;
+    3. staleness-honest /metrics — the lost worker's frozen snapshot
+       merges under stale="true", its snapshot-age gauge keeps
+       counting, and a configured horizon drops it entirely;
+    4. fleet incident bundle — worker loss writes ONE bundle (router
+       dumps, per-worker stanzas with an honest worker_error hole for
+       the dead one, clock offsets, merged chrome traces of in-flight
+       requests) and `accelerate-tpu incident show` renders it.
+    """
+    import json as _json
+
+    from accelerate_tpu.commands.incident import _run_show
+    from accelerate_tpu.telemetry import trace_events
+
+    cfg, params = gpt2_setup
+    flaky = {}
+    router, _ = build_local_distributed_pod(
+        gpt2, cfg, params,
+        engine_config=_ec(incident_dir=str(tmp_path)),
+        pod_config=DistributedPodConfig(
+            prefill_workers=1, decode_workers=2, rebalance=False,
+            heartbeat_interval_s=0.0, fleet_bundle_min_interval_s=0.0),
+        # REAL clock: worker spans are rebased by the NTP offset estimate,
+        # and a +0.01/call fake clock ticks hundreds of times between a
+        # heartbeat's stamping and its ingestion — the bogus offset would
+        # shove rebased spans seconds out of timeline order
+        channel_wrap=_wrap_capture(flaky))
+    reqs = _submit_traffic(router, cfg)
+    for _ in range(6):
+        router.step()
+    victims = {f.worker for f in router._flights.values()
+               if f.phase == "decode"}
+    assert victims, "no decode flight landed in 6 steps"
+    victim = victims.pop()
+    flaky[victim].kill()
+    _drive(router, reqs)
+    assert [list(r.tokens) for r in reqs] == ref_outputs[0]
+    assert router.workers[victim].lost
+
+    # 1. propagation: one ordered timeline per request, across roles
+    for r in reqs:
+        assert r.trace_sampled and isinstance(r.trace_id, str)
+        by_name = {}
+        for e in trace_events(r.trace_id):
+            by_name.setdefault(e["name"], []).append(e)
+        for name in ("serving.pod.dispatch", "serving.pod.prefill",
+                     "serving.page_transfer", "serving.pod.install"):
+            assert name in by_name, (r.trace_id, sorted(by_name))
+        # the acceptance ordering: prefill end <= shipment arrival <=
+        # install end, PER ATTEMPT — a replay whose re-prefill already
+        # yields the final token finishes at shipment and never grows a
+        # transfer/install leg, so attempts can't be compared to each
+        # other's legs
+        legs = ("serving.pod.prefill", "serving.page_transfer",
+                "serving.pod.install")
+        ends: dict = {}
+        for name in legs:
+            for e in by_name[name]:
+                a = e["attrs"]["attempt"]
+                by = ends.setdefault(a, {})
+                by[name] = max(by.get(name, 0),
+                               e["start_ns"] + e["dur_ns"])
+        full = [by for by in ends.values() if len(by) == len(legs)]
+        assert full, ends
+        for by in full:
+            assert by[legs[0]] <= by[legs[1]] <= by[legs[2]], ends
+        # worker-side spans carry the worker attribute for the fleet view
+        assert all("worker" in e.get("attrs", {})
+                   for e in by_name["serving.pod.install"])
+
+    # 2. replay forensics: linked to the failed dispatch, reason tagged
+    replayed = [e["request_id"] for e in router.recovery_log
+                if e["recovery_reason"] == "channel_drop"]
+    assert replayed
+    checked = 0
+    for r in reqs:
+        if r.request_id not in replayed:
+            continue
+        events = trace_events(r.trace_id)
+        replays = [e for e in events if e["name"] == "serving.replay"]
+        assert replays, [e["name"] for e in events]
+        dispatch_ids = {e["span_id"] for e in events
+                        if e["name"] == "serving.pod.dispatch"}
+        for ev in replays:
+            assert ev["attrs"]["recovery_reason"] == "channel_drop"
+            assert ev.get("links"), "replay span lost its link"
+            assert set(ev["links"]) & dispatch_ids, \
+                "replay link does not point at a dispatch span"
+        checked += 1
+    assert checked
+
+    # 3. staleness-honest scrape: kill-then-scrape
+    rows = [(name, dict(labels))
+            for _k, name, labels, _m in router.exposition_registry().items()]
+    age_workers = {l["worker"] for n, l in rows
+                   if n == "serving_pod_worker_snapshot_age_seconds"}
+    assert str(victim) in age_workers and len(age_workers) >= 2
+    assert any(l.get("stale") == "true" for _n, l in rows), \
+        "lost worker's series lost their stale label"
+    assert any(l.get("origin") == "workers" and l.get("stale") is None
+               for _n, l in rows), "survivors' series vanished"
+    # past the horizon the dead worker's numbers drop entirely
+    import dataclasses as _dc
+
+    router.pod_config = _dc.replace(router.pod_config,
+                                    snapshot_stale_after_s=0.0)
+    rows2 = [(name, dict(labels))
+             for _k, name, labels, _m in router.exposition_registry().items()]
+    assert not any(l.get("stale") == "true" for _n, l in rows2)
+    assert any(l.get("origin") == "workers" for _n, l in rows2)
+
+    # 4. the fleet bundle + its CLI rendering
+    bundles = [p for p in tmp_path.iterdir()
+               if p.name.startswith("incident-")]
+    fleet = [p for p in bundles if f"fleet-loss-w{victim}" in p.name]
+    assert fleet, [p.name for p in bundles]
+    bundle = fleet[0]
+    report = _json.loads((bundle / "report.json").read_text())
+    assert report["kind"] == "fleet_incident"
+    assert report["reason"] == "channel_drop"
+    offsets = _json.loads((bundle / "clock_offsets.json").read_text())
+    assert str(victim) in offsets and offsets[str(victim)]["lost"]
+    dead = _json.loads((bundle / f"worker_{victim}.json").read_text())
+    assert "worker_error" in dead        # the honest hole
+    survivors = [p for p in bundle.glob("worker_*.json")
+                 if p.name != f"worker_{victim}.json"]
+    assert survivors
+    alive = _json.loads(survivors[0].read_text())
+    assert "jobs" in alive and "engine" in alive
+    traces = _json.loads((bundle / "flights_trace.json").read_text())
+    assert traces, "no in-flight trace captured at loss time"
+    assert any((doc.get("traceEvents") or []) for doc in traces.values())
+    rc = _run_show(str(tmp_path), bundle.name, "text")
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet clock offsets" in out
+    assert f"worker {victim}: UNREACHABLE" in out
+    assert "in-flight traces" in out
+    router.close()
+
+
+def test_clock_sync_span_ingest_and_busy_deferral(gpt2_setup, _traced):
+    """The heartbeat-side mechanics on one idle pod (no traffic — these
+    poke the router's handlers directly):
+
+    - NTP clock estimate: one-way fallback on first contact, round-trip
+      correction with EWMA smoothing, negative rtt discarded, one-way
+      samples never regress a round-trip estimate, per-worker gauge;
+    - span ingest: a heartbeat's batch lands rebased into router time
+      exactly once (same `span_seq` = duplicated heartbeat = no-op);
+    - busy deferral (the phantom-loss fix): an announced long block
+      gets busy_heartbeat_timeout_s of silence, a quiet non-busy
+      worker is lost at the tight timeout, and busy is a rope, not
+      immortality.
+    """
+    from accelerate_tpu.telemetry import trace_events
+
+    cfg, params = gpt2_setup
+    now = [0.0]
+    router, _ = build_local_distributed_pod(
+        gpt2, cfg, params, engine_config=_ec(),
+        pod_config=DistributedPodConfig(
+            prefill_workers=1, decode_workers=1, rebalance=False,
+            heartbeat_interval_s=1e9, heartbeat_timeout_s=0.5,
+            busy_heartbeat_timeout_s=5.0),
+        clock=lambda: now[0])
+    handle = next(iter(router.workers.values()))
+
+    # -- NTP estimate -------------------------------------------------------
+    # in-process handles short-circuit to offset 0 (shared clock) — mask
+    # `local` so the estimator treats this handle as a remote worker
+    handle.local = None
+    handle.clock_offset_s = handle.clock_rtt_s = None
+    # first contact: no echo yet -> one-way T4 - T3
+    router._sync_worker_clock(handle, {"t": 95.0}, 100.0)
+    assert handle.clock_offset_s == pytest.approx(5.0)
+    assert handle.clock_rtt_s is None
+    # completed round trip: T1=100.5 T2=95.6 T3=96.0 T4=101.0
+    router._sync_worker_clock(
+        handle, {"t": 96.0, "ack": {"router_t": 100.5,
+                                    "worker_recv_t": 95.6}}, 101.0)
+    assert handle.clock_rtt_s == pytest.approx(0.1)
+    # sample ((100.5-95.6)+(101-96))/2 = 4.95, EWMA 0.75*5 + 0.25*4.95
+    assert handle.clock_offset_s == pytest.approx(4.9875)
+    # a clock stepped mid-round (rtt < 0): the sample is discarded
+    router._sync_worker_clock(
+        handle, {"t": 200.0, "ack": {"router_t": 100.9,
+                                     "worker_recv_t": 95.9}}, 101.0)
+    assert handle.clock_offset_s == pytest.approx(4.9875)
+    # a later echo-less heartbeat must not regress to the one-way guess
+    router._sync_worker_clock(handle, {"t": 90.0}, 102.0)
+    assert handle.clock_offset_s == pytest.approx(4.9875)
+    gauges = {labels: m.value
+              for kind, name, labels, m in router.registry.items()
+              if name == "serving_pod_worker_clock_offset_seconds"}
+    assert gauges[(("worker", str(handle.worker_id)),)] \
+        == pytest.approx(4.9875)
+
+    # -- span ingest + dedup ------------------------------------------------
+    handle.clock_offset_s = 2.0
+    before = router._c_spans.value
+    ev = {"name": "w-side", "trace_id": "req-dedup",
+          "start_ns": 1_000, "dur_ns": 5}
+    router._ingest_worker_spans(handle, {"spans": [ev], "span_seq": 5}, 1.0)
+    got = trace_events("req-dedup")
+    assert len(got) == 1
+    assert got[0]["start_ns"] == 1_000 + int(2.0 * 1e9)   # rebased
+    # the duplicated heartbeat: same high-water mark, no double ingest
+    router._ingest_worker_spans(handle, {"spans": [ev], "span_seq": 5}, 2.0)
+    assert len(trace_events("req-dedup")) == 1
+    # a genuinely new batch advances
+    router._ingest_worker_spans(
+        handle, {"spans": [dict(ev, span_id=9)], "span_seq": 6}, 3.0)
+    assert len(trace_events("req-dedup")) == 2
+    assert router._c_spans.value == before + 2
+
+    # -- busy deferral of heartbeat_timeout ---------------------------------
+    for h in router.workers.values():      # registered, not yet stepped:
+        h.alive, h.last_heartbeat, h.busy = True, 0.0, True
+    handle, other = list(router.workers.values())[:2]
+    now[0] = 2.0                       # 4x the plain timeout, but busy
+    router._detect_failures()
+    assert not handle.lost and not other.lost, \
+        "busy-not-dead became a phantom loss"
+    handle.busy = False                # same silence, no busy announce
+    router._detect_failures()
+    assert handle.lost and not other.lost
+    # and busy is a rope, not immortality
+    now[0] = 6.0
+    router._detect_failures()
+    assert other.lost
+    router.close()
+
+
+# ---------------------------------------------------------------------------
 # the two-OS-process socket smoke (the acceptance harness)
 # ---------------------------------------------------------------------------
 
@@ -752,4 +1020,5 @@ def test_socket_pod_two_process_smoke():
         [sys.executable, script], env={"JAX_PLATFORMS": "cpu"}, timeout=420)
     assert "PHASE1_EXACT_OK" in out
     assert "PHASE2_RECOVERY_OK" in out
+    assert "PHASE2_TRACE_OK" in out
     assert "POD_DIST_OK" in out

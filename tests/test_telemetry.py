@@ -24,15 +24,20 @@ from accelerate_tpu.telemetry import (
     aggregate_snapshot,
     clear_flight_recorder,
     configure_tracing,
+    drain_spans,
     export_chrome_trace,
     flatten_snapshot,
     flight_recorder,
     get_registry,
+    ingest_spans,
+    record_span,
     render_prometheus,
     resolve_metrics_port,
     span,
+    trace_events,
     tracing_enabled,
 )
+from accelerate_tpu.telemetry.aggregate import merged_registry
 from accelerate_tpu.telemetry.watchdog import StallError
 
 
@@ -435,6 +440,155 @@ class TestAggregation:
         assert flat["t/hbm_peak__max"] == 2.0
         assert flat["t/step_time_s__slowest_host_mean"] == pytest.approx(0.2, rel=0.02)
         assert all(isinstance(v, float) for v in flat.values())
+
+
+# ---------------------------------------------------------------------------
+# transport-backed merge: pod heartbeat snapshots -> one registry (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestMergedRegistry:
+    def test_same_series_two_workers_sum_under_one_origin(self):
+        """Two workers exposing the same series name must SUM into a
+        single labeled series, not collide or shadow each other."""
+        a = MetricsRegistry()
+        a.counter("pod_tokens_total", role="decode").inc(3)
+        b = MetricsRegistry()
+        b.counter("pod_tokens_total", role="decode").inc(4)
+        b.gauge("pod_active_pages").set(9)
+        reg = merged_registry([a.snapshot(include_sketch=True),
+                               b.snapshot(include_sketch=True)],
+                              origin="workers")
+        snap = reg.snapshot()
+        (key,) = snap["counters"]
+        assert 'origin="workers"' in key and 'role="decode"' in key
+        assert snap["counters"][key] == 7.0
+        # gauges expand to the min/mean/max family, still origin-tagged
+        assert any(k.startswith("pod_active_pages__max{")
+                   and 'origin="workers"' in k for k in snap["gauges"])
+
+    def test_exemplar_histograms_merge_across_origins(self):
+        """Exemplar-carrying histograms from different origins merge as
+        distinct series (no cross-origin collision) and still render."""
+        a = MetricsRegistry()
+        ha = a.histogram("pod_latency_s")
+        ha.record(0.1, exemplar="trace-a")
+        ha.record(0.2, exemplar="trace-a2")
+        b = MetricsRegistry()
+        hb = b.histogram("pod_latency_s")
+        hb.record(0.4, exemplar="trace-b")
+        reg = MetricsRegistry()
+        merged_registry([a.snapshot(include_sketch=True)],
+                        registry=reg, origin="workers")
+        merged_registry([b.snapshot(include_sketch=True)],
+                        registry=reg, origin="workers", stale="true")
+        snap = reg.snapshot()
+        hists = snap["histograms"]
+        assert len(hists) == 2          # one series per label set
+        assert sorted(e["count"] for e in hists.values()) == [1.0, 2.0]
+        stale_key = next(k for k in hists if 'stale="true"' in k)
+        assert hists[stale_key]["sum"] == pytest.approx(0.4)
+        # merged output renders cleanly for the scrape endpoint
+        assert "pod_latency_s" in render_prometheus(reg)
+
+    def test_newer_schema_unknown_keys_are_ignored_not_fatal(self):
+        """A snapshot from a NEWER worker build (extra sections, extra
+        histogram keys, exotic sketch encoding) merges best-effort: the
+        series we understand survive, the rest are skipped."""
+        newer = {
+            "counters": {"tokens_total": 5.0},
+            "gauges": {"hbm_peak": 2.0},
+            "histograms": {
+                "step_time_s": {"count": 2.0, "sum": 0.4,
+                                "future_stat": "x",
+                                "sketch": {"v2_encoding": True}},
+            },
+            "spans_v2": [{"opaque": 1}],      # unknown section
+        }
+        older = _host_snapshot([0.1, 0.3], tokens=7, hbm=1.0)
+        reg = merged_registry([newer, older], origin="workers")
+        snap = reg.snapshot()
+        (ckey,) = snap["counters"]
+        assert snap["counters"][ckey] == 12.0
+        # the foreign sketch is dropped but the host's scalar stats and
+        # the older host's real sketch still produce a distribution
+        (hkey,) = snap["histograms"]
+        assert snap["histograms"][hkey]["count"] == 2.0
+
+    def test_older_schema_and_garbage_sections_tolerated(self):
+        """Missing sections, non-dict sections, non-numeric values, and
+        histogram entries that aren't dicts must not crash the merge."""
+        garbage = [
+            {},                                     # empty snapshot
+            {"counters": "not-a-dict"},             # wrong section type
+            {"counters": {"tokens_total": "NaNish"},
+             "gauges": {"hbm_peak": None},
+             "histograms": {"step_time_s": 3.14}},  # entry not a dict
+            {"counters": {"tokens_total": 2.0}},    # old build: no hists
+        ]
+        reg = merged_registry(garbage, origin="workers")
+        snap = reg.snapshot()
+        (ckey,) = snap["counters"]
+        assert snap["counters"][ckey] == 2.0
+        agg = aggregate_snapshot(snapshots=garbage)
+        assert agg["num_hosts"] == 4
+        assert agg["counters"][next(iter(agg["counters"]))]["sum"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# cross-process span export: drain -> wire -> ingest (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanExport:
+    def test_drain_cursor_monotone_newest_first_and_filtered(self):
+        configure_tracing(enabled=True, annotate=False)
+        record_span("local-chatter", 0.0, 0.1, trace=12345)   # int id: home
+        record_span("req-a", 0.0, 0.2, trace="req-a")
+        record_span("req-b", 0.3, 0.4, trace="req-b")
+        events, cur = drain_spans(0)
+        assert [e["name"] for e in events] == ["req-b", "req-a"]  # newest 1st
+        # nothing new: cursor is stable and returns empty
+        again, cur2 = drain_spans(cur)
+        assert again == [] and cur2 == cur
+        record_span("req-c", 0.5, 0.6, trace="req-c")
+        events, cur3 = drain_spans(cur)
+        assert [e["name"] for e in events] == ["req-c"] and cur3 > cur
+        # the cursor space survives a ring clear: it never moves back
+        clear_flight_recorder()
+        empty, cur4 = drain_spans(cur3)
+        assert empty == [] and cur4 == cur3
+
+    def test_drain_keeps_link_carrying_int_trace_events(self):
+        configure_tracing(enabled=True, annotate=False)
+        record_span("shared-step", 0.0, 0.1, trace=99, links=[7, 8])
+        events, _ = drain_spans(0)
+        assert [e["name"] for e in events] == ["shared-step"]
+
+    def test_ingest_rebases_namespaces_and_skips_malformed(self):
+        configure_tracing(enabled=True, annotate=False)
+        events = [
+            {"name": "w-span", "trace_id": 7, "span_id": 3, "parent_id": 0,
+             "start_ns": 1_000, "dur_ns": 10},
+            "garbage",                       # not a dict: skipped
+            {"name": "half"},                # missing start_ns: skipped
+        ]
+        n = ingest_spans(events, offset_s=5.0, pid=4242, worker=2)
+        assert n == 1
+        (ev,) = trace_events("w2:7")         # int id namespaced per worker
+        assert ev["start_ns"] == 1_000 + int(5.0 * 1e9)   # rebased
+        assert ev["attrs"]["worker"] == 2 and ev["pid"] == 4242
+        # string (request-scoped) trace ids merge verbatim with ours
+        record_span("router-side", 10.0, 10.1, trace="req-x")
+        ingest_spans([{"name": "worker-side", "trace_id": "req-x",
+                       "start_ns": int(9.9e9), "dur_ns": 50}],
+                     offset_s=0.25, worker=1)
+        names = {e["name"] for e in trace_events("req-x")}
+        assert names == {"router-side", "worker-side"}
+
+    def test_ingest_is_a_noop_when_tracing_disabled(self):
+        assert ingest_spans([{"name": "x", "trace_id": "t",
+                              "start_ns": 0}], offset_s=0.0) == 0
 
 
 # ---------------------------------------------------------------------------
