@@ -34,7 +34,14 @@ from .lifecycle import (  # noqa: F401
     REQUEST_FSM,
     RequestFSM,
     ResourcePair,
+    THREAD_ENTRIES,
+    ThreadEntries,
     lint_lifecycle,
+)
+from .concurrency import (  # noqa: F401
+    BLOCKING_CALLS,
+    BlockingCall,
+    lint_concurrency,
 )
 from .program import (  # noqa: F401
     CANONICAL_COLLECTIVES,
@@ -79,7 +86,12 @@ __all__ = [
     "REQUEST_FSM",
     "RequestFSM",
     "ResourcePair",
+    "THREAD_ENTRIES",
+    "ThreadEntries",
+    "BLOCKING_CALLS",
+    "BlockingCall",
     "lint_lifecycle",
+    "lint_concurrency",
     "lint_source",
     "lint_text",
     "lint_file",

@@ -1,0 +1,761 @@
+"""ATP3xx concurrency passes: shared-state locksets, lock-order cycles,
+blocking calls on the event loop, condition-variable protocol, and
+thread shutdown discipline.
+
+PR 17/18 made the pod a real multi-threaded system — reader/writer
+threads per socket channel, the host-tier drain thread, watchdog and
+exporter threads, and the server's asyncio drive loop — and every one of
+those surfaces grows the same four bug classes that single-threaded
+lifecycle analysis (ATP2xx) cannot see. These passes encode them
+declaratively, riding the exact pipeline the other rules use
+(suppressions, baselines, the CLI, the tier-1 self-lint gate):
+
+- **ATP301 — shared state without a common lock.** Per class, every
+  concurrent context is discovered from the `THREAD_ENTRIES` table
+  (``Thread(target=...)``, ``Timer``, ``StallWatchdog`` callbacks, and
+  asyncio ``create_task``/``ensure_future`` entries) and closed over
+  same-class calls. An attribute written from two or more contexts —
+  at least one a real thread — whose write sites share NO common
+  ``with <...lock...>:`` guard is a data race. Subscript stores
+  (``self._books[k] = v``) count: the router-book-vs-heartbeat race is
+  exactly this shape. ATP221 already owns the narrow
+  one-thread-vs-drive unlocked-plain-assign case, so that shape is left
+  to it (no double report).
+- **ATP302 — static lock-order cycles.** Nested ``with`` lock scopes
+  contribute edges to a module-wide acquisition graph; calls made while
+  a lock is held contribute edges to every lock the callee acquires
+  (transitively, through the module-local call graph — ``self.m()``
+  resolves within the class, bare names to module functions). A cycle
+  in the graph is a deadlock two threads can reach by running the two
+  orderings concurrently. The runtime twin is
+  :mod:`accelerate_tpu.telemetry.lockwatch`, which catches orderings
+  the static pass cannot resolve (locks reached through attributes of
+  other objects).
+- **ATP303 — blocking calls reachable from async defs.** The
+  `BLOCKING_CALLS` table names the calls that wedge an event loop:
+  ``time.sleep``, ``.get()``/``.join()``/``.wait()``/``.acquire()``/
+  ``.result()`` with no timeout, blocking socket ops, and device syncs
+  (``block_until_ready``, ``.item()``). Flagged in async functions AND
+  in sync functions reachable from one through module-local calls —
+  awaited expressions and ``asyncio.*`` are exempt, and a callable
+  merely *referenced* (``run_in_executor(None, self._pump)``) is not a
+  call, so executor offload is clean by construction.
+- **ATP304 — condition-variable misuse.** ``cv.wait()`` outside a
+  ``while`` predicate loop (lost-wakeup / spurious-wakeup bug) and
+  ``cv.notify()``/``notify_all()`` outside ``with cv:`` (runtime error
+  at best, missed signal at worst). Condition objects are discovered
+  from ``threading.Condition(...)`` assignments.
+- **ATP305 — thread shutdown discipline.** A thread/watchdog stored on
+  ``self`` and ``.start()``-ed must have a ``.join()``/``.stop()``/
+  ``.cancel()`` on that attribute reachable from one of the owner's
+  closing methods (``close``/``shutdown``/``stop``/``drain``/...).
+  Daemon threads do NOT exempt: a daemon still races interpreter
+  teardown and still holds sockets/files (the leaked-thread class
+  PR 4/6 reviews kept hitting by hand).
+
+All passes are pure AST (no imports executed) and path-insensitive at
+the class/module granularity described above; locks are identified by
+their attribute chain (``self._lock`` in class ``C`` -> ``C._lock``), so
+two instances of one class share a lock *class* the way runtime lockdep
+treats lock classes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .findings import Finding
+from .lifecycle import (THREAD_ENTRIES, ThreadEntries, _attr_chain,
+                        _functions_with_owners, _outer_walk, _FN_NODES)
+
+__all__ = [
+    "BlockingCall",
+    "BLOCKING_CALLS",
+    "lint_concurrency",
+]
+
+
+# ---------------------------------------------------------------------------
+# declarative tables
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingCall:
+    """One event-loop-wedging call shape for ATP303. ``method`` is the
+    attribute-chain tail; ``receivers`` (when non-empty) constrains the
+    chain segment before it (``time.sleep``); ``max_args`` bounds the
+    POSITIONAL arg count (``.get()`` with zero args is a queue get,
+    ``cfg.get(key)`` is not); ``timeout_exempts`` accepts a
+    ``timeout=``/``block=`` keyword as proof of boundedness."""
+
+    method: str
+    reason: str
+    receivers: tuple = ()
+    max_args: int = 99
+    timeout_exempts: bool = False
+
+
+BLOCKING_CALLS: tuple = (
+    BlockingCall("sleep", "time.sleep parks the whole event loop; use "
+                 "asyncio.sleep", receivers=("time",)),
+    BlockingCall("get", "queue get with no timeout blocks the loop until "
+                 "a producer shows up", max_args=0, timeout_exempts=True),
+    BlockingCall("join", "thread join with no timeout can block forever",
+                 max_args=0, timeout_exempts=True),
+    BlockingCall("wait", "event/condition wait with no timeout blocks "
+                 "the loop", max_args=0, timeout_exempts=True),
+    BlockingCall("acquire", "lock acquire with no timeout blocks the "
+                 "loop", max_args=0, timeout_exempts=True),
+    BlockingCall("result", "future result with no timeout blocks the "
+                 "loop", max_args=0, timeout_exempts=True),
+    BlockingCall("recv", "blocking socket receive"),
+    BlockingCall("recvfrom", "blocking socket receive"),
+    BlockingCall("accept", "blocking socket accept", max_args=0),
+    BlockingCall("block_until_ready", "device sync stalls the loop for "
+                 "the full step latency"),
+    BlockingCall("item", "forces a device->host sync", max_args=0),
+)
+
+
+_LOCKISH = ("lock", "mutex")
+
+# a call appearing as an ARGUMENT to one of these is scheduled, offloaded
+# or bounded — not executed inline on the loop (`create_task(ev.wait())`,
+# `wait_for(q.get(), timeout)`, `run_in_executor(None, fn)`)
+_SCHEDULING_CALLS = ("create_task", "ensure_future", "wait_for", "gather",
+                     "shield", "run_in_executor", "to_thread",
+                     "run_coroutine_threadsafe")
+
+# owner methods that count as the shutdown path for ATP305
+_CLOSER_NAMES = ("close", "shutdown", "stop", "drain", "join",
+                 "terminate", "finalize", "__exit__", "__del__")
+# calls on a thread attribute that discharge the shutdown obligation
+_DISCHARGE = ("join", "cancel", "stop")
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+
+def _emit(findings: list, lines: list, path: str, rule: str, line: int,
+          message: str, data: dict) -> None:
+    src = lines[line - 1].strip() if 0 < line <= len(lines) else ""
+    findings.append(Finding(rule=rule, message=message, path=path,
+                            line=line, source=src, data=data))
+
+
+def _class_functions(cls: ast.ClassDef) -> dict:
+    """name -> [def nodes] for every function in the class (nested defs
+    included under their own names; nested classes excluded)."""
+    fns: dict = {}
+
+    def collect(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(child.name, []).append(child)
+                collect(child)
+            elif not isinstance(child, ast.ClassDef):
+                collect(child)
+
+    collect(cls)
+    return fns
+
+
+def _closure(fns: dict, seeds: set) -> set:
+    """Same-class reachability over calls OR bare references (the
+    ``dumps=self.build`` indirection counts) — the ATP221 closure."""
+    reach = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for name in list(reach):
+            for fn in fns.get(name, []):
+                for node in ast.walk(fn):
+                    ref = None
+                    if isinstance(node, ast.Attribute) \
+                            and isinstance(node.value, ast.Name) \
+                            and node.value.id == "self":
+                        ref = node.attr
+                    elif isinstance(node, ast.Name):
+                        ref = node.id
+                    if ref in fns and ref not in reach:
+                        reach.add(ref)
+                        changed = True
+    return reach
+
+
+def _lock_chain_name(expr: ast.AST, cls_name: str | None,
+                     cv_names: frozenset) -> str | None:
+    """The lock identity a `with` item acquires, or None when the item
+    is not lock-like. `self.` chains are qualified with the class name
+    (lock *classes*, not instances)."""
+    chain = _attr_chain(expr)
+    if not chain:
+        return None
+    if chain[0] == "self":
+        name = ".".join(chain[1:])
+        qual = f"{cls_name}.{name}" if cls_name else name
+    else:
+        qual = ".".join(chain)
+    last = chain[-1].lower()
+    if any(t in last for t in _LOCKISH) or qual in cv_names:
+        return qual
+    return None
+
+
+def _lock_ranges(fn: ast.AST, cls_name: str | None,
+                 cv_names: frozenset) -> list:
+    """[(start_line, end_line, lock_name)] for every lock-like `with`
+    scope directly in `fn` (nested defs excluded)."""
+    out = []
+    for node in _outer_walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                name = _lock_chain_name(item.context_expr, cls_name,
+                                        cv_names)
+                if name:
+                    out.append((node.lineno,
+                                getattr(node, "end_lineno", node.lineno),
+                                name))
+    return out
+
+
+def _condition_names(tree: ast.Module) -> frozenset:
+    """Qualified names of `threading.Condition(...)` objects: `self._cv`
+    assigned in class C -> "C._cv"; bare/module-level -> the chain."""
+    out: set = set()
+
+    def record(target: ast.AST, cls_name: str | None) -> None:
+        chain = _attr_chain(target)
+        if not chain:
+            return
+        if chain[0] == "self":
+            name = ".".join(chain[1:])
+            out.add(f"{cls_name}.{name}" if cls_name else name)
+        else:
+            out.add(".".join(chain))
+
+    def walk(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+                continue
+            if isinstance(child, ast.Assign) \
+                    and isinstance(child.value, ast.Call):
+                chain = _attr_chain(child.value.func)
+                if chain and chain[-1] == "Condition":
+                    for t in child.targets:
+                        record(t, cls_name)
+            walk(child, cls_name)
+
+    walk(tree, None)
+    return frozenset(out)
+
+
+# ---------------------------------------------------------------------------
+# ATP301: shared-state writes without a common lock
+# ---------------------------------------------------------------------------
+
+
+def _entry_targets(cls: ast.ClassDef, entries: ThreadEntries) -> dict:
+    """{fn_name: "thread" | "task"} for every concurrent entry the class
+    registers — `Thread(target=self._pump)` keyword style, and
+    `create_task(self._drive())` positional style."""
+    out: dict = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _attr_chain(node.func)
+        if not chain:
+            continue
+        if chain[-1] in entries.constructors:
+            for kw in node.keywords:
+                if kw.arg in entries.kwargs:
+                    vchain = _attr_chain(kw.value)
+                    if vchain:
+                        out.setdefault(vchain[-1], "thread")
+        elif chain[-1] in entries.task_constructors and node.args:
+            arg = node.args[0]
+            tgt = arg.func if isinstance(arg, ast.Call) else arg
+            vchain = _attr_chain(tgt)
+            if vchain:
+                out.setdefault(vchain[-1], "task")
+    return out
+
+
+def _self_writes(fn: ast.AST, cls_name: str | None,
+                 cv_names: frozenset) -> list:
+    """[(attr, line, lockset, form)] for `self.attr = ...` ("attr") and
+    `self.attr[k] = ...` ("item") stores directly in fn. The lockset is
+    the set of lock names whose `with` scope encloses the line."""
+    ranges = _lock_ranges(fn, cls_name, cv_names)
+    out = []
+
+    def lockset(line: int) -> frozenset:
+        return frozenset(n for a, b, n in ranges if a <= line <= b)
+
+    for node in _outer_walk(fn):
+        targets: list = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out.append((t.attr, node.lineno,
+                            lockset(node.lineno), "attr"))
+            elif isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Attribute) \
+                    and isinstance(t.value.value, ast.Name) \
+                    and t.value.value.id == "self":
+                out.append((t.value.attr, node.lineno,
+                            lockset(node.lineno), "item"))
+    return out
+
+
+def _lint_shared_state(tree: ast.Module, path: str, lines: list,
+                       findings: list, entries: ThreadEntries,
+                       cv_names: frozenset) -> None:
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        fns = _class_functions(cls)
+        if not fns:
+            continue
+        entry_kinds = {n: k for n, k in _entry_targets(cls, entries).items()
+                       if n in fns}
+        if "thread" not in entry_kinds.values():
+            continue        # a racy pair needs at least one real thread
+        # context membership: each entry's same-class closure; everything
+        # else (minus happens-before __init__) is the drive context
+        ctx_of: dict = {}           # fn_name -> set[(kind, ctx_name)]
+        for name, kind in entry_kinds.items():
+            for r in _closure(fns, {name}):
+                ctx_of.setdefault(r, set()).add((kind, name))
+        writes: dict = {}           # attr -> [(kind, ctx, line, lockset, form)]
+        for name, defs in fns.items():
+            if name in ("__init__", "__post_init__"):
+                continue
+            contexts = ctx_of.get(name, {("drive", "drive")})
+            for fn in defs:
+                for attr, line, lockset, form in _self_writes(
+                        fn, cls.name, cv_names):
+                    for kind, ctx in contexts:
+                        writes.setdefault(attr, []).append(
+                            (kind, ctx, line, lockset, form))
+        for attr, sites in sorted(writes.items()):
+            ctxs = sorted({(kind, ctx) for kind, ctx, *_ in sites})
+            if len(ctxs) < 2:
+                continue
+            kinds = {k for k, _ in ctxs}
+            if "thread" not in kinds:
+                continue    # task-vs-drive interleaves at awaits only
+            common = None
+            for _, _, _, lockset, _ in sites:
+                common = lockset if common is None else common & lockset
+            if common:
+                continue    # every write holds one shared lock
+            all_plain = all(form == "attr" and not lockset
+                            for _, _, _, lockset, form in sites)
+            thread_ctxs = [c for k, c in ctxs if k == "thread"]
+            if all_plain and len(thread_ctxs) == 1 \
+                    and all(k in ("thread", "drive") for k in kinds):
+                continue    # exactly ATP221's shape: leave it to ATP221
+            line = min(line for kind, _, line, _, _ in sites
+                       if kind == "thread")
+            locks_by_ctx: dict = {}
+            for kind, ctx, _, lockset, _ in sites:
+                locks_by_ctx.setdefault(ctx, set()).update(lockset)
+            _emit(findings, lines, path, "ATP301", line,
+                  f"`self.{attr}` is written from "
+                  f"{len(ctxs)} concurrent contexts "
+                  f"({', '.join(c for _, c in ctxs)}) with no common lock "
+                  "— pick ONE lock and hold it at every write site",
+                  data={"attribute": attr,
+                        "contexts": [c for _, c in ctxs],
+                        "locks": {c: sorted(s)
+                                  for c, s in sorted(locks_by_ctx.items())},
+                        "span": [min(s[2] for s in sites),
+                                 max(s[2] for s in sites)]})
+
+
+# ---------------------------------------------------------------------------
+# ATP302: static lock-order cycles
+# ---------------------------------------------------------------------------
+
+
+class _ModuleLockOrder:
+    """Builds the module's lock-acquisition graph and reports cycles.
+
+    Edges come from (a) lexically nested lock `with` scopes and (b)
+    calls made while holding a lock, joined to every lock the callee
+    acquires transitively through the module-local call graph. Function
+    keys are (class_name|None, fn_name) so `close` in two classes never
+    conflates."""
+
+    def __init__(self, tree: ast.Module, path: str, lines: list,
+                 findings: list, cv_names: frozenset):
+        self.tree = tree
+        self.path = path
+        self.lines = lines
+        self.findings = findings
+        self.cv_names = cv_names
+
+    def _callee_key(self, call: ast.Call, cls_name: str | None):
+        chain = _attr_chain(call.func)
+        if len(chain) == 2 and chain[0] == "self" and cls_name:
+            return (cls_name, chain[1])
+        if len(chain) == 1:
+            return (None, chain[0])
+        return None
+
+    def run(self) -> None:
+        funcs = _functions_with_owners(self.tree)
+        by_key: dict = {}
+        for fn, cls in funcs:
+            by_key.setdefault((cls.name if cls else None, fn.name),
+                              []).append(fn)
+        direct: dict = {}        # key -> set of lock names
+        callees: dict = {}       # key -> set of callee keys
+        edges: list = []         # (outer, inner, line)
+        held_calls: list = []    # (held tuple, callee key, line)
+        for fn, cls in funcs:
+            cls_name = cls.name if cls else None
+            key = (cls_name, fn.name)
+            d = direct.setdefault(key, set())
+            c = callees.setdefault(key, set())
+
+            def visit(node, held):
+                if isinstance(node, _FN_NODES + (ast.ClassDef,)):
+                    return      # nested defs are their own functions
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner_held = list(held)
+                    for item in node.items:
+                        # the context expr is evaluated BEFORE the lock
+                        # is held (items acquire left to right)
+                        visit(item.context_expr, tuple(inner_held))
+                        name = _lock_chain_name(
+                            item.context_expr, cls_name, self.cv_names)
+                        if name is None:
+                            continue
+                        d.add(name)
+                        for h in inner_held:
+                            if h != name:
+                                edges.append((h, name, node.lineno))
+                        inner_held.append(name)
+                    for sub in node.body:
+                        visit(sub, inner_held)
+                    return
+                if isinstance(node, ast.Call):
+                    ck = self._callee_key(node, cls_name)
+                    if ck is not None and ck in by_key:
+                        c.add(ck)
+                        if held:
+                            held_calls.append((tuple(held), ck,
+                                               node.lineno))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for stmt in fn.body:
+                visit(stmt, [])
+        # transitive lock acquisition through the call graph
+        trans = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, cs in callees.items():
+                for ck in cs:
+                    extra = trans.get(ck, set()) - trans[k]
+                    if extra:
+                        trans[k] |= extra
+                        changed = True
+        for held, ck, line in held_calls:
+            for m in trans.get(ck, ()):
+                for h in held:
+                    if h != m:
+                        edges.append((h, m, line))
+        # cycle detection: an edge (a, b) where b already reaches a
+        adj: dict = {}
+        for a, b, line in edges:
+            adj.setdefault(a, {}).setdefault(b, line)
+        reported: set = set()
+        for a, b, line in edges:
+            cycle = self._path(adj, b, a)
+            if cycle is None:
+                continue
+            full = [a] + cycle      # a -> b -> ... -> a
+            key = frozenset(full)
+            if key in reported:
+                continue
+            reported.add(key)
+            _emit(self.findings, self.lines, self.path, "ATP302", line,
+                  "lock-order cycle: " + " -> ".join(full)
+                  + " — two threads taking the two orderings "
+                  "concurrently deadlock; pick one global order",
+                  data={"cycle": full,
+                        "locks": sorted(set(full)),
+                        "span": [line, line]})
+
+    @staticmethod
+    def _path(adj: dict, src: str, dst: str) -> list | None:
+        """Shortest lock path src..dst (inclusive) via BFS, else None."""
+        prev: dict = {src: None}
+        queue = [src]
+        while queue:
+            cur = queue.pop(0)
+            if cur == dst:
+                out = []
+                while cur is not None:
+                    out.append(cur)
+                    cur = prev[cur]
+                return out[::-1]
+            for nxt in adj.get(cur, ()):
+                if nxt not in prev:
+                    prev[nxt] = cur
+                    queue.append(nxt)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# ATP303: blocking calls reachable from async defs
+# ---------------------------------------------------------------------------
+
+
+def _match_blocking(call: ast.Call, table=BLOCKING_CALLS):
+    chain = _attr_chain(call.func)
+    if len(chain) < 2:
+        return None, None
+    if chain[0] in ("asyncio", "anyio", "trio"):
+        return None, None
+    tail, recv = chain[-1], chain[-2]
+    for b in table:
+        if b.method != tail:
+            continue
+        if b.receivers and recv not in b.receivers:
+            continue
+        if len(call.args) > b.max_args:
+            continue
+        if b.timeout_exempts:
+            kw = {k.arg for k in call.keywords}
+            if "timeout" in kw or "block" in kw:
+                continue
+        return b, ".".join(chain)
+    return None, None
+
+
+def _lint_blocking(tree: ast.Module, path: str, lines: list,
+                   findings: list, blocking=BLOCKING_CALLS) -> None:
+    funcs = _functions_with_owners(tree)
+    by_key: dict = {}
+    for fn, cls in funcs:
+        by_key.setdefault((cls.name if cls else None, fn.name),
+                          []).append(fn)
+    callees: dict = {}
+    for fn, cls in funcs:
+        cls_name = cls.name if cls else None
+        key = (cls_name, fn.name)
+        cs = callees.setdefault(key, set())
+        for node in _outer_walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            ck = None
+            if len(chain) == 2 and chain[0] == "self" and cls_name:
+                ck = (cls_name, chain[1])
+            elif len(chain) == 1:
+                ck = (None, chain[0])
+            if ck is not None and ck in by_key:
+                cs.add(ck)
+    async_keys = [k for k, defs in by_key.items()
+                  if any(isinstance(f, ast.AsyncFunctionDef) for f in defs)]
+    via: dict = {}               # key -> path of fn names from an async def
+    queue = []
+    for k in async_keys:
+        via[k] = [k[1]]
+        queue.append(k)
+    while queue:
+        cur = queue.pop(0)
+        for ck in sorted(callees.get(cur, ()),
+                         key=lambda k: (k[0] or "", k[1])):
+            if ck not in via:
+                via[ck] = via[cur] + [ck[1]]
+                queue.append(ck)
+    reported: set = set()
+    for key, chain_path in via.items():
+        for fn in by_key[key]:
+            awaited = {
+                id(c)
+                for n in _outer_walk(fn) if isinstance(n, ast.Await)
+                for c in ast.walk(n) if isinstance(c, ast.Call)
+            }
+            for n in _outer_walk(fn):
+                if isinstance(n, ast.Call):
+                    chain = _attr_chain(n.func)
+                    if chain and chain[-1] in _SCHEDULING_CALLS:
+                        for a in list(n.args) + [k.value for k in n.keywords]:
+                            awaited |= {id(c) for c in ast.walk(a)
+                                        if isinstance(c, ast.Call)}
+            for call in _outer_walk(fn):
+                if not isinstance(call, ast.Call) or id(call) in awaited:
+                    continue
+                b, name = _match_blocking(call, blocking)
+                if b is None or (call.lineno, name) in reported:
+                    continue
+                reported.add((call.lineno, name))
+                hop = ("" if len(chain_path) == 1
+                       else " via " + " -> ".join(chain_path))
+                _emit(findings, lines, path, "ATP303", call.lineno,
+                      f"blocking call `{name}` reachable from async "
+                      f"`{chain_path[0]}`{hop} — {b.reason}",
+                      data={"call": name, "reason": b.reason,
+                            "async_entry": chain_path[0],
+                            "via": chain_path,
+                            "span": [call.lineno, call.lineno]})
+
+
+# ---------------------------------------------------------------------------
+# ATP304: condition-variable protocol
+# ---------------------------------------------------------------------------
+
+
+def _lint_condvars(tree: ast.Module, path: str, lines: list,
+                   findings: list, cv_names: frozenset) -> None:
+    if not cv_names:
+        return
+    for fn, cls in _functions_with_owners(tree):
+        cls_name = cls.name if cls else None
+        held = _lock_ranges(fn, cls_name, cv_names)
+        whiles = [(n.lineno, getattr(n, "end_lineno", n.lineno))
+                  for n in _outer_walk(fn) if isinstance(n, ast.While)]
+        for call in _outer_walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            chain = _attr_chain(call.func)
+            if len(chain) < 2:
+                continue
+            recv = chain[:-1]
+            if recv[0] == "self":
+                qual = (f"{cls_name}." if cls_name else "") \
+                    + ".".join(recv[1:])
+            else:
+                qual = ".".join(recv)
+            if qual not in cv_names:
+                continue
+            method = chain[-1]
+            line = call.lineno
+            if method == "wait":
+                in_loop = any(a <= line <= b for a, b in whiles)
+                if not in_loop:
+                    _emit(findings, lines, path, "ATP304", line,
+                          f"`{qual}.wait()` outside a `while` predicate "
+                          "loop — spurious wakeups and lost notifies "
+                          "make a bare wait incorrect; re-check the "
+                          "predicate in a loop (or use wait_for)",
+                          data={"condition": qual, "misuse": "bare-wait",
+                                "span": [line, line]})
+            elif method in ("notify", "notify_all"):
+                locked = any(a <= line <= b and n == qual
+                             for a, b, n in held)
+                if not locked:
+                    _emit(findings, lines, path, "ATP304", line,
+                          f"`{qual}.{method}()` without holding the "
+                          "condition's lock — RuntimeError at runtime, "
+                          "and the waiter can miss the signal; wrap in "
+                          f"`with {qual.split('.')[-1]}:`",
+                          data={"condition": qual,
+                                "misuse": "unlocked-notify",
+                                "span": [line, line]})
+
+
+# ---------------------------------------------------------------------------
+# ATP305: thread shutdown discipline
+# ---------------------------------------------------------------------------
+
+
+def _lint_thread_shutdown(tree: ast.Module, path: str, lines: list,
+                          findings: list, entries: ThreadEntries) -> None:
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        fns = _class_functions(cls)
+        owned: dict = {}        # attr -> (ctor, line)
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                chain = _attr_chain(node.value.func)
+                if chain and chain[-1] in entries.constructors:
+                    for t in node.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            owned.setdefault(
+                                t.attr, (chain[-1], node.lineno))
+        if not owned:
+            continue
+        started: set = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if len(chain) == 3 and chain[0] == "self" \
+                        and chain[2] == "start" and chain[1] in owned:
+                    started.add(chain[1])
+        closers = _closure(fns, {n for n in _CLOSER_NAMES if n in fns})
+        discharged: set = set()
+        for name in closers:
+            for fn in fns.get(name, []):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        chain = _attr_chain(node.func)
+                        if len(chain) == 3 and chain[0] == "self" \
+                                and chain[2] in _DISCHARGE \
+                                and chain[1] in owned:
+                            discharged.add(chain[1])
+        close_names = sorted(n for n in _CLOSER_NAMES if n in fns)
+        for attr in sorted(started - discharged):
+            ctor, line = owned[attr]
+            how = (f"none of {', '.join(close_names)} reaches it"
+                   if close_names else
+                   "the class has no close/shutdown/stop method at all")
+            _emit(findings, lines, path, "ATP305", line,
+                  f"`self.{attr}` ({ctor}) is started but never "
+                  f"joined/stopped/cancelled on shutdown — {how}. A "
+                  "daemon flag is not a shutdown path: the thread still "
+                  "races teardown and pins its sockets/files",
+                  data={"attribute": attr, "constructor": ctor,
+                        "closers": close_names,
+                        "span": [line, line]})
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def lint_concurrency(tree: ast.Module, text: str, path: str,
+                     lines: list, findings: list,
+                     entries: ThreadEntries = THREAD_ENTRIES,
+                     blocking=BLOCKING_CALLS) -> None:
+    """Run the ATP3xx passes over one parsed module. Text pre-gates keep
+    the cost near zero on modules with no concurrency surface."""
+    low = text.lower()
+    run_entries = any(c + "(" in text for c in entries.constructors)
+    run_order = "with" in text and "lock" in low
+    run_async = "async def" in text
+    run_cv = "Condition(" in text
+    if not (run_entries or run_order or run_async or run_cv):
+        return
+    cv_names = _condition_names(tree) if run_cv else frozenset()
+    if run_entries:
+        _lint_shared_state(tree, path, lines, findings, entries, cv_names)
+        _lint_thread_shutdown(tree, path, lines, findings, entries)
+    if run_order or run_cv:
+        _ModuleLockOrder(tree, path, lines, findings, cv_names).run()
+    if run_async:
+        _lint_blocking(tree, path, lines, findings, blocking)
+    if run_cv:
+        _lint_condvars(tree, path, lines, findings, cv_names)

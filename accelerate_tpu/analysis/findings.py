@@ -87,6 +87,24 @@ RULES: dict[str, Rule] = {
         Rule("ATP221", "cross-thread-state-mutation", "source",
              "state mutated both from a thread/handler context and from "
              "drive-loop code without a lock or the drive task"),
+        Rule("ATP301", "shared-state-no-common-lock", "source",
+             "attribute written from two or more concurrent contexts "
+             "(thread entries / asyncio tasks / drive loop) whose write "
+             "sites share no common lock"),
+        Rule("ATP302", "lock-order-cycle", "source",
+             "nested lock acquisitions (joined across the module call "
+             "graph) form an ordering cycle — a statically reachable "
+             "deadlock"),
+        Rule("ATP303", "blocking-call-in-async", "source",
+             "blocking call (time.sleep, unbounded get/join/wait, socket "
+             "ops, device syncs) reachable from an async def wedges the "
+             "event loop"),
+        Rule("ATP304", "condvar-misuse", "source",
+             "condition-variable wait outside a predicate loop, or "
+             "notify without holding the condition's lock"),
+        Rule("ATP305", "thread-never-joined", "source",
+             "a started thread with no join/stop/cancel path reachable "
+             "from the owner's close/shutdown/drain"),
         Rule("ATP101", "collective-contract", "program",
              "lowered program's collective counts violate its declared "
              "CollectiveContract"),

@@ -135,10 +135,15 @@ REQUEST_FSM = RequestFSM()
 class ThreadEntries:
     """Where thread/handler contexts are born: constructor-call name
     tails whose listed keyword arguments register a callable that runs
-    off the drive loop."""
+    off the drive loop. ``task_constructors`` are asyncio task spawns
+    whose FIRST POSITIONAL argument is the entry (``create_task(
+    self._pump())``); tasks interleave with the drive loop at awaits and
+    race preemptively against real threads, so the ATP3xx concurrency
+    passes treat them as their own contexts."""
 
     constructors: tuple = ("Thread", "Timer", "StallWatchdog")
     kwargs: tuple = ("target", "dumps", "on_stall")
+    task_constructors: tuple = ("create_task", "ensure_future")
 
 
 THREAD_ENTRIES = ThreadEntries()

@@ -565,6 +565,11 @@ def lint_text(text: str, path: str = "<string>") -> list[Finding]:
     from .lifecycle import lint_lifecycle
 
     lint_lifecycle(tree, text, path, lines, findings)
+    # ATP3xx: concurrency passes (locksets, lock order, blocking-in-
+    # async, condvars, thread shutdown)
+    from .concurrency import lint_concurrency
+
+    lint_concurrency(tree, text, path, lines, findings)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 

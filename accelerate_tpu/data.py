@@ -707,18 +707,33 @@ class _PrefetchIterator:
     def __init__(self, source_iter: Iterator, prepare: Callable, depth: int):
         self._queue: queue.Queue = queue.Queue(maxsize=max(depth, 1))
         self._error: BaseException | None = None
+        self._stop = threading.Event()
 
         def worker():
             try:
                 for item in source_iter:
-                    self._queue.put(prepare(item))
+                    payload = prepare(item)
+                    if not self._put(payload):
+                        return      # consumer closed mid-epoch
             except BaseException as e:  # surfaced on the consumer side
                 self._error = e
             finally:
-                self._queue.put(_SENTINEL)
+                self._put(_SENTINEL)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that a close() can always unpark: an abandoned
+        iterator must not leave the worker blocked on a full queue
+        forever (the epoch-break leak)."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def __iter__(self):
         return self
@@ -730,6 +745,17 @@ class _PrefetchIterator:
                 raise self._error
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Stop the prefetch thread and reap it. Idempotent; safe to call
+        with the source only partially consumed."""
+        self._stop.set()
+        while True:     # drain so a parked worker sees the stop promptly
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
 
 
 class DevicePrefetchIterator:
@@ -911,9 +937,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.generator)
         self.begin()
+        prefetch = None
         try:
             source = iter(self.loader)
-            prepared = _PrefetchIterator(
+            prefetch = prepared = _PrefetchIterator(
                 source, self._prepare_host, self.prefetch_size
             )
             if self.put_on_device:
@@ -954,8 +981,12 @@ class DataLoaderShard(DataLoaderStateMixin):
                 current = nxt
             self.set_epoch(self.epoch + 1)
         finally:
-            # breaking out early must still unregister from GradientState —
-            # a stale reference would corrupt accumulate() sync decisions
+            # breaking out early must still reap the prefetch thread (an
+            # abandoned epoch would leave it parked on the full queue) and
+            # unregister from GradientState — a stale reference would
+            # corrupt accumulate() sync decisions
+            if prefetch is not None:
+                prefetch.close()
             self.end()
 
     def __len__(self) -> int:

@@ -95,7 +95,14 @@ class InferenceService:
             except BaseException:
                 pass  # already recorded as _drive_error and surfaced
             self._drive_task = None
-        self.engine.close()
+        # engine.close() joins the watchdog / metrics-server / host-tier
+        # threads — seconds of blocking if one is mid-drain. The drive
+        # task is already dead, so no engine call races this; run it off
+        # the loop so health checks and other servers on this loop keep
+        # answering while we tear down. (ATP303's module-local view ends
+        # at the engine boundary; this is the audit fix it points at.)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.close)
 
     async def drain(self, timeout_s: float | None = None) -> None:
         """Stop admitting, let in-flight work finish, cancel stragglers."""

@@ -48,6 +48,8 @@ import threading
 
 import numpy as np
 
+from ..telemetry.lockwatch import maybe_tracked
+
 __all__ = ["HostTier"]
 
 
@@ -65,7 +67,7 @@ class _HostEntry:
         self.node = node
         self.device = device
         self.data = None
-        self.lock = threading.Lock()
+        self.lock = maybe_tracked("host-tier-entry")
         self.cancelled = False
 
 

@@ -226,6 +226,7 @@ class DistributedPodRouter:
         # (queue pressure exists before decode occupancy can) would
         # reshape the pod before it ever ran its configured shape
         self._last_rebalance = self._clock()
+        self.last_step_worked = False
         self.recovery_log: deque[dict] = deque(maxlen=256)
 
         self.scheduler = _FrontScheduler(
@@ -402,7 +403,12 @@ class DistributedPodRouter:
                 sent += 1
             if request.done or not self.step():
                 break
-            await asyncio.sleep(0)
+            # idle-but-outstanding on a pure-remote pod: yield a real
+            # tick so the reader threads can land replies; otherwise
+            # just yield the loop
+            await asyncio.sleep(
+                0 if self.last_step_worked or self._has_local_workers()
+                else 0.001)
         for tok in request.tokens[sent:]:
             yield tok
 
@@ -452,13 +458,16 @@ class DistributedPodRouter:
                 self._maybe_write_fleet_bundle()
                 raise
         outstanding = bool(self._flights) or self.scheduler.queue_depth > 0
-        if not worked and outstanding and not self._has_local_workers():
-            time.sleep(0.001)   # remote work in flight: don't spin hot
+        # pacing is the CALLER's job: step() runs inline on the asyncio
+        # drive loop (astream), and a sleep here would park every task on
+        # the loop. Sync callers read `last_step_worked` and sleep.
+        self.last_step_worked = worked
         return worked or outstanding
 
     def run_until_idle(self) -> None:
         while self.step():
-            pass
+            if not self.last_step_worked and not self._has_local_workers():
+                time.sleep(0.001)   # remote work in flight: don't spin hot
 
     def _has_local_workers(self) -> bool:
         return any(h.local is not None for h in self.workers.values())
@@ -1402,7 +1411,10 @@ class DistributedPodRouter:
                     out[handle.worker_id] = dumps
                     asked.remove(handle)
             if asked and not self._has_local_workers():
-                time.sleep(0.005)
+                # deliberate: incident capture is synchronous by design —
+                # the pod is already broken, and the wait is bounded by
+                # `budget` above
+                time.sleep(0.005)  # atp: disable=ATP303
         for handle in asked:
             out[handle.worker_id] = {
                 "worker_error": f"no reply within {budget}s"}

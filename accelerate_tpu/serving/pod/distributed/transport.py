@@ -30,6 +30,7 @@ import socket
 import threading
 from typing import Callable, Iterable
 
+from ....telemetry.lockwatch import maybe_tracked
 from .wire import (Message, decode_message, encode_message, read_frame,
                    write_frame)
 
@@ -122,7 +123,7 @@ class SocketChannel(Channel):
         self._sendq: queue.Queue[bytes | None] = queue.Queue(
             maxsize=max(1, send_queue_depth))
         self._inbox: collections.deque[Message] = collections.deque()
-        self._lock = threading.Lock()
+        self._lock = maybe_tracked("pod-channel")
         self._closed = threading.Event()
         self.bytes_sent = 0
         self.bytes_received = 0
@@ -203,6 +204,16 @@ class SocketChannel(Channel):
 
     def close(self) -> None:
         self._mark_closed()
+        # reap the IO threads: the socket shutdown kicks the reader out
+        # of read_frame and the None sentinel kicks the writer off the
+        # queue, so both exit promptly. `_mark_closed` itself must NOT
+        # join — it runs on the reader/writer's own error paths, and a
+        # thread cannot join itself.
+        me = threading.current_thread()
+        if self._reader is not me:
+            self._reader.join(timeout=5.0)
+        if self._writer is not me:
+            self._writer.join(timeout=5.0)
 
 
 class ChannelListener:

@@ -16,6 +16,10 @@ One observability layer the rest of the codebase plugs into (ISSUE 3):
   snapshots (global tokens/sec, slowest-host step time, per-host HBM).
 - `watchdog` — heartbeat thread that dumps all-thread stacks, device
   memory stats, and the flight-recorder tail when a job goes silent.
+- `lockwatch` — instrumented locks recording per-thread acquisition
+  order into a process-wide graph; a would-deadlock ordering raises
+  `LockOrderViolation` naming the cycle and writes an incident bundle
+  (opt-in via `ACCELERATE_TPU_LOCKWATCH=1`, on for tier-1 tests).
 
 Importing this package never initializes a jax backend (guarded by
 tests/test_telemetry.py), so it is safe in CLI tools and collectors.
@@ -67,6 +71,15 @@ from .cost import (
     device_peaks,
     extract_cost_analysis,
     resolve_sample_every,
+)
+from .lockwatch import (
+    LOCKWATCH_ENV,
+    LockOrderViolation,
+    TrackedLock,
+    lockwatch_enabled,
+    lockwatch_state,
+    maybe_tracked,
+    reset_lockwatch,
 )
 from .watchdog import (
     INCIDENT_DIR_ENV,
@@ -128,6 +141,13 @@ __all__ = [
     "build_exception_report",
     "list_incident_bundles",
     "load_incident_bundle",
+    "LOCKWATCH_ENV",
+    "LockOrderViolation",
+    "TrackedLock",
+    "lockwatch_enabled",
+    "lockwatch_state",
+    "maybe_tracked",
+    "reset_lockwatch",
 ]
 
 if os.environ.get("ACCELERATE_TPU_TRACE", "").strip() in ("1", "true", "on"):

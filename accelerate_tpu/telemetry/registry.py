@@ -335,7 +335,16 @@ class MetricsRegistry:
     paths can also cache the returned metric and skip the lookup."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # tracked under ACCELERATE_TPU_LOCKWATCH: _get_or_create's
+        # lock-free fast path means this lock is only taken on series
+        # creation, so the tracking cost is off the metrics hot path.
+        # metrics=False: ordering-graph only — recording held-duration
+        # for the registry's own lock would add series to every registry
+        # it guards, polluting snapshot()s.
+        from .lockwatch import maybe_tracked
+
+        self._lock = maybe_tracked("metrics-registry", registry=self,
+                                   metrics=False)
         self._metrics: dict[tuple[str, str, tuple], Any] = {}
 
     @staticmethod

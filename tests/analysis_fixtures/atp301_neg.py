@@ -1,0 +1,27 @@
+"""ATP301 negative: the same thread-vs-task shape, but every write to
+the shared attribute holds ONE common lock — the intersection of the
+locksets is non-empty, so the exclusion is real."""
+import asyncio
+import threading
+
+
+class LockedRouter:
+    def start(self, loop):
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+        loop.create_task(self._drive())
+
+    def _pump(self):
+        while not self._stop:
+            with self._books_lock:
+                self.books[self.next_id] = self.poll()
+
+    async def _drive(self):
+        while True:
+            with self._books_lock:
+                self.books[0] = None
+            await asyncio.sleep(0)
+
+    def close(self):
+        self._stop = True
+        self._reader.join(timeout=5.0)

@@ -1,0 +1,29 @@
+"""ATP301 positive: `self.books` is written from a reader THREAD and
+from an asyncio TASK (which races the thread preemptively), and the two
+sites hold two DIFFERENT locks — no common lock means no exclusion.
+Subscript stores count: the router-book-vs-heartbeat race is exactly
+`self.books[k] = v` from two contexts."""
+import asyncio
+import threading
+
+
+class RacyRouter:
+    def start(self, loop):
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+        loop.create_task(self._drive())
+
+    def _pump(self):
+        while not self._stop:
+            with self._io_lock:
+                self.books[self.next_id] = self.poll()   # lock A
+
+    async def _drive(self):
+        while True:
+            with self._books_lock:
+                self.books[0] = None                     # lock B != A
+            await asyncio.sleep(0)
+
+    def close(self):
+        self._stop = True
+        self._reader.join(timeout=5.0)

@@ -1,0 +1,23 @@
+"""ATP302 negative: every path takes the locks in ONE global order
+(books before wire), including the path through the call graph — a
+consistent order can never cycle."""
+import threading
+
+
+class Pod:
+    def __init__(self):
+        self._books_lock = threading.Lock()
+        self._wire_lock = threading.Lock()
+
+    def forward(self):
+        with self._books_lock:
+            with self._wire_lock:        # books -> wire
+                self.ship()
+
+    def on_frame(self):
+        with self._books_lock:
+            self._send_locked()          # call under books...
+
+    def _send_locked(self):
+        with self._wire_lock:            # ...still books -> wire
+            self.record()

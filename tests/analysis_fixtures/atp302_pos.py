@@ -1,0 +1,22 @@
+"""ATP302 positive: two methods acquire the same two locks in OPPOSITE
+nested order — two threads running them concurrently deadlock. The
+second pair goes through the call graph: `offer` holds the wire lock
+and calls a helper that takes the books lock, while `fetch` nests them
+the other way lexically."""
+import threading
+
+
+class Pod:
+    def __init__(self):
+        self._books_lock = threading.Lock()
+        self._wire_lock = threading.Lock()
+
+    def forward(self):
+        with self._books_lock:
+            with self._wire_lock:        # books -> wire
+                self.ship()
+
+    def on_frame(self):
+        with self._wire_lock:
+            with self._books_lock:       # wire -> books: the inversion
+                self.record()

@@ -1,0 +1,27 @@
+"""ATP303 negative: the accepted idioms — `await asyncio.sleep`, timed
+gets, executor offload (a callable REFERENCE, not a call), and waits
+that are scheduled/bounded by asyncio rather than run inline."""
+import asyncio
+
+
+class Service:
+    async def drive(self):
+        loop = asyncio.get_running_loop()
+        stop = loop.create_task(self.stop_requested.wait())
+        while not stop.done():
+            await asyncio.sleep(0.01)
+            self._pump_once()
+            await loop.run_in_executor(None, self._drain_blocking)
+            await asyncio.wait_for(self.inbox_async.get(), timeout=1.0)
+
+    def _pump_once(self):
+        try:
+            item = self.inbox.get(timeout=0.1)   # bounded: fine
+        except Exception:
+            return
+        self.handle(item)
+
+    def _drain_blocking(self):
+        # only ever REFERENCED from the async side (executor offload),
+        # never called from it — blocking here is the point
+        self.worker.join()

@@ -1,0 +1,15 @@
+"""ATP303 positive: blocking calls on the event loop — a bare
+`time.sleep` directly in an async def, and an untimed `queue.get()` in
+a sync helper the async drive loop reaches through a call."""
+import time
+
+
+class Service:
+    async def drive(self):
+        while True:
+            time.sleep(0.01)             # parks every task on the loop
+            self._pump_once()
+
+    def _pump_once(self):
+        item = self.inbox.get()          # no timeout: blocks the loop
+        self.handle(item)

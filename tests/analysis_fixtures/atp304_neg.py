@@ -1,0 +1,26 @@
+"""ATP304 negative: the textbook protocol — wait in a `while` predicate
+loop under the lock, notify under the lock, and `wait_for` (which owns
+its own predicate re-check) used bare."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def take(self):
+        with self._cv:
+            while not self.items:
+                self._cv.wait()
+            return self.items.pop()
+
+    def take_bounded(self, timeout):
+        with self._cv:
+            self._cv.wait_for(lambda: bool(self.items), timeout=timeout)
+            return self.items.pop() if self.items else None
+
+    def put(self, item):
+        with self._cv:
+            self.items.append(item)
+            self._cv.notify()

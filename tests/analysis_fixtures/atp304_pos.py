@@ -1,0 +1,21 @@
+"""ATP304 positive: both condition-variable protocol violations — a
+bare `wait()` outside any `while` predicate loop (spurious wakeups and
+lost notifies break it), and a `notify()` without holding the
+condition's lock (RuntimeError, or a missed signal)."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.items = []
+
+    def take(self):
+        with self._cv:
+            if not self.items:
+                self._cv.wait()          # bare wait: if, not while
+            return self.items.pop()
+
+    def put(self, item):
+        self.items.append(item)
+        self._cv.notify()                # lock not held

@@ -1,0 +1,29 @@
+"""ATP305 negative: shutdown discipline done right — `close` reaps the
+reader thread (through a same-class helper, which the closure follows)
+and `stop` cancels the timer it started."""
+import threading
+
+
+class Channel:
+    def __init__(self, sock):
+        self._sock = sock
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+        self._ticker = threading.Timer(5.0, self._beat)
+        self._ticker.start()
+
+    def _read_loop(self):
+        while not self._closed:
+            self.inbox.append(self._sock.recv(4096))
+
+    def close(self):
+        self._closed = True
+        self._sock.close()
+        self._reap()
+
+    def _reap(self):
+        self._reader.join(timeout=5.0)
+
+    def stop(self):
+        self._ticker.cancel()

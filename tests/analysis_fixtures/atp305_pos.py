@@ -1,0 +1,21 @@
+"""ATP305 positive: a started thread with no shutdown path — `close`
+exists but never joins/stops/cancels the attribute. The daemon flag is
+not an exemption: the thread still races interpreter teardown and pins
+its socket."""
+import threading
+
+
+class Channel:
+    def __init__(self, sock):
+        self._sock = sock
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self):
+        while not self._closed:
+            self.inbox.append(self._sock.recv(4096))
+
+    def close(self):
+        self._closed = True
+        self._sock.close()               # ...but the reader is never joined

@@ -49,6 +49,14 @@ configure_compilation_cache()
 # Host-side only; compile counts are pinned flat with this on.
 os.environ.setdefault("ACCELERATE_TPU_SANITIZE", "1")
 
+# Runtime lock-order sanitizer (ISSUE 19): transport / host-tier /
+# metrics-registry locks become TrackedLocks recording per-thread
+# acquisition order into a process-wide graph — a would-deadlock
+# ordering raises LockOrderViolation instead of wedging the suite.
+# Same split as the sanitizer above: the ATP3xx static pass proves what
+# it can name, lockwatch catches the orderings only runtime sees.
+os.environ.setdefault("ACCELERATE_TPU_LOCKWATCH", "1")
+
 
 def pytest_collection_modifyitems(config, items):
     """Gate @pytest.mark.slow behind RUN_SLOW=1 (ref testing.py slow

@@ -53,6 +53,10 @@ ALL_RULE_IDS = [f"ATP00{i}" for i in range(1, 9)]
 # FSM, thread confinement — same fixture scheme, same pipeline
 LIFECYCLE_RULE_IDS = ["ATP201", "ATP202", "ATP203",
                       "ATP211", "ATP212", "ATP221"]
+# ATP3xx (ISSUE 19): the concurrency auditor — shared-state locksets,
+# lock-order cycles, blocking calls on the loop, condvar protocol,
+# thread shutdown — same fixture scheme, same pipeline
+CONCURRENCY_RULE_IDS = ["ATP301", "ATP302", "ATP303", "ATP304", "ATP305"]
 
 
 # ---------------------------------------------------------------------------
@@ -61,13 +65,15 @@ LIFECYCLE_RULE_IDS = ["ATP201", "ATP202", "ATP203",
 
 
 class TestSourceRules:
-    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS)
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS
+                             + CONCURRENCY_RULE_IDS)
     def test_positive_fixture_fires(self, rule):
         path = os.path.join(FIXTURES, f"{rule.lower()}_pos.py")
         got = {f.rule for f in lint_file(path)}
         assert rule in got, f"{path} did not produce {rule} (got {got})"
 
-    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS)
+    @pytest.mark.parametrize("rule", ALL_RULE_IDS + LIFECYCLE_RULE_IDS
+                             + CONCURRENCY_RULE_IDS)
     def test_negative_fixture_is_clean(self, rule):
         path = os.path.join(FIXTURES, f"{rule.lower()}_neg.py")
         found = [f for f in lint_file(path) if f.rule == rule]
@@ -515,6 +521,229 @@ class TestLifecyclePasses:
         bl = tmp_path / "bl.json"
         save_baseline(str(bl), findings)
         assert new_findings(findings, json.loads(bl.read_text())) == []
+
+
+# ---------------------------------------------------------------------------
+# ATP3xx concurrency passes (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyPasses:
+    def test_rule_catalog_is_stable(self):
+        assert RULES["ATP301"].name == "shared-state-no-common-lock"
+        assert RULES["ATP302"].name == "lock-order-cycle"
+        assert RULES["ATP303"].name == "blocking-call-in-async"
+        assert RULES["ATP304"].name == "condvar-misuse"
+        assert RULES["ATP305"].name == "thread-never-joined"
+
+    def test_self_lint_gate_runs_the_concurrency_rules(self):
+        """The gate runs with NO rule restriction, so the ATP3xx passes
+        are part of it by construction — pinned the same way the
+        lifecycle gate is: lint_paths' full pipeline must report the
+        planted fixture's findings."""
+        for rid in CONCURRENCY_RULE_IDS:
+            assert rid in RULES, rid
+        findings = lint_paths(
+            [os.path.join(FIXTURES, "atp302_pos.py")], root=REPO)
+        assert any(f.rule == "ATP302" for f in findings)
+        findings = lint_paths(
+            [os.path.join(FIXTURES, "atp301_pos.py")], root=REPO)
+        assert any(f.rule == "ATP301" for f in findings)
+
+    def test_findings_carry_structured_data(self):
+        """The JSON contract: ATP302 names the full cycle path and the
+        participating locks; ATP301 names the attribute, the contexts,
+        and each context's locks; ATP303 names the call and the async
+        entry path. Every rule keeps the span contract."""
+        fs = [f for f in lint_file(os.path.join(FIXTURES, "atp302_pos.py"))
+              if f.rule == "ATP302"]
+        assert fs
+        cycle = fs[0].data["cycle"]
+        assert cycle[0] == cycle[-1] and len(cycle) >= 3
+        assert set(fs[0].data["locks"]) == {"Pod._books_lock",
+                                            "Pod._wire_lock"}
+        fs = [f for f in lint_file(os.path.join(FIXTURES, "atp301_pos.py"))
+              if f.rule == "ATP301"]
+        assert fs and fs[0].data["attribute"] == "books"
+        assert len(fs[0].data["contexts"]) >= 2
+        assert isinstance(fs[0].data["locks"], dict)
+        fs = [f for f in lint_file(os.path.join(FIXTURES, "atp303_pos.py"))
+              if f.rule == "ATP303"]
+        assert fs
+        by_call = {f.data["call"]: f for f in fs}
+        assert by_call["time.sleep"].data["async_entry"] == "drive"
+        # the sync helper's finding carries the hop path from the loop
+        assert by_call["self.inbox.get"].data["via"] == \
+            ["drive", "_pump_once"]
+        for fixture, rule in (("atp304_pos.py", "ATP304"),
+                              ("atp305_pos.py", "ATP305")):
+            fs = [f for f in lint_file(os.path.join(FIXTURES, fixture))
+                  if f.rule == rule]
+            assert fs and all(len(f.data["span"]) == 2 for f in fs), rule
+
+    def test_json_output_includes_data(self, capsys):
+        """`--rules atp3 --format json` emits the structured payload the
+        acceptance criteria pin: lock names and the cycle path ride
+        `data`, and the run exits 1 on findings."""
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp302_pos.py"),
+                       "--rules", "atp3", "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["summary"]["by_rule"]) == {"ATP302"}
+        (row,) = payload["findings"]
+        assert row["data"]["cycle"][0] == row["data"]["cycle"][-1]
+        assert row["data"]["locks"]
+
+    def test_rules_group_alias(self, capsys):
+        """`--rules atp3` selects the whole concurrency family and
+        nothing else: the ATP201 fixture is clean under it, every ATP3xx
+        fixture is not, and the clean exit is 0."""
+        rc = cli_main(["lint", os.path.join(FIXTURES, "atp201_pos.py"),
+                       "--rules", "atp3"])
+        capsys.readouterr()
+        assert rc == 0
+        for rid in CONCURRENCY_RULE_IDS:
+            rc = cli_main(["lint",
+                           os.path.join(FIXTURES, f"{rid.lower()}_pos.py"),
+                           "--rules", "atp3"])
+            out = capsys.readouterr().out
+            assert rc == 1 and rid in out, (rid, out)
+
+    def test_blocking_table_one_line_extension(self):
+        """The declarative recipe: a NEW blocking shape registers in one
+        BlockingCall row and the reachability machinery audits it."""
+        import ast as ast_mod
+
+        from accelerate_tpu.analysis import BLOCKING_CALLS, BlockingCall
+        from accelerate_tpu.analysis.concurrency import lint_concurrency
+
+        table = BLOCKING_CALLS + (BlockingCall(
+            "fetch_sync", "synchronous RPC stalls the loop"),)
+        src = (
+            "class S:\n"
+            "    async def drive(self):\n"
+            "        reply = self.stub.fetch_sync()\n"
+        )
+        findings = []
+        lint_concurrency(ast_mod.parse(src), src, "t.py",
+                         src.splitlines(), findings, blocking=table)
+        assert [f.rule for f in findings] == ["ATP303"]
+        assert findings[0].data["call"] == "self.stub.fetch_sync"
+        # without the extra row the same code is silent
+        findings2 = []
+        lint_concurrency(ast_mod.parse(src), src, "t.py",
+                         src.splitlines(), findings2)
+        assert findings2 == []
+
+    def test_thread_entries_task_extension(self):
+        """ISSUE 19's THREAD_ENTRIES extension: asyncio task creation is
+        a concurrent context. Dropping task_constructors from the table
+        silences the thread-vs-task race the atp301 fixture pins."""
+        import ast as ast_mod
+
+        from accelerate_tpu.analysis import ThreadEntries
+        from accelerate_tpu.analysis.concurrency import lint_concurrency
+
+        # plain unlocked writes, one thread + one task: WITH task
+        # recognition the pair is thread-vs-task (preemptive race, ours);
+        # WITHOUT it the async def is just drive-loop code, which is
+        # ATP221's one-thread-vs-drive territory and ATP301 stays silent
+        src = (
+            "import threading\n"
+            "class R:\n"
+            "    def start(self, loop):\n"
+            "        self._t = threading.Thread(target=self._pump)\n"
+            "        self._t.start()\n"
+            "        loop.create_task(self._drive())\n"
+            "    def _pump(self):\n"
+            "        self.depth = 1\n"
+            "    async def _drive(self):\n"
+            "        self.depth = 2\n"
+        )
+        tree = ast_mod.parse(src)
+        findings = []
+        lint_concurrency(tree, src, "t.py", src.splitlines(), findings)
+        hits = [f for f in findings if f.rule == "ATP301"]
+        assert hits and hits[0].data["attribute"] == "depth"
+        assert sorted(hits[0].data["contexts"]) == ["_drive", "_pump"]
+        no_tasks = ThreadEntries(task_constructors=())
+        findings2 = []
+        lint_concurrency(tree, src, "t.py", src.splitlines(), findings2,
+                         entries=no_tasks)
+        assert not any(f.rule == "ATP301" for f in findings2)
+
+    def test_suppression_and_baseline_apply_to_concurrency_rules(
+            self, tmp_path):
+        """ATP3xx rides the whole existing pipeline: line suppressions
+        disarm a finding, baselines accept it. The tree itself carries a
+        justified `# atp: disable=ATP303` (droute's incident-capture
+        sleep), so the real-code path is exercised by the self-lint gate
+        too."""
+        pos = os.path.join(FIXTURES, "atp303_pos.py")
+        findings = lint_file(pos, root=REPO)
+        assert any(f.rule == "ATP303" for f in findings)
+        src = open(pos).read()
+        # the directive must END its line, so replace the trailing prose
+        suppressed = src.replace(
+            "# parks every task on the loop",
+            "# atp: disable=ATP303")
+        from accelerate_tpu.analysis import apply_suppressions
+
+        left = apply_suppressions(lint_text(suppressed, "t.py"), suppressed)
+        assert not any(f.rule == "ATP303" and "sleep" in f.source
+                       for f in left)
+        bl = tmp_path / "bl.json"
+        save_baseline(str(bl), findings)
+        assert new_findings(findings, json.loads(bl.read_text())) == []
+        # the in-tree justified suppression is really there
+        droute = os.path.join(REPO, "accelerate_tpu", "serving", "pod",
+                              "distributed", "droute.py")
+        assert "# atp: disable=ATP303" in open(droute).read()
+
+    def test_regression_shapes_of_the_fixed_bugs(self):
+        """The genuine ATP3xx findings this PR fixed, as inline shapes:
+        reverting any fix re-creates code the self-lint gate rejects."""
+        # (1) transport.SocketChannel pre-fix: reader/writer threads
+        # started in __init__, close() never joined them
+        src = (
+            "import threading\n"
+            "class Chan:\n"
+            "    def __init__(self, sock):\n"
+            "        self._reader = threading.Thread(target=self._rl)\n"
+            "        self._reader.start()\n"
+            "    def _rl(self):\n"
+            "        pass\n"
+            "    def close(self):\n"
+            "        self._closed = True\n"
+        )
+        assert "ATP305" in {f.rule for f in lint_text(src, "t.py")}
+        # (2) droute pre-fix: step() slept inline, and astream (an async
+        # def) calls step() — a time.sleep on the event loop
+        src = (
+            "import time\n"
+            "class Router:\n"
+            "    async def astream(self, req):\n"
+            "        while not self.step():\n"
+            "            pass\n"
+            "    def step(self):\n"
+            "        worked = self.pump()\n"
+            "        if not worked:\n"
+            "            time.sleep(0.001)\n"
+            "        return worked\n"
+        )
+        assert "ATP303" in {f.rule for f in lint_text(src, "t.py")}
+        # (3) data._PrefetchIterator pre-fix: worker thread with no
+        # close/stop path at all
+        src = (
+            "import threading\n"
+            "class Prefetch:\n"
+            "    def __init__(self, it):\n"
+            "        self._thread = threading.Thread(target=self._w)\n"
+            "        self._thread.start()\n"
+            "    def _w(self):\n"
+            "        pass\n"
+        )
+        assert "ATP305" in {f.rule for f in lint_text(src, "t.py")}
 
 
 # ---------------------------------------------------------------------------

@@ -790,3 +790,63 @@ def test_even_batches_property_equal_counts_and_full_coverage():
             want = {x for b in base for x in b}
             assert want <= seen, (n, bs, world, drop_last,
                                   sorted(want - seen))
+
+
+# ---------------------------------------------------------------------------
+# host prefetch shutdown (ATP305 regression, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_iterator_close_reaps_worker_mid_epoch():
+    """ATP305 regression: an abandoned epoch (consumer breaks out of the
+    loader loop) must reap the prefetch thread. Before the fix the
+    worker parked forever on the full bounded queue — every early break
+    leaked a thread pinning the source iterator."""
+    from accelerate_tpu.data import _PrefetchIterator
+
+    produced = []
+
+    def source():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    it = _PrefetchIterator(source(), prepare=lambda x: x * 2, depth=1)
+    assert next(it) == 0
+    assert it._thread.is_alive()
+    it.close()
+    assert not it._thread.is_alive(), "prefetch worker leaked past close()"
+    # bounded queue really did bound the read-ahead: close() came after a
+    # handful of items, not after the worker ripped through the source
+    assert len(produced) < 10, produced
+    it.close()                         # idempotent
+
+
+def test_prefetch_iterator_close_unparks_blocked_worker():
+    """The exact leak shape: queue full, worker blocked in put() when
+    close() lands. The stop event must unpark it promptly."""
+    import time
+
+    from accelerate_tpu.data import _PrefetchIterator
+
+    it = _PrefetchIterator(iter(range(100)), prepare=lambda x: x, depth=1)
+    deadline = time.monotonic() + 5
+    while it._queue.qsize() < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)              # let the worker fill the queue
+    it.close()
+    assert not it._thread.is_alive()
+
+
+def test_dataloader_break_mid_epoch_leaves_no_prefetch_thread():
+    """Loader-level: `break` inside the consumer loop runs the loader's
+    finally, which closes the prefetch stage."""
+    import threading
+
+    before = {t.ident for t in threading.enumerate()}
+    loader = DataLoaderShard(list(make_batches(64, 4)), put_on_device=False)
+    for i, _batch in enumerate(loader):
+        if i == 1:
+            break
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and t.is_alive()]
+    assert leaked == [], f"prefetch thread(s) leaked: {leaked}"

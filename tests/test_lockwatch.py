@@ -1,0 +1,309 @@
+"""Runtime lock-order sanitizer (ISSUE 19): TrackedLock ordering graph,
+would-deadlock refusal, incident bundles, contention/held metrics, and
+the deadlock-injection drill over the real pod transport.
+
+The static twin (ATP302, tests/test_analysis.py) proves ordering over
+locks it can name; these tests pin the runtime half: the process-wide
+graph records per-thread acquisition order, a cycle-closing acquire
+raises `LockOrderViolation` naming the full cycle BEFORE blocking (the
+test suite sees a structured failure, not a wedged worker), and the
+violation leaves a loadable incident bundle behind. The suite runs with
+`ACCELERATE_TPU_LOCKWATCH=1` (tests/conftest.py), so the wired sites —
+SocketChannel's inbox lock, the host-tier entry locks, the metrics
+registry's create lock — are tracked across the whole tier-1 serving
+surface."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from accelerate_tpu.telemetry import (
+    LockOrderViolation,
+    TrackedLock,
+    lockwatch_enabled,
+    lockwatch_state,
+    maybe_tracked,
+    reset_lockwatch,
+)
+from accelerate_tpu.telemetry.registry import MetricsRegistry
+from accelerate_tpu.telemetry.watchdog import (
+    list_incident_bundles,
+    load_incident_bundle,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_graph():
+    """Isolate the process-wide graph: edges recorded by other tests
+    must not pre-order these locks, and the deliberate inversions below
+    must not poison later pod tests."""
+    reset_lockwatch()
+    yield
+    reset_lockwatch()
+
+
+class TestGate:
+    def test_disabled_returns_plain_lock(self):
+        lock = maybe_tracked("x", setting=False)
+        assert not isinstance(lock, TrackedLock)
+        with lock:
+            assert lock.locked()
+
+    def test_env_parsing(self, monkeypatch):
+        assert lockwatch_enabled(True) and not lockwatch_enabled(False)
+        for raw, want in (("1", True), ("true", True), ("on", True),
+                          ("0", False), ("", False), ("no", False)):
+            monkeypatch.setenv("ACCELERATE_TPU_LOCKWATCH", raw)
+            assert lockwatch_enabled() is want, raw
+
+    def test_suite_runs_with_lockwatch_on(self):
+        """The conftest gate: tier-1 runs the whole serving surface with
+        tracked locks, like the PR 13 sanitizer."""
+        assert os.environ.get("ACCELERATE_TPU_LOCKWATCH") == "1"
+        assert lockwatch_enabled()
+
+
+class TestTrackedLock:
+    def test_duck_types_threading_lock(self):
+        lock = TrackedLock("t-lock")
+        assert not lock.locked()
+        with lock:
+            assert lock.locked()
+        assert not lock.locked()
+        assert lock.acquire(timeout=0.5)
+        lock.release()
+        assert lock.acquire(blocking=False)
+        lock.release()
+        assert "t-lock" in repr(lock)
+
+    def test_nonblocking_acquire_of_held_lock_fails_fast(self):
+        lock = TrackedLock("t-held")
+        lock.acquire()
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(lock.acquire(blocking=False)))
+        t.start()
+        t.join(timeout=5)
+        assert got == [False]
+        lock.release()
+
+    def test_consistent_order_records_edges_no_violation(self):
+        a, b = TrackedLock("order-a"), TrackedLock("order-b")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        edges = lockwatch_state()["edges"]
+        assert edges["order-a"]["order-b"]["count"] == 3
+        assert lockwatch_state()["violations"] == []
+
+    def test_inversion_raises_naming_the_cycle(self):
+        a, b = TrackedLock("inv-a"), TrackedLock("inv-b")
+
+        def first():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=first)
+        t.start()
+        t.join(timeout=5)
+        with pytest.raises(LockOrderViolation) as exc:
+            with b:
+                with a:
+                    pass
+        err = exc.value
+        assert err.cycle == ["inv-b", "inv-a", "inv-b"]
+        assert err.held == ["inv-b"]
+        assert "lock-order cycle" in str(err)
+        # the refusal happened BEFORE acquiring: nothing stays locked
+        assert not a.locked() and not b.locked()
+        # and the graph remembers the violation for forensics
+        (v,) = lockwatch_state()["violations"]
+        assert v["cycle"] == err.cycle and v["acquiring"] == "inv-a"
+
+    def test_three_lock_cycle_through_the_graph(self):
+        a, b, c = (TrackedLock("tri-a"), TrackedLock("tri-b"),
+                   TrackedLock("tri-c"))
+
+        def run(outer, inner):
+            with outer:
+                with inner:
+                    pass
+
+        for outer, inner in ((a, b), (b, c)):
+            t = threading.Thread(target=run, args=(outer, inner))
+            t.start()
+            t.join(timeout=5)
+        with pytest.raises(LockOrderViolation) as exc:
+            run(c, a)
+        assert exc.value.cycle == ["tri-c", "tri-a", "tri-b", "tri-c"]
+
+    def test_same_name_reacquire_is_not_a_cycle(self):
+        """Two instances sharing a lock class (two channels, both
+        "pod-channel") taken nested must not self-report: lock classes
+        skip self-edges exactly like runtime lockdep."""
+        a, b = TrackedLock("same-name"), TrackedLock("same-name")
+        with a:
+            with b:
+                pass
+        assert lockwatch_state()["violations"] == []
+
+    def test_contention_and_held_metrics(self):
+        reg = MetricsRegistry()
+        lock = TrackedLock("metered", registry=reg)
+        lock.acquire()
+
+        def contender():
+            assert lock.acquire(timeout=5)
+            lock.release()
+
+        t = threading.Thread(target=contender)
+        t.start()
+        time.sleep(0.05)
+        lock.release()
+        t.join(timeout=5)
+        snap = reg.snapshot()
+        assert 'lock_contention_total{lock="metered"}' in snap["counters"]
+        assert 'lock_held_seconds{lock="metered"}' in snap["histograms"]
+
+    def test_violation_writes_loadable_bundle(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("ACCELERATE_TPU_INCIDENT_DIR", str(tmp_path))
+        a, b = TrackedLock("bndl-a"), TrackedLock("bndl-b")
+
+        def first():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=first)
+        t.start()
+        t.join(timeout=5)
+        with pytest.raises(LockOrderViolation) as exc:
+            with b:
+                with a:
+                    pass
+        (manifest,) = list_incident_bundles(str(tmp_path))
+        assert manifest["kind"] == "lockwatch"
+        assert exc.value.bundle_path == manifest["path"]
+        loaded = load_incident_bundle(manifest["path"])
+        report = loaded["files"]["report.json"]
+        assert report["kind"] == "lock_order_violation"
+        assert report["cycle"] == exc.value.cycle
+        assert report["acquiring"] == "bndl-a"
+        # all-thread stacks ride along, like the stall watchdog's bundles
+        assert "stacks.txt" in loaded["files"]
+
+    def test_registry_lock_is_tracked_without_recursion(self):
+        """The metrics registry's own create lock is in the ordering
+        graph; creating series under ANOTHER tracked lock must neither
+        recurse nor pollute the registry with its own lock metrics."""
+        reg = MetricsRegistry()
+        assert isinstance(reg._lock, TrackedLock)
+        outer = TrackedLock("outer-of-registry", registry=reg)
+        with outer:
+            reg.counter("some_series").inc()
+        edges = lockwatch_state()["edges"]
+        assert "metrics-registry" in edges.get("outer-of-registry", {})
+        snap = reg.snapshot()
+        own = [k for bucket in snap.values()
+               for k in bucket if 'lock="metrics-registry"' in k]
+        assert own == [], (
+            "the registry's own lock must not add series to the "
+            "registries it guards")
+
+    def test_overhead_is_bounded(self):
+        """Loose guard for the <5% tier-1 budget: an uncontended tracked
+        acquire/release pair is micro-fast (no graph work when nothing
+        else is held)."""
+        lock = TrackedLock("bench")
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            with lock:
+                pass
+        assert time.perf_counter() - t0 < 2.0
+
+
+class TestDeadlockInjection:
+    """Satellite 3: the forced-inversion drill over the REAL transport.
+
+    FlakyTransport.hang() wedges a live socket channel silently (the
+    missed-heartbeat failure mode) — recovery code then runs while IO is
+    stuck, which is exactly when ad-hoc lock ordering between the
+    channel lock and the host-tier entry lock inverts. Lockwatch must
+    name the cycle as a structured violation instead of letting the two
+    threads deadlock, and leave a loadable bundle."""
+
+    def test_hung_link_inversion_is_named_and_bundled(
+            self, tmp_path, monkeypatch):
+        from accelerate_tpu.serving.host_tier import _HostEntry
+        from accelerate_tpu.serving.pod.distributed import (
+            FlakyTransport, Message)
+        from accelerate_tpu.serving.pod.distributed.transport import (
+            ChannelListener, SocketChannel)
+
+        monkeypatch.setenv("ACCELERATE_TPU_INCIDENT_DIR", str(tmp_path))
+        listener = ChannelListener("127.0.0.1", 0)
+        try:
+            client = SocketChannel.connect("127.0.0.1", listener.port)
+            server = None
+            for _ in range(200):
+                got = listener.accept_all()
+                if got:
+                    server = got[0]
+                    break
+                time.sleep(0.01)
+            assert server is not None
+            # the wired sites really are tracked under the suite env
+            assert isinstance(client._lock, TrackedLock)
+            assert client._lock.name == "pod-channel"
+            entry = _HostEntry(node=None, device=None)
+            assert isinstance(entry.lock, TrackedLock)
+            assert entry.lock.name == "host-tier-entry"
+
+            flaky = FlakyTransport(server)
+            flaky.hang()            # silent wedge: open link, nothing moves
+            client.send(Message("heartbeat", {"n": 1}))
+            time.sleep(0.1)
+            assert flaky.poll() == [], "hung link must swallow messages"
+            assert flaky.faults["hang"] == 1
+
+            # drain-thread side: entry lock held, then the channel is
+            # polled for the shipment that will never arrive
+            def drain_side():
+                with entry.lock:
+                    flaky.poll()    # takes the channel lock inside
+
+            t = threading.Thread(target=drain_side, name="drain")
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive()
+
+            # IO side inverts: channel lock held, entry lock wanted.
+            # Without lockwatch this is the schedule-away deadlock; with
+            # it the acquire refuses and NAMES the cycle.
+            with pytest.raises(LockOrderViolation) as exc:
+                with server._lock:
+                    with entry.lock:
+                        pass
+            err = exc.value
+            assert err.cycle == ["pod-channel", "host-tier-entry",
+                                 "pod-channel"]
+            assert err.thread == "MainThread"
+            # nothing is left held: the suite continues, not wedges
+            assert not server._lock.locked() and not entry.lock.locked()
+
+            (manifest,) = list_incident_bundles(str(tmp_path))
+            loaded = load_incident_bundle(manifest["path"])
+            report = loaded["files"]["report.json"]
+            assert report["cycle"] == err.cycle
+            assert report["kind"] == "lock_order_violation"
+            # the drain thread's ordering is in the recorded graph
+            assert report["lock_graph"]["host-tier-entry"][
+                "pod-channel"]["thread"] == "drain"
+        finally:
+            client.close()
+            server.close()
+            listener.close()

@@ -205,14 +205,16 @@ def test_chunked_causal_lm_loss_matches_full():
     mask[0, 30:] = 0
     batch = {"input_ids": ids, "attention_mask": mask}
 
-    full = llama.causal_lm_loss(cfg, params, batch, loss_chunk_size=10_000)
-    chunked = llama.causal_lm_loss(cfg, params, batch, loss_chunk_size=16)
-    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
+    # one jitted value_and_grad per variant: same comparison, but two
+    # compiled programs instead of four eager op-by-op walks (~12s -> ~5s)
+    def value_and_grad(chunk):
+        return jax.jit(jax.value_and_grad(
+            lambda p: llama.causal_lm_loss(cfg, p, batch,
+                                           loss_chunk_size=chunk)))
 
-    g_full = jax.grad(lambda p: llama.causal_lm_loss(cfg, p, batch,
-                                                     loss_chunk_size=10_000))(params)
-    g_chunk = jax.grad(lambda p: llama.causal_lm_loss(cfg, p, batch,
-                                                      loss_chunk_size=16))(params)
+    full, g_full = value_and_grad(10_000)(params)
+    chunked, g_chunk = value_and_grad(16)(params)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g_chunk),
                     jax.tree_util.tree_leaves(g_full)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),

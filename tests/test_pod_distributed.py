@@ -356,6 +356,80 @@ def test_socket_send_queue_bounded_backpressure():
         listener.close()
 
 
+def test_socket_close_joins_io_threads():
+    """ATP305 regression: `close()` must reap the reader/writer threads,
+    not just mark the channel closed — a leaked IO thread pins its
+    socket and races interpreter teardown. The reader also closes the
+    channel from its OWN thread on peer death, so the join has to guard
+    against self-join instead of deadlocking."""
+    import time
+
+    listener = ChannelListener("127.0.0.1", 0)
+    try:
+        client = SocketChannel.connect("127.0.0.1", listener.port)
+        server = None
+        for _ in range(200):
+            got = listener.accept_all()
+            if got:
+                server = got[0]
+                break
+            time.sleep(0.01)
+        assert server is not None
+        assert client._reader.is_alive() and client._writer.is_alive()
+        client.close()
+        assert not client._reader.is_alive(), "reader leaked past close()"
+        assert not client._writer.is_alive(), "writer leaked past close()"
+        # peer death path: server's reader notices and closes from inside
+        # the reader thread itself — must finish, not self-join-wedge
+        for _ in range(500):
+            if server.closed:
+                break
+            time.sleep(0.01)
+        assert server.closed
+        server.close()
+        for _ in range(500):
+            if not (server._reader.is_alive() or server._writer.is_alive()):
+                break
+            time.sleep(0.01)
+        assert not server._reader.is_alive()
+        assert not server._writer.is_alive()
+    finally:
+        listener.close()
+
+
+def test_step_never_sleeps_on_the_callers_thread(gpt2_setup, monkeypatch):
+    """ATP303 regression: `step()` runs inline on the asyncio drive loop
+    (astream), so a sleep inside it parks every coroutine on the loop.
+    Pacing belongs to the sync callers, keyed off `last_step_worked` —
+    step itself must never block, idle or busy."""
+    import threading
+    import time as time_mod
+
+    import accelerate_tpu.serving.pod.distributed.droute as droute_mod
+
+    cfg, params = gpt2_setup
+    router, _ = _build_pod(cfg, params)
+    main = threading.current_thread()
+    slept = []
+    real_sleep = time_mod.sleep
+
+    def spy(seconds):
+        if threading.current_thread() is main:
+            slept.append(seconds)
+        real_sleep(seconds)
+
+    monkeypatch.setattr(droute_mod.time, "sleep", spy)
+    for _ in range(20):
+        router.step()                  # idle pod: nothing to do
+    assert router.last_step_worked is False
+    assert slept == [], "idle step() slept on the caller's thread"
+    reqs = _submit_traffic(router, cfg)
+    _drive(router, reqs)
+    assert all(r.done for r in reqs)
+    assert slept == [], "busy step() slept on the caller's thread"
+    router.close()
+
+
 def test_flaky_transport_is_deterministic_and_injects_all_faults():
     def run_once():
         a, b = LocalChannel.pair()

@@ -11,6 +11,8 @@ compile count stays exactly 3."""
 
 import asyncio
 import json
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -1344,3 +1346,67 @@ class TestLogprobsAndForking:
         assert len(choices) == 2
         sums = [sum(c["logprobs"]["token_logprobs"]) for c in choices]
         assert sums == sorted(sums, reverse=True)
+
+
+class TestServiceStopOffLoop:
+    """ATP303 audit fix (ISSUE 19): `InferenceService.stop()` runs
+    `engine.close()` in the default executor — closing joins the
+    watchdog / metrics-server / host-tier threads, seconds of blocking
+    that must not park every other coroutine on the serving loop."""
+
+    class _StubScheduler:
+        queue = ()
+
+        def has_work(self):
+            return False
+
+        def running(self):
+            return ()
+
+    class _StubEngine:
+        watchdog = None
+
+        def __init__(self):
+            self.scheduler = TestServiceStopOffLoop._StubScheduler()
+            self.closed_on = None
+            self.loop_alive_during_close = None
+
+        def cancel(self, req):
+            pass
+
+        def close(self):
+            self.closed_on = threading.current_thread()
+            time.sleep(0.15)  # a watchdog join mid-drain takes this long
+
+    def test_stop_closes_engine_off_the_event_loop(self):
+        from accelerate_tpu.server.service import InferenceService
+        from accelerate_tpu.server.tokenizer import get_tokenizer
+
+        engine = self._StubEngine()
+        service = InferenceService(engine, get_tokenizer("auto", 256),
+                                   ServerConfig(port=0, drain_timeout_s=0.1))
+
+        async def scenario():
+            await service.start()
+            beats = []
+
+            async def heartbeat():
+                while True:
+                    beats.append(time.monotonic())
+                    await asyncio.sleep(0.01)
+
+            hb = asyncio.get_running_loop().create_task(heartbeat())
+            before = len(beats)
+            await service.stop()
+            hb.cancel()
+            # the loop kept beating while close() slept in the executor
+            engine.loop_alive_during_close = len(beats) - before
+            return threading.current_thread()
+
+        loop_thread = asyncio.run(scenario())
+        assert engine.closed_on is not None, "stop() never closed the engine"
+        assert engine.closed_on is not loop_thread, (
+            "engine.close() ran ON the event loop thread — the ATP303 "
+            "blocking-call fix regressed")
+        assert engine.loop_alive_during_close >= 5, (
+            "event loop starved during engine teardown")
