@@ -31,6 +31,12 @@ _LAZY = {
     "AcceleratedScheduler": ".scheduler",
     "TrainState": ".training",
     "DynamicLossScale": ".training",
+    "run_resilient": ".training",
+    "ResilienceReport": ".training",
+    "resume_latest": ".checkpointing",
+    "latest_complete_checkpoint": ".checkpointing",
+    "prune_checkpoints": ".checkpointing",
+    "wait_for_checkpoints": ".checkpointing",
     "prepare_data_loader": ".data",
     "skip_first_batches": ".data",
     "DataLoaderShard": ".data",
@@ -72,6 +78,7 @@ _LAZY = {
     "render_prometheus": ".telemetry",
     "aggregate_snapshot": ".telemetry",
     "StallWatchdog": ".telemetry",
+    "StragglerMonitor": ".telemetry",
     "AnalysisViolation": ".analysis",
     "CollectiveContract": ".analysis",
     "Finding": ".analysis",

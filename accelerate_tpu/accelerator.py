@@ -1611,6 +1611,34 @@ class Accelerator:
         self.step = int(result.get("step", 0))
         return result
 
+    def resume_latest(self, input_dir: str | None = None,
+                      state: TrainState | None = None, **kwargs):
+        """Preemption-tolerant restart: restore from the newest COMPLETE
+        checkpoint (committed manifest, all files present) under
+        `input_dir` (default: the project checkpoints dir). Torn saves —
+        a crash at any byte offset of a prior save — are skipped. Returns
+        the `load_state`-shaped result dict plus `checkpoint_dir` /
+        `manifest`, or None when nothing committed exists (fresh start)."""
+        from .checkpointing import resume_latest
+
+        if input_dir is None:
+            input_dir = os.path.join(
+                self.project_configuration.project_dir or ".", "checkpoints")
+        for hook in self._load_model_state_pre_hook.values():
+            hook(self._models, input_dir)
+        result = resume_latest(
+            input_dir,
+            train_states=[state] if state is not None else [],
+            optimizers=self._optimizers,
+            schedulers=self._schedulers,
+            dataloaders=self._dataloaders,
+            custom_objects=self._custom_objects,
+            **kwargs,
+        )
+        if result is not None:
+            self.step = int(result.get("step", 0))
+        return result
+
     def _checkpoint_dir(self, new: bool) -> str:
         """Versioned dir resolution. On a shared filesystem, EVERY process
         must agree on the index: the main process lists/prunes and broadcasts

@@ -102,6 +102,12 @@ PAIRING_TABLE: tuple = (
                  release=("release", "rollback"), receivers=("allocator",)),
     ResourcePair("slot-claim", acquire=("adopt_running",),
                  release=("free", "rollback"), receivers=("scheduler",)),
+    # the checkpoint manifest commit protocol (ISSUE 20): a staged
+    # snapshot must publish its manifest (commit) or be abandoned
+    # (rollback) on every path — a dropped handle is a checkpoint that
+    # never becomes loadable and a retention pass that can't see it
+    ResourcePair("checkpoint-snapshot", acquire=("stage",),
+                 release=("commit", "rollback"), receivers=("stager",)),
 )
 
 

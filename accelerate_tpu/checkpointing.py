@@ -22,6 +22,7 @@ import json
 import os
 import pickle
 import random as _py_random
+import threading
 import time
 from typing import Any
 
@@ -30,6 +31,7 @@ import numpy as np
 
 from .logging import get_logger
 from .state import PartialState
+from .telemetry.lockwatch import maybe_tracked
 from .telemetry.registry import get_registry
 from .telemetry.trace import span
 from .utils.constants import (
@@ -40,6 +42,14 @@ from .utils.constants import (
     SAFE_WEIGHTS_NAME,
     SAMPLER_NAME,
     SCHEDULER_NAME,
+)
+from .utils.manifest import (
+    MANIFEST_NAME,
+    is_complete,
+    latest_complete,
+    prune_complete,
+    read_manifest,
+    write_manifest,
 )
 from .utils.other import flatten_dict, unflatten_dict
 
@@ -59,38 +69,221 @@ def _checkpointer():
 # ONE shared AsyncCheckpointer: orbax serializes saves on it (each save()
 # first waits out the previous one), so at most one write is in flight,
 # back-to-back saves to the same directory can't race, and host RAM holds at
-# most one extra staged copy.
-_async_state: dict = {"ckptr": None, "inflight": 0}
+# most one extra staged copy. The ENQUEUE itself also rides a dedicated
+# single writer thread (ISSUE 20): ocp's save() call blocks on directory
+# setup and the previous write's drain — tens of ms the training loop
+# shouldn't pay. In-loop cost of an async save is therefore just the
+# device->host snapshot; everything else overlaps subsequent steps.
+_async_state: dict = {"ckptr": None, "inflight": 0, "executor": None,
+                      "futures": []}
+_async_init_lock = threading.Lock()
 
 
 def _get_async_checkpointer():
-    if _async_state["ckptr"] is None:
-        import atexit
+    # construction is SECONDS on some hosts (thread pools, tensorstore
+    # init), so it normally happens on the writer thread — the lock keeps a
+    # concurrent warm_async_checkpointer() from double-building it
+    with _async_init_lock:
+        if _async_state["ckptr"] is None:
+            import atexit
 
-        import orbax.checkpoint as ocp
+            import orbax.checkpoint as ocp
 
-        _async_state["ckptr"] = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-        atexit.register(_close_async_checkpointer)
-    return _async_state["ckptr"]
+            _async_state["ckptr"] = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+            atexit.register(_close_async_checkpointer)
+        return _async_state["ckptr"]
+
+
+def _get_enqueue_executor():
+    if _async_state["executor"] is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # max_workers=1 — submission order IS write order, preserving the
+        # serializing checkpointer's back-to-back guarantees
+        _async_state["executor"] = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ckpt-enqueue")
+    return _async_state["executor"]
+
+
+def warm_async_checkpointer() -> None:
+    """Pay the one-time async-writer setup (orbax AsyncCheckpointer
+    construction and the torch import the RNG capture needs — seconds on
+    some hosts) OUTSIDE the measured training window. Idempotent; the first
+    `save_state(async_save=True)` does it implicitly otherwise."""
+    _get_async_checkpointer()
+    _get_enqueue_executor()
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        pass
 
 
 def _close_async_checkpointer() -> None:
     ckptr = _async_state["ckptr"]
+    executor = _async_state["executor"]
     _async_state["ckptr"] = None
+    _async_state["executor"] = None
     _async_state["inflight"] = 0
+    _async_state["futures"] = []
+    if executor is not None:
+        try:
+            executor.shutdown(wait=True)
+        except Exception:  # pragma: no cover - interpreter shutdown
+            pass
     if ckptr is not None:
+        try:
+            # drain before close: close() tears down the metadata store,
+            # and a still-running background commit would race it
+            ckptr.wait_until_finished()
+        except Exception:
+            pass
         try:
             ckptr.close()
         except Exception:  # pragma: no cover - interpreter shutdown
             pass
 
 
+# ---------------------------------------------------------------------------
+# manifest commit protocol (ISSUE 20): a checkpoint directory is loadable
+# iff its manifest committed — written-then-renamed strictly after the bytes
+# it lists are durable, so a crash at any byte offset leaves either a
+# complete checkpoint or an ignorable partial one, never a torn restore.
+# ---------------------------------------------------------------------------
+
+
+class _PendingCommit:
+    """One staged-but-unpublished checkpoint: the handle
+    `_SnapshotStager.stage` returns and `commit`/`rollback` consume.
+    `add` registers files the manifest will list."""
+
+    __slots__ = ("directory", "step", "files", "main", "deferred")
+
+    def __init__(self, directory: str, step: int, main: bool):
+        self.directory = directory
+        self.step = int(step)
+        self.files: set[str] = set()
+        self.main = main
+        self.deferred = False
+
+    def add(self, *names: str) -> None:
+        self.files.update(names)
+
+
+class _SnapshotStager:
+    """Bookkeeping for the commit protocol. `stage` opens a pending
+    commit; `commit(pending)` publishes the manifest — immediately when
+    the writes were synchronous, else the pending parks on the sealed
+    list until the async writer proves durability (`flush_ready`, called
+    after a drain or after the serializing checkpointer accepts a newer
+    save); `rollback(pending)` abandons it, leaving an incomplete
+    directory resume will skip. The sealed list is shared with the
+    background-drain callers, hence the tracked lock."""
+
+    def __init__(self):
+        self._lock = maybe_tracked("checkpoint-commit")
+        self._sealed: list[_PendingCommit] = []
+
+    def stage(self, output_dir: str, step: int) -> _PendingCommit:
+        return _PendingCommit(_abspath(output_dir), step,
+                              PartialState().is_main_process)
+
+    def commit(self, pending: _PendingCommit, *, deferred: bool = False) -> None:
+        if not deferred:
+            self._publish(pending)
+            return
+        pending.deferred = True
+        with self._lock:
+            self._sealed.append(pending)
+
+    def rollback(self, pending: _PendingCommit) -> None:
+        with self._lock:
+            if pending in self._sealed:
+                self._sealed.remove(pending)
+        get_registry().counter("checkpoint_rollbacks_total").inc()
+
+    def flush_ready(self) -> int:
+        """Publish every sealed manifest. Call ONLY at points where the
+        sealed saves' bytes are proven durable: after
+        `wait_until_finished`, or right after the serializing
+        AsyncCheckpointer accepted a newer save (it waits out all earlier
+        ones first)."""
+        with self._lock:
+            ready, self._sealed = self._sealed, []
+        for pending in ready:
+            self._publish(pending)
+        return len(ready)
+
+    def drop_sealed(self) -> int:
+        """Abandon sealed-but-unpublished commits (failed drain): their
+        directories stay incomplete and resume skips them."""
+        with self._lock:
+            dropped, self._sealed = self._sealed, []
+        if dropped:
+            get_registry().counter(
+                "checkpoint_rollbacks_total").inc(len(dropped))
+        return len(dropped)
+
+    def sealed_dirs(self) -> list[str]:
+        with self._lock:
+            return [p.directory for p in self._sealed]
+
+    def _publish(self, pending: _PendingCommit) -> None:
+        if pending.main:
+            write_manifest(pending.directory, step=pending.step,
+                           files=pending.files)
+        get_registry().counter("checkpoint_commits_total").inc()
+
+
+_stager_state: dict = {"stager": None}
+
+
+def _stager() -> _SnapshotStager:
+    if _stager_state["stager"] is None:
+        _stager_state["stager"] = _SnapshotStager()
+    return _stager_state["stager"]
+
+
+def _stage_to_host(tree: Any) -> Any:
+    """Donation-safe device->host snapshot: the training loop may donate
+    (and overwrite) the live buffers on the very next step, so the async
+    writer must hold its own host copy. Non-fully-addressable arrays
+    (ZeRO-sharded / multi-host, incl. the fp8 metas riding the same save
+    path) stay live — orbax streams only each host's local shards, and
+    those buffers are never donation targets across hosts."""
+    def _leaf(x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            return jax.device_get(x)
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
 def _save_pytree(tree: Any, path: str, async_save: bool = False) -> None:
     if async_save:
         import orbax.checkpoint as ocp
 
-        ckptr = _get_async_checkpointer()
-        ckptr.save(_abspath(path), args=ocp.args.StandardSave(tree), force=True)
+        t0 = time.perf_counter()
+        with span("checkpoint.stage"):
+            tree = _stage_to_host(tree)
+        get_registry().histogram("checkpoint_stage_seconds").record(
+            time.perf_counter() - t0)
+        target = _abspath(path)
+
+        def _enqueue():
+            # checkpointer resolution INSIDE the job: first-use construction
+            # costs seconds and must not stall the training loop
+            ckptr = _get_async_checkpointer()
+            ckptr.save(target, args=ocp.args.StandardSave(tree), force=True)
+            # the serializing checkpointer just waited out every EARLIER
+            # save before accepting this one: their bytes are durable, so
+            # their manifests can publish now without blocking training
+            _stager().flush_ready()
+
+        # even the ENQUEUE blocks for tens of ms (directory setup + draining
+        # the previous write), so it rides the single writer thread; the
+        # training loop pays only the device->host snapshot above
+        _async_state["futures"].append(_get_enqueue_executor().submit(_enqueue))
         _async_state["inflight"] += 1
         return
     ckptr = _checkpointer()
@@ -104,16 +297,25 @@ def wait_for_checkpoints() -> int:
     overlap the device->disk write). Returns how many were drained. A failed
     background write re-raises here after the checkpointer is torn down, so
     later saves start from a clean slate."""
-    ckptr = _async_state["ckptr"]
     drained = _async_state["inflight"]
-    if ckptr is None or drained == 0:
+    if drained == 0:
         _async_state["inflight"] = 0
+        _stager().flush_ready()
         return 0
     t0 = time.perf_counter()
     with span("checkpoint.drain"):
         try:
-            ckptr.wait_until_finished()
+            futures, _async_state["futures"] = _async_state["futures"], []
+            for fut in futures:
+                fut.result()  # re-raise enqueue failures from the writer
+            ckptr = _async_state["ckptr"]
+            if ckptr is not None:
+                ckptr.wait_until_finished()
         except Exception:
+            # the sealed manifests must NOT publish: their bytes never
+            # became durable. Their directories stay incomplete, so
+            # resume_latest falls back to the previous complete commit.
+            _stager().drop_sealed()
             _close_async_checkpointer()
             raise
     # how long training actually BLOCKED on the async writer — the number
@@ -121,6 +323,7 @@ def wait_for_checkpoints() -> int:
     get_registry().histogram("checkpoint_drain_seconds").record(
         time.perf_counter() - t0)
     _async_state["inflight"] = 0
+    _stager().flush_ready()
     return drained
 
 
@@ -228,72 +431,98 @@ def _save_accelerator_state(
     state = PartialState()
     output_dir = _abspath(output_dir)
     os.makedirs(output_dir, exist_ok=True)
-
-    for i, ts in enumerate(train_states):
-        _save_pytree(_train_state_payload(ts),
-                     os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
-                     async_save=async_save)
-        if getattr(ts, "fp8_state", None) is not None:
-            # separate dir + window-length sidecar: restore builds its
-            # like-tree against the ON-DISK amax window, so a recipe change
-            # (e.g. the old 1024 default -> today's 16) adapts instead of
-            # failing orbax's shape check
-            from .ops.fp8 import fp8_state_history_len
-
-            _save_pytree({"fp8_state": ts.fp8_state},
-                         os.path.join(output_dir, f"{MODEL_NAME}_{i}_fp8"),
-                         async_save=async_save)
-            if state.is_main_process:
-                with open(os.path.join(output_dir,
-                                       f"{MODEL_NAME}_{i}_fp8.json"), "w") as f:
-                    json.dump(
-                        {"amax_history_len": fp8_state_history_len(ts.fp8_state)},
-                        f,
-                    )
-    for i, opt in enumerate(optimizers):
-        payload = {}
-        if opt.opt_state is not None:
-            payload["opt_state"] = opt.opt_state
-        if opt.params is not None:
-            # the eager path's live weights live on the optimizer facade —
-            # they must round-trip too (ref saves model.safetensors alongside
-            # optimizer.bin, checkpointing.py:51-133)
-            payload["params"] = opt.params
-        if payload:
-            _save_pytree(payload, os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"),
-                         async_save=async_save)
-
-    if state.is_main_process:
-        for i, sched in enumerate(schedulers):
-            with open(os.path.join(output_dir, f"{SCHEDULER_NAME}_{i}.bin"), "wb") as f:
-                pickle.dump(sched.state_dict(), f)
-        for i, loader in enumerate(dataloaders):
-            with open(os.path.join(output_dir, f"{SAMPLER_NAME}_{i}.bin"), "wb") as f:
-                pickle.dump({"epoch": getattr(loader, "epoch", 0)}, f)
-        for i, obj in enumerate(custom_objects):
-            with open(os.path.join(output_dir, f"custom_checkpoint_{i}.pkl"), "wb") as f:
-                pickle.dump(obj.state_dict(), f)
-        with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
-            json.dump({"step": step}, f)
-
-    # per-rank host RNG streams (ref checkpointing.py:134-148). JAX model keys
-    # are explicit in TrainState/seeds, so only host libs are captured.
-    rng_states: dict[str, Any] = {
-        "python": _py_random.getstate(),
-        "numpy": np.random.get_state(),
-    }
+    stager = _stager()
+    pending = stager.stage(output_dir, step)
     try:
-        import torch
+        for i, ts in enumerate(train_states):
+            _save_pytree(_train_state_payload(ts),
+                         os.path.join(output_dir, f"{MODEL_NAME}_{i}"),
+                         async_save=async_save)
+            pending.add(f"{MODEL_NAME}_{i}")
+            if getattr(ts, "fp8_state", None) is not None:
+                # separate dir + window-length sidecar: restore builds its
+                # like-tree against the ON-DISK amax window, so a recipe
+                # change (e.g. the old 1024 default -> today's 16) adapts
+                # instead of failing orbax's shape check
+                from .ops.fp8 import fp8_state_history_len
 
-        rng_states["torch"] = torch.get_rng_state()
-    except ImportError:
-        pass
-    with open(
-        os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl"), "wb"
-    ) as f:
-        pickle.dump(rng_states, f)
+                _save_pytree({"fp8_state": ts.fp8_state},
+                             os.path.join(output_dir, f"{MODEL_NAME}_{i}_fp8"),
+                             async_save=async_save)
+                pending.add(f"{MODEL_NAME}_{i}_fp8")
+                if state.is_main_process:
+                    with open(os.path.join(
+                            output_dir, f"{MODEL_NAME}_{i}_fp8.json"), "w") as f:
+                        json.dump(
+                            {"amax_history_len":
+                                 fp8_state_history_len(ts.fp8_state)},
+                            f,
+                        )
+                    pending.add(f"{MODEL_NAME}_{i}_fp8.json")
+        for i, opt in enumerate(optimizers):
+            payload = {}
+            if opt.opt_state is not None:
+                payload["opt_state"] = opt.opt_state
+            if opt.params is not None:
+                # the eager path's live weights live on the optimizer
+                # facade — they must round-trip too (ref saves
+                # model.safetensors alongside optimizer.bin,
+                # checkpointing.py:51-133)
+                payload["params"] = opt.params
+            if payload:
+                _save_pytree(payload,
+                             os.path.join(output_dir, f"{OPTIMIZER_NAME}_{i}"),
+                             async_save=async_save)
+                pending.add(f"{OPTIMIZER_NAME}_{i}")
 
-    state.wait_for_everyone()
+        if state.is_main_process:
+            for i, sched in enumerate(schedulers):
+                with open(os.path.join(
+                        output_dir, f"{SCHEDULER_NAME}_{i}.bin"), "wb") as f:
+                    pickle.dump(sched.state_dict(), f)
+                pending.add(f"{SCHEDULER_NAME}_{i}.bin")
+            for i, loader in enumerate(dataloaders):
+                with open(os.path.join(
+                        output_dir, f"{SAMPLER_NAME}_{i}.bin"), "wb") as f:
+                    pickle.dump({"epoch": getattr(loader, "epoch", 0)}, f)
+                pending.add(f"{SAMPLER_NAME}_{i}.bin")
+            for i, obj in enumerate(custom_objects):
+                with open(os.path.join(
+                        output_dir, f"custom_checkpoint_{i}.pkl"), "wb") as f:
+                    pickle.dump(obj.state_dict(), f)
+                pending.add(f"custom_checkpoint_{i}.pkl")
+            with open(os.path.join(output_dir, "accelerator_state.json"), "w") as f:
+                json.dump({"step": step}, f)
+            pending.add("accelerator_state.json")
+
+        # per-rank host RNG streams (ref checkpointing.py:134-148). JAX model
+        # keys are explicit in TrainState/seeds, so only host libs are
+        # captured. The manifest lists only rank 0's stream — the one file
+        # every resuming host can rely on existing.
+        rng_states: dict[str, Any] = {
+            "python": _py_random.getstate(),
+            "numpy": np.random.get_state(),
+        }
+        try:
+            import torch
+
+            rng_states["torch"] = torch.get_rng_state()
+        except ImportError:
+            pass
+        with open(
+            os.path.join(output_dir, f"{RNG_STATE_NAME}_{state.process_index}.pkl"),
+            "wb",
+        ) as f:
+            pickle.dump(rng_states, f)
+        pending.add(f"{RNG_STATE_NAME}_0.pkl")
+
+        state.wait_for_everyone()
+    except BaseException:
+        # abandon the commit: the directory stays manifest-less and
+        # resume_latest falls back to the previous complete checkpoint
+        stager.rollback(pending)
+        raise
+    stager.commit(pending, deferred=async_save)
     logger.info(f"Checkpoint saved to {output_dir}")
     return output_dir
 
@@ -414,6 +643,70 @@ def _load_accelerator_state(
 
     logger.info(f"Checkpoint loaded from {input_dir}")
     return out
+
+
+# ---------------------------------------------------------------------------
+# preemption-tolerant auto-resume (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def is_complete_checkpoint(directory: str) -> bool:
+    """True iff `directory` carries a committed manifest whose files all
+    exist — i.e. resume_latest would consider it."""
+    return is_complete(directory)
+
+
+def latest_complete_checkpoint(base_dir: str) -> str | None:
+    """Newest complete checkpoint under `base_dir` (or `base_dir` itself
+    when it carries a manifest), ordered by (manifest step, commit time);
+    None when nothing committed. Torn/uncommitted directories — a crash
+    mid-save at any byte offset — are skipped, never errors."""
+    return latest_complete(base_dir)
+
+
+def resume_latest(
+    input_dir: str,
+    train_states: list = (),
+    optimizers: list = (),
+    schedulers: list = (),
+    dataloaders: list = (),
+    custom_objects: list = (),
+    load_rng: bool = True,
+) -> dict | None:
+    """Restore from the newest COMPLETE checkpoint under `input_dir`:
+    step count, params/opt state, host RNG streams, dataloader epoch —
+    everything `load_accelerator_state` round-trips. Returns its result
+    dict plus `checkpoint_dir` and `manifest`, or None when no complete
+    checkpoint exists (a fresh start, not an error)."""
+    t0 = time.perf_counter()
+    path = latest_complete(_abspath(input_dir))
+    if path is None:
+        return None
+    out = load_accelerator_state(
+        path,
+        train_states=train_states,
+        optimizers=optimizers,
+        schedulers=schedulers,
+        dataloaders=dataloaders,
+        custom_objects=custom_objects,
+        load_rng=load_rng,
+    )
+    out["checkpoint_dir"] = path
+    out["manifest"] = read_manifest(path)
+    reg = get_registry()
+    reg.counter("checkpoint_resumes_total").inc()
+    reg.histogram("resume_latency_seconds").record(time.perf_counter() - t0)
+    return out
+
+
+def prune_checkpoints(base_dir: str, keep_last_n: int) -> list[str]:
+    """Retention: delete all but the newest `keep_last_n` complete
+    checkpoints under `base_dir` (clamped so the newest complete commit
+    always survives). Directories whose async writes are still sealing
+    are protected; incomplete directories are left alone (they may be
+    mid-write). Returns the removed paths."""
+    return prune_complete(base_dir, keep_last_n,
+                          protected=_stager().sealed_dirs())
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,9 @@ _HIGHER_IS_BETTER = {
     # the A/B row's chunk ratio is the headline (no-tier chunks over
     # with-tier chunks, >= 2x on the churn workload)
     "prefix_hits_host", "prefix_dedup_hits", "prefill_chunk_ratio",
+    # resilient training (ISSUE 20): goodput of the run_resilient loop —
+    # useful step time over wall, with the checkpoint/resume machinery on
+    "resilient",
 }
 _LOWER_IS_BETTER = {
     "ttft_p50_ms", "ttft_p99_ms", "ttft_mean_ms",
@@ -82,6 +85,11 @@ _LOWER_IS_BETTER = {
     # round to zero — a regression here is instrumentation on the hot
     # path
     "pod_span_export_lag_s", "pod_trace_overhead_pct",
+    # resilient training (ISSUE 20): how long the loop BLOCKS on the
+    # async checkpoint writer, and how long a preempted run takes to
+    # find + restore the newest complete manifest
+    "checkpoint_drain_p99_s", "checkpoint_drain_mean_s",
+    "checkpoint_stage_mean_s", "resume_latency_s",
 }
 
 

@@ -168,6 +168,13 @@ class StepTimer:
     # goodput = useful step-time / wall-time over this window
     _window_start: float | None = None
     _window_end: float | None = None
+    # the very first tick: window_start - first_tick is the warmup
+    # (compile + first dispatch) wall time, the "compile" taxonomy bucket
+    _first_tick: float | None = None
+    # stall taxonomy: seconds per overhead kind (tagged overhead()
+    # windows) and per externally attributed cause (note_lost)
+    _overhead_kinds: dict = field(default_factory=dict, repr=False)
+    _attributed: dict = field(default_factory=dict, repr=False)
     _step_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
     _dispatch_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
     _stall_hist: StreamingHistogram = field(default=None, repr=False)  # type: ignore[assignment]
@@ -196,6 +203,9 @@ class StepTimer:
         self._last = None
         self._seen = self._dispatch_seen = self._stall_seen = 0
         self._window_start = self._window_end = None
+        self._first_tick = None
+        self._overhead_kinds.clear()
+        self._attributed.clear()
 
     def tick(self, block_on: Any = None) -> float | None:
         """Record one step boundary; returns this step's seconds (or None
@@ -204,6 +214,8 @@ class StepTimer:
         if block_on is not None:
             jax.block_until_ready(block_on)
         now = time.perf_counter()
+        if self._first_tick is None:
+            self._first_tick = now
         elapsed = None
         if self._last is not None:
             self._seen += 1
@@ -241,19 +253,59 @@ class StepTimer:
             self._stall_hist.record(time.perf_counter() - t0)
 
     @contextlib.contextmanager
-    def overhead(self) -> Iterator[None]:
+    def overhead(self, kind: str | None = None) -> Iterator[None]:
         """Mark non-step wall time the loop KNOWS about (a checkpoint
         save, an eval pass, a log flush) so `goodput` can subtract it.
         Tick-to-tick intervals tile the wall clock, so unmarked work
         between ticks is indistinguishable from step time — this marker
         is how a training loop makes its goodput honest::
 
-            with timer.overhead():
-                accelerator.save_state(path)
+            with timer.overhead("checkpoint_stage"):
+                accelerator.save_state(path, async_save=True)
+
+        `kind` tags the window for `stall_taxonomy()` ("checkpoint_stage",
+        "checkpoint_drain", "eval", ...); untagged windows bucket under
+        "other".
         """
         t0 = time.perf_counter()
         yield
-        self._overhead_hist.record(time.perf_counter() - t0)
+        elapsed = time.perf_counter() - t0
+        self._overhead_hist.record(elapsed)
+        key = kind or "other"
+        self._overhead_kinds[key] = self._overhead_kinds.get(key, 0.0) + elapsed
+
+    def note_lost(self, kind: str, seconds: float) -> None:
+        """Attribute externally-diagnosed lost time (e.g. the straggler
+        monitor's slowest-host excess) into the taxonomy WITHOUT touching
+        goodput: that time already sits inside measured step intervals —
+        this labels its cause, it does not subtract it twice."""
+        self._attributed[kind] = (
+            self._attributed.get(kind, 0.0) + float(seconds))
+
+    def stall_taxonomy(self) -> dict[str, float]:
+        """Where the wall clock went, in seconds over the goodput window:
+        `step` (useful), `input` (pipeline stalls), one entry per tagged
+        overhead kind (`checkpoint_stage`, `checkpoint_drain`, `other`,
+        ...), `compile` (warmup wall time BEFORE the window opened —
+        attribution only, the goodput window already excludes it), plus
+        externally attributed causes (`straggler`, via `note_lost`).
+        Empty before any step records."""
+        if not self._step_hist.count or self._window_start is None:
+            return {}
+        stall = self._stall_hist.sum if self._stall_hist.count else 0.0
+        overhead = self._overhead_hist.sum if self._overhead_hist.count else 0.0
+        out = {
+            "step": max(0.0, self._step_hist.sum - stall - overhead),
+            "input": stall,
+        }
+        for kind, sec in self._overhead_kinds.items():
+            out[kind] = out.get(kind, 0.0) + sec
+        if self._first_tick is not None \
+                and self._window_start > self._first_tick:
+            out["compile"] = self._window_start - self._first_tick
+        for kind, sec in self._attributed.items():
+            out[kind] = out.get(kind, 0.0) + sec
+        return out
 
     @property
     def host_dispatch_us(self) -> float:

@@ -64,6 +64,7 @@ from .export import (
     write_snapshot,
 )
 from .aggregate import aggregate_flat, aggregate_snapshot
+from .straggler import StragglerMonitor
 from .cost import (
     COST_SAMPLE_EVERY_ENV,
     CostTable,
@@ -125,6 +126,7 @@ __all__ = [
     "METRICS_HOST_ENV",
     "aggregate_snapshot",
     "aggregate_flat",
+    "StragglerMonitor",
     "CostTable",
     "ProgramCost",
     "device_peaks",

@@ -203,6 +203,14 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
     if "mfu" in train_sheet:
         goodput_row["train_mfu_measured"] = round(train_sheet["mfu"], 5)
 
+    # resilient-loop smoke (ISSUE 20): the SAME compiled step through
+    # run_resilient with periodic step-overlapped saves — goodput with the
+    # loop on, what draining the async writer actually cost, and proof the
+    # resilience plumbing recompiles nothing. A retried bench attempt
+    # (BENCH_RESUME_DIR set by the parent) resumes from the previous
+    # attempt's newest complete manifest instead of starting over.
+    goodput_row.update(_resilience_smoke(acc, step, ts, batch_arrays, steps))
+
     n_chips = jax.device_count()
     tokens_per_step = batch * seq
     tokens_per_sec_per_chip = tokens_per_step * steps / dt / n_chips
@@ -262,6 +270,54 @@ def run_bench(error: str | None, require_tpu: bool = False) -> dict | None:
         else:
             result["error"] = error
     return result
+
+
+def _resilience_smoke(acc, step, ts, batch_arrays, steps) -> dict:
+    """Fold the resilience loop into the bench (ISSUE 20): run the SAME
+    compiled step through `run_resilient` with periodic async saves.
+    Quotes the loop's goodput, the drain/stage costs from the telemetry
+    histograms, the resume latency when an earlier attempt's commit was
+    picked up, and the compile-counter deltas (must be 0 — the loop adds
+    no retraces)."""
+    import tempfile
+
+    from accelerate_tpu import checkpointing as ckpt
+    from accelerate_tpu.profiler import StepTimer
+    from accelerate_tpu.telemetry import get_registry
+    from accelerate_tpu.training import run_resilient
+
+    ckpt_dir = os.environ.get("BENCH_RESUME_DIR") or tempfile.mkdtemp(
+        prefix="bench_resilient_")
+    # one-time writer setup (orbax construction, torch import) happens
+    # OUTSIDE the goodput window, as a real long run would have it
+    ckpt.warm_async_checkpointer()
+    pins0 = getattr(step, "_pin_computations", 0)
+    aot0 = getattr(step, "_aot_compiles", 0)
+    timer = StepTimer(warmup_steps=1, name="bench_resilient")
+    num = max(6, steps)
+    rep = run_resilient(
+        acc, ts, step, lambda i: batch_arrays, num, ckpt_dir,
+        save_every=max(2, num // 3), keep_last_n=2, timer=timer)
+    row = {
+        "resilient": round(rep.goodput, 4),
+        "resumes": rep.resumes,
+        "saves": rep.saves,
+        "attempts": int(os.environ.get("BENCH_ATTEMPT", "0")) + 1,
+        "resumed_from_step": rep.start_step,
+        "train_pin_computations": getattr(step, "_pin_computations", 0) - pins0,
+        "train_aot_compiles": getattr(step, "_aot_compiles", 0) - aot0,
+    }
+    drain = get_registry().histogram("checkpoint_drain_seconds").summary()
+    if drain.get("count"):
+        row["checkpoint_drain_p99_s"] = round(drain["p99"], 4)
+        row["checkpoint_drain_mean_s"] = round(drain["mean"], 4)
+    stage = get_registry().histogram("checkpoint_stage_seconds").summary()
+    if stage.get("count"):
+        row["checkpoint_stage_mean_s"] = round(stage["mean"], 4)
+    resume = get_registry().histogram("resume_latency_seconds").summary()
+    if resume.get("count"):
+        row["resume_latency_s"] = round(resume["mean"], 4)
+    return row
 
 
 def _load_serve_bench():
@@ -642,11 +698,20 @@ def main() -> None:
         return
     # bounded retry-with-backoff: the tunnel flaps (down since r03, and a
     # transient drop used to cost the whole TPU row on the spot) — only
-    # after every attempt fails is the run declared degraded
+    # after every attempt fails is the run declared degraded. All attempts
+    # share one resume dir: an attempt killed mid-run leaves its newest
+    # COMPLETE manifest behind, and the retry's resilient loop picks it up
+    # instead of starting over (extra.goodput.attempts/resumed_from_step
+    # record that it happened).
+    import tempfile
+
+    resume_dir = tempfile.mkdtemp(prefix="bench_resume_")
     for attempt in range(_TPU_RETRIES + 1):
         try:
             rc, line, tail = _spawn_child("train", _TPU_TIMEOUT,
-                                          JAX_PLATFORMS="")
+                                          JAX_PLATFORMS="",
+                                          BENCH_ATTEMPT=str(attempt),
+                                          BENCH_RESUME_DIR=resume_dir)
             if rc == 0 and line:
                 _emit(json.loads(line), cpu=False)
                 return

@@ -15,6 +15,11 @@ probe on the spot — attempts retry with exponential backoff
 (`TUNNEL_PROBE_RETRIES`, default 2; `TUNNEL_PROBE_BACKOFF_S`, default 5)
 and only after every attempt fails does the probe emit its error line
 (still one parseable JSON line, exit 0 — same contract as bench.py).
+Attempts share a PROGRESS MANIFEST (the same atomic write-then-rename
+commit protocol checkpoints use, ISSUE 20): each completed size commits,
+so a retry resumes at the first unmeasured size instead of re-paying the
+256MB transfer that probably triggered the flap. The line reports
+`extra.attempts` and `extra.resumed_sizes`.
 
 Run: python benchmarks/tunnel_probe.py   (prints one JSON line)
 """
@@ -23,17 +28,39 @@ from __future__ import annotations
 
 import json
 import os
+import tempfile
 import time
 
 
-def _probe() -> dict:
+def _manifest_mod():
+    try:
+        from accelerate_tpu.utils import manifest
+    except ImportError:  # invoked from inside benchmarks/
+        import sys
+
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from accelerate_tpu.utils import manifest
+    return manifest
+
+
+def _probe(state_dir: str | None = None) -> dict:
     import jax
     import numpy as np
 
     dev = jax.devices()[0]
     sizes_mb = [1, 16, 64, 256]
     rows = {}
+    resumed = 0
+    manifest = _manifest_mod() if state_dir else None
+    if manifest is not None:
+        committed = manifest.read_manifest(state_dir)
+        if committed:
+            rows.update((committed.get("extra") or {}).get("rows") or {})
+            resumed = len(rows)
     for mb in sizes_mb:
+        if f"{mb}MB" in rows:
+            continue  # committed by a previous attempt — don't re-pay it
         arr = np.zeros((mb * 2**20 // 4,), np.float32)
         # warm once (allocator, program setup)
         jax.block_until_ready(jax.device_put(arr, dev))
@@ -46,6 +73,9 @@ def _probe() -> dict:
             "seconds": round(best, 4),
             "MB_per_s": round(mb / best, 1),
         }
+        if manifest is not None:
+            manifest.write_manifest(state_dir, step=len(rows),
+                                    extra={"rows": rows})
     # per-call fixed cost via a tiny transfer
     tiny = np.zeros((16,), np.float32)
     jax.block_until_ready(jax.device_put(tiny, dev))
@@ -59,19 +89,20 @@ def _probe() -> dict:
         "value": rows["256MB"]["MB_per_s"],
         "unit": "MB/s@256MB",
         "extra": {"sizes": rows, "per_call_ms": round(per_call_ms, 2),
-                  "device": str(dev)},
+                  "device": str(dev), "resumed_sizes": resumed},
     }
 
 
 def main() -> None:
     retries = int(os.environ.get("TUNNEL_PROBE_RETRIES", "2"))
     backoff = float(os.environ.get("TUNNEL_PROBE_BACKOFF_S", "5"))
+    state_dir = (os.environ.get("TUNNEL_PROBE_STATE_DIR")
+                 or tempfile.mkdtemp(prefix="tunnel_probe_"))
     last_error = None
     for attempt in range(retries + 1):
         try:
-            result = _probe()
-            if attempt:
-                result["extra"]["attempts"] = attempt + 1
+            result = _probe(state_dir)
+            result["extra"]["attempts"] = attempt + 1
             print(json.dumps(result))
             return
         except Exception as e:  # a flap, not necessarily an outage

@@ -1,0 +1,89 @@
+"""Fault-injection victim for test_crash_resume.py: SIGKILL mid-save.
+
+Trains a deterministic toy loop, commits one complete async checkpoint at
+step 4 (enqueue + drain), runs two more steps, enqueues a second async
+save for step 6 and SIGKILLs itself while the background persist is in
+flight. No drain ever runs, so step 6's manifest must never publish —
+whatever bytes landed, the directory is torn, and resume must fall back
+to step 4's commit. Run with CRASH_DIR set; deliberately killed, so it
+never exits normally.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+_W = 64
+NUM_STEPS = 8
+COMMIT_STEP = 4
+TORN_STEP = 6
+
+
+def make_state():
+    from accelerate_tpu.training import TrainState
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    return TrainState.create(
+        apply_fn=apply_fn,
+        params={"w": jnp.eye(_W) * 0.5},
+        tx=optax.adam(1e-2),
+    )
+
+
+def batch_fn(i):
+    x = np.random.RandomState(0).randn(8, _W).astype("float32")
+    y = np.random.RandomState(1).randn(8, _W).astype("float32")
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def _loss(params, batch):
+    return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+
+def step_fn(state, batch):
+    @jax.jit
+    def _step(state, batch):
+        loss, grads = jax.value_and_grad(_loss)(state.params, batch)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    out = _step(state, batch)
+    jax.block_until_ready(out[0].params)
+    return out
+
+
+def main() -> None:
+    from accelerate_tpu import checkpointing as ckpt
+
+    base = os.environ["CRASH_DIR"]
+    state = make_state()
+    for i in range(TORN_STEP):
+        state, metrics = step_fn(state, batch_fn(i))
+        if i + 1 == COMMIT_STEP:
+            ckpt.save_accelerator_state(
+                os.path.join(base, f"step_{COMMIT_STEP:08d}"),
+                train_states=[state], step=COMMIT_STEP, async_save=True)
+            ckpt.wait_for_checkpoints()  # drain: step 4 COMMITS
+    ckpt.save_accelerator_state(
+        os.path.join(base, f"step_{TORN_STEP:08d}"),
+        train_states=[state], step=TORN_STEP, async_save=True)
+    print("ENQUEUED", flush=True)
+    time.sleep(0.02)  # let the background persist get bytes in flight
+    os.kill(os.getpid(), signal.SIGKILL)  # crash mid-save: no drain, ever
+
+
+if __name__ == "__main__":
+    main()

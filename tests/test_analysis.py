@@ -1170,3 +1170,57 @@ class TestServingStrict:
                 'analysis_findings_total{rule="ATP101"}'] == 1.0
         finally:
             eng.close()
+
+
+class TestCheckpointSnapshotPair:
+    """ISSUE 20: the stage/commit pair guarding the async-checkpoint
+    manifest protocol is a declarative PAIRING_TABLE row — a staged
+    snapshot that can leak past an exception path without commit() or
+    rollback() is exactly the bug that publishes no manifest and strands
+    a complete-on-disk checkpoint invisible."""
+
+    def test_pair_is_registered(self):
+        from accelerate_tpu.analysis.lifecycle import PAIRING_TABLE
+
+        pair = next(p for p in PAIRING_TABLE
+                    if p.name == "checkpoint-snapshot")
+        assert pair.acquire == ("stage",)
+        assert set(pair.release) == {"commit", "rollback"}
+        assert pair.receivers == ("stager",)
+        assert pair.returns_handle
+
+    def test_staged_snapshot_leak_is_flagged(self):
+        src = (
+            "class Saver:\n"
+            "    def save(self, output_dir, step):\n"
+            "        pending = self.stager.stage(output_dir, step)\n"
+            "        if step < 0:\n"
+            "            return None\n"          # leaks the staged handle
+            "        self.stager.commit(pending)\n"
+        )
+        findings = [f for f in lint_text(src, "t.py") if f.rule == "ATP201"]
+        assert findings
+        assert findings[0].data["resource"] == "checkpoint-snapshot"
+
+    def test_rollback_on_error_path_is_clean(self):
+        src = (
+            "class Saver:\n"
+            "    def save(self, output_dir, step):\n"
+            "        pending = self.stager.stage(output_dir, step)\n"
+            "        try:\n"
+            "            self.write(pending)\n"
+            "        except BaseException:\n"
+            "            self.stager.rollback(pending)\n"
+            "            raise\n"
+            "        self.stager.commit(pending, deferred=True)\n"
+        )
+        assert not [f for f in lint_text(src, "t.py")
+                    if f.rule == "ATP201"]
+
+    def test_real_checkpointing_module_is_clean(self):
+        """The production save path must pass its own guard rule."""
+        path = os.path.join(REPO, "accelerate_tpu", "checkpointing.py")
+        findings = lint_paths([path], root=REPO)
+        assert not [f for f in findings if f.rule.startswith("ATP2")], \
+            [(f.rule, f.line, f.data) for f in findings
+             if f.rule.startswith("ATP2")]

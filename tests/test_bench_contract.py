@@ -318,7 +318,7 @@ def test_tunnel_probe_retries_before_declaring_down(monkeypatch, capsys):
     spec.loader.exec_module(tp)
     calls = []
 
-    def flaky_probe():
+    def flaky_probe(state_dir=None):
         calls.append(1)
         if len(calls) < 2:
             raise ConnectionError("tunnel flapped")
@@ -333,7 +333,7 @@ def test_tunnel_probe_retries_before_declaring_down(monkeypatch, capsys):
 
     calls.clear()
 
-    def dead_probe():
+    def dead_probe(state_dir=None):
         calls.append(1)
         raise ConnectionError("gone")
 
@@ -875,3 +875,172 @@ def test_debug_profile_gating_and_capture(tmp_path):
     produced = [os.path.join(dirpath, f)
                 for dirpath, _, files in os.walk(logdir) for f in files]
     assert produced, "profiler capture produced no trace files"
+
+
+# ---------------------------------------------------------------------------
+# resilient training in the bench line (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def test_bench_diff_resilience_directions():
+    """The goodput/drain/resume keys the resilient smoke adds to
+    extra.goodput must carry direction entries so bench-diff gates them:
+    goodput up is better, drain and resume latency down is better."""
+    from accelerate_tpu.commands.bench_diff import metric_direction
+
+    assert metric_direction("extra.goodput.goodput") == 1
+    assert metric_direction("extra.goodput.resilient") == 1
+    assert metric_direction("extra.goodput.checkpoint_drain_p99_s") == -1
+    assert metric_direction("extra.goodput.checkpoint_drain_mean_s") == -1
+    assert metric_direction("extra.goodput.resume_latency_s") == -1
+    # attempt/resume counts are run facts, not compared metrics
+    assert metric_direction("extra.goodput.attempts") == 0
+    assert metric_direction("extra.goodput.resumes") == 0
+
+
+def test_bench_diff_flags_goodput_regression():
+    from accelerate_tpu.commands.bench_diff import compare_rows
+
+    def line(resilient, drain):
+        return {"schema_version": 2, "metric": "m", "unit": "u",
+                "value": 1.0,
+                "extra": {"goodput": {"resilient": resilient,
+                                      "checkpoint_drain_p99_s": drain,
+                                      "attempts": 1}}}
+
+    report = compare_rows(line(0.95, 0.05), line(0.60, 0.50))
+    keys = {e["key"] for e in report["regressions"]}
+    assert "extra.goodput.resilient" in keys
+    assert "extra.goodput.checkpoint_drain_p99_s" in keys
+    assert not compare_rows(line(0.95, 0.05), line(0.95, 0.05))["regressions"]
+
+
+def test_bench_resilience_smoke_row(tmp_path, monkeypatch):
+    """The in-bench resilient smoke: run_resilient over a toy step must
+    produce the extra.goodput keys the trajectory tooling reads, with the
+    compile-counter deltas flat."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.accelerator import Accelerator
+    from accelerate_tpu.training import TrainState
+
+    bench = _load_bench()
+    monkeypatch.setenv("BENCH_RESUME_DIR", os.path.join(str(tmp_path), "ck"))
+    monkeypatch.setenv("BENCH_ATTEMPT", "1")
+    acc = Accelerator()
+    ts = TrainState.create(apply_fn=None, params={"w": jnp.zeros((8, 8))},
+                           tx=optax.sgd(1e-2))
+
+    @jax.jit
+    def step(state, batch):
+        grads = jax.tree_util.tree_map(jnp.ones_like, state.params)
+        return state.apply_gradients(grads), {"loss": jnp.float32(0.0)}
+
+    row = bench._resilience_smoke(acc, step, ts, {"x": 0}, steps=6)
+    assert row["attempts"] == 2  # BENCH_ATTEMPT=1 means second try
+    assert 0.0 <= row["resilient"] <= 1.0
+    assert row["saves"] >= 2 and row["resumes"] == 0
+    assert row["train_pin_computations"] == 0
+    assert row["train_aot_compiles"] == 0
+    assert row["checkpoint_drain_p99_s"] >= 0.0
+    assert row["checkpoint_stage_mean_s"] >= 0.0
+
+
+def test_tpu_retry_attempts_share_resume_dir(monkeypatch, capsys):
+    """The parent's flap-retry loop hands every train attempt the SAME
+    resume dir plus its attempt index, so a killed attempt's newest
+    complete manifest seeds the next one instead of starting over."""
+    bench = _load_bench()
+    train_envs = []
+
+    class GoodOut:
+        returncode = 0
+        stderr = ""
+        stdout = json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 321.0, "vs_baseline": 1.2, "unit": "tokens/s/chip",
+            "extra": {"goodput": {"attempts": 2}}}) + "\n"
+
+    class FlapOut:
+        returncode = 3
+        stderr = ""
+        stdout = ""
+
+    def fake_run(cmd, env=None, timeout=None, **kw):
+        if env.get("BENCH_PHASE") == "train":
+            train_envs.append(env)
+            return FlapOut() if len(train_envs) < 2 else GoodOut()
+        return GoodOut()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(bench, "_TPU_RETRIES", 2)
+    monkeypatch.setenv("BENCH_SERVING", "0")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_CHILD", raising=False)
+    bench.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 321.0
+    assert [e["BENCH_ATTEMPT"] for e in train_envs] == ["0", "1"]
+    dirs = {e["BENCH_RESUME_DIR"] for e in train_envs}
+    assert len(dirs) == 1 and os.path.isdir(dirs.pop())
+    assert line["extra"]["goodput"]["attempts"] == 2
+
+
+def test_tunnel_probe_resumes_completed_sizes(monkeypatch, capsys,
+                                              tmp_path):
+    """A probe retry must NOT re-pay transfers that already committed to
+    the progress manifest: the second attempt resumes at the first
+    unmeasured size and the line reports attempts + resumed_sizes."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tunnel_probe", os.path.join(ROOT, "benchmarks", "tunnel_probe.py"))
+    tp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tp)
+    manifest = tp._manifest_mod()
+
+    state_dir = str(tmp_path)
+    monkeypatch.setenv("TUNNEL_PROBE_STATE_DIR", state_dir)
+    monkeypatch.setattr(tp.time, "sleep", lambda s: None)
+    measured = []
+    flaky = {"armed": True}
+    real_probe = tp._probe
+
+    class FakeDev:
+        platform = "cpu"
+
+        def __str__(self):
+            return "FakeCpuDevice"
+
+    def fake_probe(sd):
+        # mimic _probe's manifest protocol without jax: measure each
+        # size, committing progress; flap once after two sizes
+        committed = manifest.read_manifest(sd) or {}
+        rows = dict((committed.get("extra") or {}).get("rows") or {})
+        resumed = len(rows)
+        for mb in (1, 16, 64, 256):
+            key = f"{mb}MB"
+            if key in rows:
+                continue
+            measured.append(key)
+            rows[key] = {"seconds": 0.1, "MB_per_s": mb / 0.1}
+            manifest.write_manifest(sd, step=len(rows),
+                                    extra={"rows": rows})
+            if flaky["armed"] and len(rows) == 2:
+                flaky["armed"] = False
+                raise ConnectionError("tunnel flapped mid-probe")
+        return {"metric": "host_device_link",
+                "value": rows["256MB"]["MB_per_s"], "unit": "MB/s@256MB",
+                "extra": {"sizes": rows, "resumed_sizes": resumed}}
+
+    monkeypatch.setattr(tp, "_probe", fake_probe)
+    tp.main()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["value"] == 2560.0
+    assert line["extra"]["attempts"] == 2
+    assert line["extra"]["resumed_sizes"] == 2  # 1MB+16MB not re-paid
+    assert measured == ["1MB", "16MB", "64MB", "256MB"]  # each size once
+    assert real_probe is not fake_probe  # the real one still exists

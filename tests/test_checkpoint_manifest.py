@@ -1,0 +1,128 @@
+"""Commit-protocol semantics of the checkpoint manifest (ISSUE 20).
+
+Model-free on purpose: the manifest layer (accelerate_tpu/utils/manifest.py)
+is plain files + atomic rename, so every crash-at-any-byte-offset case is
+exercised here without touching jax — a corrupt or missing manifest must
+parse as "this checkpoint does not exist", and retention must never delete
+the newest complete commit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from accelerate_tpu.utils.manifest import (
+    MANIFEST_NAME,
+    complete_checkpoints,
+    is_complete,
+    latest_complete,
+    prune_complete,
+    read_manifest,
+    write_manifest,
+)
+
+
+def _commit(base, name, step, files=("a.bin",)):
+    d = os.path.join(str(base), name)
+    os.makedirs(d, exist_ok=True)
+    for f in files:
+        with open(os.path.join(d, f), "wb") as fh:
+            fh.write(b"x")
+    write_manifest(d, step=step, files=files)
+    return d
+
+
+def test_write_read_roundtrip(tmp_path):
+    d = _commit(tmp_path, "step_1", 1, files=("a.bin", "b.bin"))
+    m = read_manifest(d)
+    assert m["step"] == 1
+    assert sorted(m["files"]) == ["a.bin", "b.bin"]
+    assert is_complete(d)
+
+
+def test_missing_manifest_is_absent(tmp_path):
+    d = os.path.join(str(tmp_path), "torn")
+    os.makedirs(d)
+    with open(os.path.join(d, "a.bin"), "wb") as fh:
+        fh.write(b"x")  # bytes landed, commit never happened
+    assert read_manifest(d) is None
+    assert not is_complete(d)
+    assert latest_complete(str(tmp_path)) is None
+
+
+def test_corrupt_manifest_is_absent(tmp_path):
+    d = _commit(tmp_path, "step_1", 1)
+    with open(os.path.join(d, MANIFEST_NAME), "w") as fh:
+        fh.write('{"version": 1, "ste')  # torn at an arbitrary byte offset
+    assert read_manifest(d) is None
+    assert not is_complete(d)
+
+
+def test_manifest_wrong_shape_is_absent(tmp_path):
+    d = os.path.join(str(tmp_path), "odd")
+    os.makedirs(d)
+    with open(os.path.join(d, MANIFEST_NAME), "w") as fh:
+        json.dump(["not", "a", "manifest"], fh)
+    assert read_manifest(d) is None
+
+
+def test_listed_file_missing_means_incomplete(tmp_path):
+    d = _commit(tmp_path, "step_1", 1, files=("a.bin", "b.bin"))
+    os.remove(os.path.join(d, "b.bin"))
+    assert read_manifest(d) is not None  # manifest parses...
+    assert not is_complete(d)            # ...but the commit is void
+
+
+def test_latest_complete_picks_highest_step(tmp_path):
+    _commit(tmp_path, "step_2", 2)
+    _commit(tmp_path, "step_10", 10)
+    _commit(tmp_path, "step_5", 5)
+    # a torn later save must not win
+    torn = os.path.join(str(tmp_path), "step_11")
+    os.makedirs(torn)
+    assert latest_complete(str(tmp_path)).endswith("step_10")
+    names = [os.path.basename(p) for p in complete_checkpoints(str(tmp_path))]
+    assert names == ["step_2", "step_5", "step_10"]
+
+
+def test_base_dir_itself_can_be_the_checkpoint(tmp_path):
+    d = _commit(tmp_path, ".", 7)
+    assert latest_complete(d) == os.path.abspath(d)
+
+
+def test_prune_keeps_newest(tmp_path):
+    for s in (1, 2, 3, 4):
+        _commit(tmp_path, f"step_{s}", s)
+    removed = prune_complete(str(tmp_path), keep_last_n=2)
+    assert sorted(os.path.basename(p) for p in removed) == ["step_1", "step_2"]
+    assert latest_complete(str(tmp_path)).endswith("step_4")
+
+
+def test_prune_clamps_to_one_and_never_deletes_newest(tmp_path):
+    for s in (1, 2):
+        _commit(tmp_path, f"step_{s}", s)
+    prune_complete(str(tmp_path), keep_last_n=0)  # clamped to keep >= 1
+    assert latest_complete(str(tmp_path)).endswith("step_2")
+
+
+def test_prune_skips_protected_and_incomplete(tmp_path):
+    kept = _commit(tmp_path, "step_1", 1)
+    _commit(tmp_path, "step_2", 2)
+    _commit(tmp_path, "step_3", 3)
+    torn = os.path.join(str(tmp_path), "step_0")
+    os.makedirs(torn)  # incomplete: not prune_complete's to delete
+    removed = prune_complete(str(tmp_path), keep_last_n=1, protected=(kept,))
+    assert [os.path.basename(p) for p in removed] == ["step_2"]
+    assert os.path.isdir(kept) and os.path.isdir(torn)
+
+
+def test_atomic_replace_no_partial_manifest_visible(tmp_path):
+    # overwriting a manifest goes through tmp+rename: a reader can only
+    # ever see the old or the new version, never a torn one
+    d = _commit(tmp_path, "step_1", 1)
+    write_manifest(d, step=1, files=("a.bin",), extra={"round": 2})
+    m = read_manifest(d)
+    assert m["extra"]["round"] == 2
+    leftovers = [f for f in os.listdir(d) if f not in ("a.bin", MANIFEST_NAME)]
+    assert leftovers == []
